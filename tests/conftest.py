@@ -1,0 +1,50 @@
+"""Test configuration.
+
+Tests run JAX on a virtual 8-device CPU mesh standing in for a TPU slice
+(the driver separately dry-runs the multi-chip path via __graft_entry__).
+The env vars must be set before the first jax import anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+REFERENCE_DATA = "/root/reference/data"
+
+
+def ref_data(relpath: str) -> str:
+    path = os.path.join(REFERENCE_DATA, relpath)
+    if not os.path.isdir(path):
+        pytest.skip(f"reference dataset not available: {path}")
+    return path
+
+
+@pytest.fixture(scope="session")
+def hotel_store():
+    from traceweaver_tpu.ingest import load_corpus
+
+    return load_corpus(ref_data("hotel_reservation/hotel_load25"),
+                       fix=2, max_traces=100, cache=False)
+
+
+@pytest.fixture(scope="session")
+def media_store():
+    from traceweaver_tpu.ingest import load_corpus
+
+    return load_corpus(ref_data("media_microservices/media_load25"),
+                       fix=1, max_traces=50, cache=False)
+
+
+@pytest.fixture(scope="session")
+def nodejs_store():
+    from traceweaver_tpu.ingest import load_corpus
+
+    return load_corpus(ref_data("nodejs_microservices/node_load25"),
+                       fix=0, max_traces=50, cache=False)

@@ -1,0 +1,72 @@
+"""Unit tests for the accuracy metrics on a synthetic micro-problem."""
+
+from traceweaver_tpu.metrics import (
+    accuracy_end_to_end,
+    accuracy_for_service,
+    bin_accuracy_by_response_times,
+    get_ground_truth,
+    get_out_eps_in_order,
+)
+from traceweaver_tpu.spans import Span
+
+
+def _mk(tid, sid, start, dur, kind):
+    return Span(tid, sid, start, dur, "op", [], "p1", kind)
+
+
+def _problem():
+    in_spans = [_mk(f"t{i}", "in", 100 * i, 90, "server") for i in range(4)]
+    out_a = [_mk(f"t{i}", "a", 100 * i + 10, 20, "client") for i in range(4)]
+    out_b = [_mk(f"t{i}", "b", 100 * i + 40, 20, "client") for i in range(4)]
+    return {"up": in_spans}, {"A": out_a, "B": out_b}
+
+
+def test_ground_truth():
+    in_parts, out_parts = _problem()
+    ta = get_ground_truth(in_parts, out_parts)
+    assert ta["A"][("t2", "in")] == ("t2", "a")
+    assert ta["B"][("t0", "in")] == ("t0", "b")
+
+
+def test_accuracy_all_or_nothing_per_span():
+    in_parts, out_parts = _problem()
+    ta = get_ground_truth(in_parts, out_parts)
+    pred = {ep: dict(m) for ep, m in ta.items()}
+    # one wrong hop on t1 kills the whole span, not just one endpoint
+    pred["B"][("t1", "in")] = ("t0", "b")
+    assert accuracy_for_service(pred, ta, in_parts) == 0.75
+
+
+def test_accuracy_list_unwrap():
+    in_parts, out_parts = _problem()
+    ta = get_ground_truth(in_parts, out_parts)
+    pred = {ep: {k: [v] for k, v in m.items()} for ep, m in ta.items()}
+    pred["A"][("t0", "in")] = [("t0", "a"), ("t1", "a")]  # ambiguous => wrong
+    assert accuracy_for_service(pred, ta, in_parts) == 0.75
+
+
+def test_end_to_end_requires_all_services():
+    in_parts, out_parts = _problem()
+    ta = get_ground_truth(in_parts, out_parts)
+    pred = {ep: dict(m) for ep, m in ta.items()}
+    pred["A"][("t3", "in")] = ("t2", "a")
+    trace_acc, acc = accuracy_end_to_end({"svc": pred}, {"svc": ta},
+                                         {"svc": in_parts["up"]})
+    assert trace_acc[("t3")] is False and abs(acc - 0.75) < 1e-9
+
+
+def test_out_eps_in_order():
+    _, out_parts = _problem()
+    assert get_out_eps_in_order(out_parts) == ["A", "B"]
+
+
+def test_bin_accuracy():
+    all_spans = {}
+    trace_acc = {}
+    for i in range(20):
+        s = _mk(f"t{i}", "root", 0, 10 * (i + 1), "server")
+        all_spans[s.GetId()] = s
+        trace_acc[f"t{i}"] = i % 2 == 0
+    bins = bin_accuracy_by_response_times(trace_acc, all_spans, nbins=10)
+    assert len(bins) == 10
+    assert all(0.0 <= acc <= 1.0 for _, acc, _ in bins)

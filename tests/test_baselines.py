@@ -1,0 +1,45 @@
+"""Baseline algorithms on a real hotel_reservation slice.
+
+Thresholds are a few points below observed values so regressions are caught
+without flaking on dataset-slice choice.
+"""
+
+import pytest
+
+from traceweaver_tpu.algorithms import FCFS, WAP5, ArrivalOrder, VPath, VPathOld
+from traceweaver_tpu.ingest import build_service_problem
+from traceweaver_tpu.metrics import (
+    accuracy_end_to_end,
+    accuracy_for_service,
+    get_ground_truth,
+)
+
+
+def run_algo(store, algo_cls):
+    pred_by, true_by = {}, {}
+    for process in store.out_spans_by_process:
+        prob = build_service_problem(store, process)
+        if prob.skipped:
+            continue
+        ta = get_ground_truth(prob.in_span_partitions, prob.out_span_partitions)
+        algo = algo_cls(store.all_spans, store.all_processes)
+        pred = algo.FindAssignments(
+            algo_cls.__name__, process, prob.in_span_partitions,
+            prob.out_span_partitions, False, [], ta,
+        )
+        accuracy_for_service(pred, ta, prob.in_span_partitions)  # unwraps lists
+        pred_by[process], true_by[process] = pred, ta
+    _, e2e = accuracy_end_to_end(pred_by, true_by, store.in_spans_by_process)
+    return e2e
+
+
+@pytest.mark.parametrize("algo_cls,floor", [
+    (FCFS, 0.80),
+    (ArrivalOrder, 0.90),
+    (VPathOld, 0.65),
+    (VPath, 0.75),
+    (WAP5, 0.60),
+])
+def test_baseline_accuracy_floor(hotel_store, algo_cls, floor):
+    e2e = run_algo(hotel_store, algo_cls)
+    assert e2e >= floor, f"{algo_cls.__name__} e2e accuracy {e2e:.3f} < {floor}"

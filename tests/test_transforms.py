@@ -1,0 +1,62 @@
+"""Load-synthesis transform tests."""
+
+import copy
+import random
+
+from traceweaver_tpu.metrics import get_ground_truth
+from traceweaver_tpu.spans import SKIP, Span
+from traceweaver_tpu.synth import compress_spans, create_cache_hits
+
+
+def _mk(tid, sid, start, dur, kind):
+    return Span(tid, sid, start, dur, "op", [], "p1", kind)
+
+
+def _problem(n=50):
+    in_spans = [_mk(f"t{i:03d}", "in", 1000 * i, 900, "server") for i in range(n)]
+    out_a = [_mk(f"t{i:03d}", "a", 1000 * i + 100, 200, "client") for i in range(n)]
+    out_b = [_mk(f"t{i:03d}", "b", 1000 * i + 400, 200, "client") for i in range(n)]
+    return {"up": in_spans}, {"A": out_a, "B": out_b}
+
+
+def test_compress_preserves_offsets():
+    in_parts, out_parts = _problem()
+    orig_offsets = [
+        out_parts["A"][i].start_mus - in_parts["up"][i].start_mus
+        for i in range(50)
+    ]
+    compress_spans(in_parts, out_parts, repeat_factor=1, compress_factor=10)
+    by_tid_in = {s.trace_id: s for s in in_parts["up"]}
+    by_tid_a = {s.trace_id: s for s in out_parts["A"]}
+    for i in range(50):
+        tid = f"t{i:03d}"
+        assert by_tid_in[tid].start_mus == 1000 * i / 10
+        assert by_tid_a[tid].start_mus - by_tid_in[tid].start_mus == orig_offsets[i]
+
+
+def test_compress_noop_at_unity():
+    in_parts, out_parts = _problem()
+    snapshot = copy.deepcopy(in_parts)
+    compress_spans(in_parts, out_parts, 1, 1)
+    assert [s.start_mus for s in in_parts["up"]] == [s.start_mus for s in snapshot["up"]]
+
+
+def test_cache_hits_mark_skips_and_delete_spans():
+    random.seed(10)
+    in_parts, out_parts = _problem()
+    ta = get_ground_truth(in_parts, out_parts)
+    n_before = len(out_parts["A"])
+    ta = create_cache_hits(ta, in_parts, out_parts, cache_rate=0.2)
+    skips = [k for k, v in ta["A"].items() if v == SKIP]
+    assert len(skips) == 10  # int(0.2 * 50)
+    assert len(out_parts["A"]) == n_before - 10
+    # incoming spans of cached traces were shortened
+    cached_tids = {k[0] for k in skips}
+    for s in in_parts["up"]:
+        if s.trace_id in cached_tids:
+            assert s.duration_mus == 900 - 200
+    # endpoint B untouched in count, but shifted earlier for cached traces
+    assert len(out_parts["B"]) == n_before
+    for s in out_parts["B"]:
+        expected = 400 - 200 if s.trace_id in cached_tids else 400
+        assert s.start_mus - 1000 * int(s.trace_id[1:]) == expected

@@ -1,0 +1,27 @@
+"""traceweaver_tpu — a TPU-native trace-reconstruction framework.
+
+Reconstructs end-to-end distributed request traces for microservice
+applications without application instrumentation, with the capabilities of
+TraceWeaver (SIGCOMM'24, reference: /root/reference). The per-service
+span-assignment problem — matching each incoming (server) span to one
+outgoing (client) span per downstream endpoint, under timing containment and
+invocation-order constraints — is expressed as batched, differentiable
+assignment: entropy-regularized optimal transport (Sinkhorn) over masked
+timing-score matrices, vmapped over time windows and call-graph edges and
+sharded over TPU cores with ``jax.sharding`` / ``shard_map``.
+
+Package layout:
+
+- :mod:`traceweaver_tpu.spans`      — span data model + struct-of-arrays batches
+- :mod:`traceweaver_tpu.ingest`     — Jaeger-JSON ingestion, dataset repair,
+  per-service partitioning, invocation-graph inference
+- :mod:`traceweaver_tpu.metrics`    — ground truth + accuracy metrics
+- :mod:`traceweaver_tpu.synth`      — load synthesis (compress / repeat / cache hits)
+- :mod:`traceweaver_tpu.algorithms` — reconstruction algorithms (plugin registry)
+- :mod:`traceweaver_tpu.ops`        — JAX/Pallas numeric kernels (Sinkhorn, scoring)
+- :mod:`traceweaver_tpu.parallel`   — device mesh + sharding helpers
+- :mod:`traceweaver_tpu.runtime`    — executor (library + CLI)
+- :mod:`traceweaver_tpu.query`      — query engine over reconstructed traces
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,68 @@
+"""Reconstruction algorithms behind the reference's plugin contract.
+
+Every algorithm is a class with ``__init__(all_spans, all_processes)`` and a
+``FindAssignments(method, process, in_span_partitions, out_span_partitions,
+parallel, instrumented_hops, true_assignments, ...)`` method returning
+``{out_ep: {in_span_id: out_span_id}}`` (reference:
+src/trace_reconstructor/ports/python/algorithms/README.md:16-53).
+
+:func:`make_predictors` reproduces the reference executor's 11-entry,
+index-selected registry (reference executor.py:888-902), with the
+TPU solver registered at the TraceWeaverV3 slots (8, 9, 10).
+"""
+
+def _unavailable(module_name):
+    class _Unavailable:
+        def __init__(self, *args, **kwargs):
+            pass
+
+        def FindAssignments(self, *args, **kwargs):
+            raise NotImplementedError(
+                f"traceweaver_tpu.algorithms.{module_name} is not available "
+                "in this build"
+            )
+
+    _Unavailable.__name__ = f"Unavailable[{module_name}]"
+    return _Unavailable
+
+
+from traceweaver_tpu.algorithms.fcfs import FCFS  # noqa: F401,E402
+from traceweaver_tpu.algorithms.arrival_order import ArrivalOrder  # noqa: F401
+from traceweaver_tpu.algorithms.vpath import VPath, VPathOld  # noqa: F401
+from traceweaver_tpu.algorithms.wap5 import WAP5  # noqa: F401
+
+
+def make_predictors(all_spans, all_processes):
+    """The ordered (method_name, instance) registry, index-compatible with
+    the reference (0..10). Indices:
+
+    0 MaxScoreBatch (V2)               1 MaxScoreBatchParallel (V2)
+    2 MaxScore (V1)                    3 WAP5
+    4 FCFS                             5 ArrivalOrder
+    6 vPathOld                         7 vPath
+    8 MaxScoreBatchParallelWithoutIterations (TPU solver)
+    9 MaxScoreBatchParallel (TPU solver)
+    10 MaxScoreBatchSubsetWithSkips (TPU solver)
+    """
+    try:
+        from traceweaver_tpu.algorithms.weaver_exact import WeaverExact
+    except ImportError:  # solver not built yet in this checkout
+        WeaverExact = _unavailable("weaver_exact")
+    try:
+        from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
+    except ImportError:
+        WeaverTPU = _unavailable("weaver_tpu")
+
+    return [
+        ("MaxScoreBatch", WeaverExact(all_spans, all_processes)),
+        ("MaxScoreBatchParallel", WeaverExact(all_spans, all_processes)),
+        ("MaxScore", WeaverExact(all_spans, all_processes)),
+        ("WAP5", WAP5(all_spans, all_processes)),
+        ("FCFS", FCFS(all_spans, all_processes)),
+        ("ArrivalOrder", ArrivalOrder(all_spans, all_processes)),
+        ("vPathOld", VPathOld(all_spans, all_processes)),
+        ("vPath", VPath(all_spans, all_processes)),
+        ("MaxScoreBatchParallelWithoutIterations", WeaverTPU(all_spans, all_processes)),
+        ("MaxScoreBatchParallel", WeaverTPU(all_spans, all_processes)),
+        ("MaxScoreBatchSubsetWithSkips", WeaverTPU(all_spans, all_processes)),
+    ]

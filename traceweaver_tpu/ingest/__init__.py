@@ -1,0 +1,14 @@
+"""Jaeger-JSON ingestion, dataset repair, partitioning, DAG inference."""
+
+from traceweaver_tpu.ingest.jaeger import (  # noqa: F401
+    FIX_ROOT_OPS,
+    load_corpus,
+    parse_trace_file,
+    time_ordered_trace_files,
+)
+from traceweaver_tpu.ingest.partition import (  # noqa: F401
+    ServiceProblem,
+    build_service_problem,
+    partition_spans_by_endpoint,
+)
+from traceweaver_tpu.ingest.order import infer_invocation_dag  # noqa: F401

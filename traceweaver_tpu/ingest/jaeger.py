@@ -1,0 +1,366 @@
+"""Jaeger-JSON trace ingestion.
+
+Replicates the ingestion semantics of the reference executor
+(reference: src/trace_reconstructor/ports/python/executor.py:287-475,
+755-849) as a library:
+
+- per-file parsing of Jaeger's ``{"data": [{traceID, spans, processes}]}``
+  shape into :class:`~traceweaver_tpu.spans.Span` objects;
+- the per-dataset ``FIX`` repair modes (0=nodejs, 1=media, 2/3=hotel,
+  4=todo-app, 5=Alibaba);
+- Alibaba-mode client/server span-id rewriting, self-loop remapping to
+  synthetic ``*-loop`` services, and parent⊇child time-containment
+  validation (violating traces dropped);
+- time-ordered directory listing with an on-disk cache;
+- corpus assembly into a :class:`~traceweaver_tpu.spans.TraceStore`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random
+import string
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from traceweaver_tpu.spans import Span, SpanId, TraceStore
+from traceweaver_tpu.ingest import repair
+
+# FIX mode -> required root-span operation name. ``None`` (Alibaba) means
+# "ingest every trace" (reference executor.py:756-762).
+FIX_ROOT_OPS: Dict[int, Optional[str]] = {
+    0: "init-span",
+    1: "ComposeReview",
+    2: "HTTP GET /hotels",
+    3: "HTTP GET /recommendations",
+    4: "[Todo] CompleteTodoCommandHandler",
+    5: None,
+}
+
+
+def _random_id(n: int = 16, suffix: str = "") -> str:
+    alphabet = string.ascii_letters + string.digits
+    return "".join(random.choice(alphabet) for _ in range(n)) + suffix
+
+
+# ---------------------------------------------------------------------------
+# Directory listing, time-ordered (reference executor.py:287-339)
+# ---------------------------------------------------------------------------
+
+def _root_start_time(path: str) -> float:
+    try:
+        with open(path, "r") as f:
+            data = json.load(f).get("data", [])
+    except (json.JSONDecodeError, OSError):
+        return float("inf")
+    if not data:
+        return float("inf")
+    spans = data[0].get("spans", [])
+    root = next((s for s in spans if len(s.get("references", [])) == 0), None)
+    if root is None:
+        return float("inf")
+    return float(root["startTime"])
+
+
+def time_ordered_trace_files(directory: str, clear_cache: bool = False,
+                             cache: bool = True,
+                             write_cache: bool = False) -> List[str]:
+    """List ``*.json`` files in ``directory`` sorted by root-span start time.
+
+    With ``cache=True`` an existing ``time_order_filenames.pickle`` alongside
+    the data is reused if its entries resolve on this machine (same cache
+    file name as the reference, executor.py:320-339, so either implementation
+    can read the other's cache). Writing the cache is opt-in
+    (``write_cache=True``) so loading never mutates a dataset directory.
+    """
+    cache_path = Path(directory) / "time_order_filenames.pickle"
+    if clear_cache:
+        # Honor the clear request without reading the stale cache; only
+        # delete the file when we own cache writes for this directory.
+        cache = False
+        if write_cache and cache_path.exists():
+            os.remove(cache_path)
+    if cache and cache_path.exists():
+        try:
+            with open(cache_path, "rb") as f:
+                files = pickle.load(f)
+            # Shipped datasets carry caches with the original author's
+            # absolute paths; only trust a cache whose entries exist here.
+            if files and all(os.path.exists(f) for f in files[:3]):
+                return files
+        except (pickle.UnpicklingError, EOFError, OSError):
+            pass
+
+    files = sorted(
+        os.path.join(os.path.abspath(directory), f)
+        for f in os.listdir(directory)
+        if f.endswith("json") and os.path.isfile(os.path.join(directory, f))
+    )
+    files.sort(key=_root_start_time)
+    if write_cache:
+        try:
+            with open(cache_path, "wb") as f:
+                pickle.dump(files, f)
+        except OSError:
+            pass  # read-only data dir: skip the cache
+    return files
+
+
+# ---------------------------------------------------------------------------
+# Span-level parsing (reference executor.py:342-488)
+# ---------------------------------------------------------------------------
+
+def _parse_spans_json(
+    spans_json: List[dict],
+    self_loop_map: Dict[str, List[str]],
+    service_loop_map: Dict[str, str],
+    alibaba: bool,
+) -> Optional[Dict[SpanId, Span]]:
+    """Build Span objects from one trace's raw span records.
+
+    In Alibaba mode (``alibaba=True``): client span ids get a ``.client``
+    suffix and server spans are re-parented onto the suffixed client id
+    (executor.py:377-384); self-calls (caller==callee) are remapped onto a
+    synthetic ``<random>-loop`` service shared across traces via
+    ``self_loop_map`` (executor.py:386-399); parent⊇child time containment is
+    validated from the root and the whole trace is dropped (returns None) on
+    violation (executor.py:433-448).
+    """
+    spans: Dict[SpanId, Span] = {}
+    overall_trace_id = None
+
+    for rec in spans_json:
+        span_kind = None
+        for tag in rec.get("tags", []):
+            if tag.get("key") == "span.kind":
+                span_kind = tag.get("value")
+
+        process_id = rec["processID"]
+        trace_id = rec["traceID"]
+        sid = rec["spanID"]
+        start_mus = rec["startTime"]
+        duration_mus = rec["duration"]
+        op_name = rec.get("requestType", rec.get("operationName"))
+
+        if overall_trace_id is None:
+            overall_trace_id = trace_id
+        elif trace_id != overall_trace_id:
+            raise ValueError("Different trace ids for spans in the same trace")
+
+        references: List[SpanId] = [
+            (ref["traceID"], ref["spanID"]) for ref in rec.get("references", [])
+        ]
+
+        if alibaba:
+            if span_kind == "client":
+                sid = sid + ".client"
+            if span_kind == "server" and len(references) == 1:
+                # The Alibaba converter emits a server+client record pair per
+                # call sharing one spanID: the server half's parent is its own
+                # id's client half (executor.py:382-384).
+                references[0] = (references[0][0], sid + ".client")
+            # Self-loop calls: remap the callee (and the server span's
+            # process) onto a stable synthetic "-loop" service.
+            if rec.get("caller") == rec.get("callee"):
+                sanitized = sid[:-7] if sid.endswith(".client") else sid
+                if sanitized not in self_loop_map:
+                    new_callee = _random_id(suffix="-loop")
+                    self_loop_map[sanitized] = [rec["callee"], new_callee]
+                    service_loop_map[new_callee] = rec["callee"]
+                rec["callee"] = self_loop_map[sanitized][1]
+                if span_kind == "server":
+                    process_id = self_loop_map[sanitized][1]
+                    rec["processID"] = process_id
+
+        spans[(trace_id, sid)] = Span(
+            trace_id=trace_id,
+            sid=sid,
+            start_mus=start_mus,
+            duration_mus=duration_mus,
+            op_name=op_name,
+            references=references,
+            process_id=process_id,
+            span_kind=span_kind,
+            tags=rec.get("tags"),
+        )
+
+    if not alibaba:
+        return spans
+
+    # Alibaba mode: link children temporarily, validate containment, and
+    # propagate self-loop process ids down to descendant client spans.
+    children: Dict[SpanId, List[SpanId]] = {}
+    for span_id, span in spans.items():
+        if not span.IsRoot():
+            children.setdefault(span.references[0], []).append(span_id)
+    for parent_id, kids in children.items():
+        if parent_id in spans:
+            for kid in kids:
+                spans[parent_id].AddChild(kid)
+
+    def check_containment(span: Span) -> bool:
+        for child_id in span.children_spans:
+            child = spans[child_id]
+            if not (span.start_mus <= child.start_mus
+                    and span.end_mus >= child.end_mus):
+                return False
+            if not check_containment(child):
+                return False
+        return True
+
+    root = next((s for s in spans.values() if s.IsRoot()), None)
+    if root is not None and not check_containment(root):
+        return None
+
+    def update_descendant_clients(span: Span) -> None:
+        for child_id in span.children_spans:
+            child = spans[child_id]
+            if child.span_kind == "client":
+                child.process_id = spans[(span.trace_id, span.sid)].process_id
+            update_descendant_clients(child)
+
+    def walk(span: Span) -> None:
+        sanitized = span.sid[:-7] if span.sid.endswith(".client") else span.sid
+        if sanitized in self_loop_map:
+            update_descendant_clients(span)
+        for child_id in span.children_spans:
+            walk(spans[child_id])
+
+    if root is not None:
+        walk(root)
+
+    for span in spans.values():
+        span.children_spans = []
+    return spans
+
+
+def _parse_processes(trace_json: dict, alibaba_spans: bool) -> Dict[str, str]:
+    if alibaba_spans:
+        # Alibaba conversion carries no process table: process ids double as
+        # service names (executor.py:484-488).
+        return {rec["processID"]: rec["processID"] for rec in trace_json["spans"]}
+    return {
+        pid: entry["serviceName"]
+        for pid, entry in trace_json.get("processes", {}).items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# Trace-level parsing (reference executor.py:755-793)
+# ---------------------------------------------------------------------------
+
+def parse_trace_file(
+    path: str,
+    fix: int,
+    self_loop_map: Dict[str, List[str]],
+    service_loop_map: Dict[str, str],
+) -> Optional[Tuple[str, Dict[SpanId, Span], Dict[str, str]]]:
+    """Parse one trace file. Returns (trace_id, spans, processes) or None
+    if the trace was dropped (time-containment violation in Alibaba mode).
+    """
+    first_span = FIX_ROOT_OPS[fix]
+    alibaba = first_span is None
+
+    with open(path, "r") as f:
+        payload = json.load(f)
+
+    results = []
+    processes: Dict[str, str] = {}
+    for trace_json in payload["data"]:
+        trace_id = trace_json["traceID"]
+        spans = _parse_spans_json(
+            trace_json["spans"], self_loop_map, service_loop_map, alibaba
+        )
+        if spans is None:
+            return None
+        alibaba_format = "requestType" in trace_json["spans"][0]
+        processes = _parse_processes(trace_json, alibaba_format)
+        if fix == 0:
+            spans = repair.fix_nodejs(spans, processes)
+        elif fix == 1:
+            spans, processes = repair.fix_media(spans, processes)
+        has_root = any(s.IsRoot() for s in spans.values())
+        if has_root:
+            results.append((trace_id, spans))
+
+    assert len(results) == 1, f"expected exactly one rooted trace in {path}"
+    trace_id, spans = results[0]
+    return trace_id, spans, processes
+
+
+# ---------------------------------------------------------------------------
+# Corpus assembly (reference executor.py:798-874)
+# ---------------------------------------------------------------------------
+
+def ingest_trace(
+    store: TraceStore,
+    trace_id: str,
+    spans: Dict[SpanId, Span],
+    processes: Dict[str, str],
+    fix: int,
+) -> int:
+    """Add one parsed trace to the store if its root matches the FIX mode's
+    root operation. Returns 1 if ingested, else 0 (executor.py:798-849).
+    """
+    first_span = FIX_ROOT_OPS[fix]
+
+    root_span_id = None
+    for span_id, span in spans.items():
+        if span.IsRoot():
+            root_span_id = span_id
+        for parent_id in span.references:
+            spans[parent_id].AddChild(span.GetId())
+    for span in spans.values():
+        span.children_spans.sort(key=lambda cid: spans[cid].start_mus)
+
+    if root_span_id is None:
+        return 0
+    if first_span is not None and spans[root_span_id].op_name != first_span:
+        return 0
+
+    def add_span(span_id: SpanId) -> None:
+        span = spans[span_id]
+        service = processes[span.process_id]
+        if span.span_kind == "client":
+            store.out_spans_by_process.setdefault(service, []).append(span)
+        elif span.span_kind == "server":
+            store.in_spans_by_process.setdefault(service, []).append(span)
+        else:
+            raise ValueError(f"span {span_id} has kind {span.span_kind!r}")
+        for child in span.children_spans:
+            add_span(child)
+
+    add_span(root_span_id)
+    store.all_spans.update(spans)
+    store.all_processes[trace_id] = processes
+    return 1
+
+
+def load_corpus(
+    directory: str,
+    fix: int,
+    max_traces: int = 1000,
+    clear_cache: bool = False,
+    cache: bool = True,
+    write_cache: bool = False,
+) -> TraceStore:
+    """Load a directory of Jaeger-JSON traces into a TraceStore.
+
+    ``max_traces`` mirrors the reference's hard cap (executor.py:873:
+    ``if cnt > 1000: break`` — i.e. up to max_traces+1 ingested).
+    """
+    store = TraceStore()
+    self_loop_map: Dict[str, List[str]] = {}
+    cnt = 0
+    for path in time_ordered_trace_files(directory, clear_cache=clear_cache,
+                                         cache=cache, write_cache=write_cache):
+        parsed = parse_trace_file(path, fix, self_loop_map, store.service_loop_map)
+        if parsed is None:
+            continue
+        trace_id, spans, processes = parsed
+        cnt += ingest_trace(store, trace_id, spans, processes, fix)
+        if cnt > max_traces:
+            break
+    return store

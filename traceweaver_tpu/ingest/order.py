@@ -1,0 +1,78 @@
+"""Invocation-order (precedence DAG) inference over outgoing endpoints.
+
+Given ground-truth assignments for a service, infer which downstream
+endpoints are invoked strictly after which others: start from the complete
+digraph over endpoints and delete every edge contradicted by a pair of
+overlapping ground-truth spans (reference:
+src/trace_reconstructor/ports/python/executor.py:214-285, the ``G1`` graph).
+
+Also provides grouped topological sort (executor.py:136-150) used by
+downstream solvers to process endpoints level by level.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import networkx as nx
+
+from traceweaver_tpu.spans import Span, TraceStore
+
+
+def topological_sort_grouped(G: nx.DiGraph) -> List[List]:
+    """Kahn's algorithm, yielding antichains (groups of zero in-degree)."""
+    indegree = {v: d for v, d in G.in_degree() if d > 0}
+    zero = [v for v, d in G.in_degree() if d == 0]
+    groups = []
+    while zero:
+        groups.append(zero)
+        nxt = []
+        for v in zero:
+            for _, child in G.edges(v):
+                indegree[child] -= 1
+                if not indegree[child]:
+                    nxt.append(child)
+        zero = nxt
+    return groups
+
+
+def infer_invocation_dag(
+    in_span_partitions: Dict[str, List[Span]],
+    out_span_partitions: Dict[str, List[Span]],
+    true_assignments: Dict[str, Dict],
+    store: TraceStore,
+) -> nx.DiGraph:
+    """Infer the endpoint precedence DAG from ground-truth assignments.
+
+    Edge (a, b) survives iff in no request does endpoint a's span overlap
+    endpoint b's span in a way contradicting "a completes before b starts".
+    """
+    assert len(in_span_partitions) == 1
+    _, in_spans = next(iter(in_span_partitions.items()))
+    out_eps = list(out_span_partitions.keys())
+
+    G = nx.DiGraph()
+    G.add_nodes_from(out_eps)
+    for a in out_eps:
+        for b in out_eps:
+            if a != b:
+                G.add_edge(a, b)
+
+    for in_span in in_spans:
+        outgoing = []
+        for out_ep in out_eps:
+            span = store.all_spans[true_assignments[out_ep][in_span.GetId()]]
+            child = span.GetChildProcess(store.all_processes, store.all_spans)
+            outgoing.append((span.start_mus, span.duration_mus, child))
+        outgoing.sort(key=lambda x: x[0])
+
+        for i, (xs, xd, xep) in enumerate(outgoing):
+            for j, (ys, yd, yep) in enumerate(outgoing):
+                if i == j:
+                    continue
+                if xs + xd > ys and G.has_edge(xep, yep):
+                    G.remove_edge(xep, yep)
+                if ys + yd > xs and G.has_edge(yep, xep):
+                    G.remove_edge(yep, xep)
+
+    return G

@@ -1,0 +1,71 @@
+"""Per-service span partitioning.
+
+For one service: group its incoming (server) spans by upstream endpoint and
+its outgoing (client) spans by downstream endpoint, sorted by
+``(start, end)`` (reference: src/trace_reconstructor/ports/python/
+executor.py:931-950). Services with more than one incoming partition are
+skipped by the executor, matching the reference.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from traceweaver_tpu.spans import Span, TraceStore
+
+
+def partition_spans_by_endpoint(
+    spans: List[Span], endpoint_of: Callable[[Span], str]
+) -> Dict[str, List[Span]]:
+    partitions: Dict[str, List[Span]] = {}
+    for span in spans:
+        partitions.setdefault(endpoint_of(span), []).append(span)
+    for part in partitions.values():
+        part.sort(key=lambda s: (s.start_mus, s.start_mus + s.duration_mus))
+    return partitions
+
+
+@dataclass
+class ServiceProblem:
+    """One service's assignment problem, ready for a solver.
+
+    ``in_span_partitions`` has exactly one key (the upstream endpoint);
+    ``out_span_partitions`` one key per downstream endpoint.
+    """
+
+    process: str
+    in_span_partitions: Dict[str, List[Span]]
+    out_span_partitions: Dict[str, List[Span]]
+    skipped: bool = False
+    skip_reason: Optional[str] = None
+
+
+def build_service_problem(store: TraceStore, process: str,
+                          deepcopy: bool = True) -> ServiceProblem:
+    """Partition one service's spans (reference executor.py:915-950).
+
+    Deep-copies the span lists by default because downstream transforms
+    (load compression, cache-hit injection) mutate spans in place.
+    """
+    in_spans = store.in_spans_by_process.get(process, [])
+    out_spans = store.out_spans_by_process.get(process, [])
+    if deepcopy:
+        in_spans = copy.deepcopy(in_spans)
+        out_spans = copy.deepcopy(out_spans)
+
+    if len(out_spans) == 0:
+        return ServiceProblem(process, {}, {}, skipped=True,
+                              skip_reason="no outgoing spans")
+
+    in_parts = partition_spans_by_endpoint(
+        in_spans, lambda s: s.GetParentProcess(store.all_processes, store.all_spans)
+    )
+    out_parts = partition_spans_by_endpoint(
+        out_spans, lambda s: s.GetChildProcess(store.all_processes, store.all_spans)
+    )
+    if len(in_parts) > 1:
+        return ServiceProblem(process, in_parts, out_parts, skipped=True,
+                              skip_reason="multiple incoming partitions")
+    return ServiceProblem(process, in_parts, out_parts)

@@ -1,0 +1,167 @@
+"""Span data model.
+
+Two representations:
+
+- :class:`Span` — a per-span record used on the host side during ingestion,
+  partitioning, and by the CPU baseline algorithms. Mirrors the semantics of
+  the reference model (reference: src/trace_reconstructor/ports/python/
+  spans.py:1-75) — notably ``GetParentProcess`` (root spans get a synthetic
+  ``"client_" + op_name`` parent) and ``GetChildProcess`` (a client span's
+  single child's service).
+
+- :class:`SpanArray` — a struct-of-arrays view over a list of spans
+  (start/end times rebased to a local origin so they fit comfortably in
+  float32 on device). This is the representation the TPU solver consumes:
+  everything downstream of partitioning is dense arrays, not Python objects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+SpanId = Tuple[str, str]  # (trace_id, span_id)
+
+# Sentinel assignments used throughout (same wire format as the reference so
+# result pickles / accuracy definitions are interchangeable).
+NA = ("NA", "NA")
+SKIP = ("Skip", "Skip")
+
+
+@dataclass
+class Span:
+    """One RPC span (either the server half or the client half of a call).
+
+    Times are integer microseconds since epoch (Jaeger convention); they stay
+    int64/float on host and are only rebased+downcast when packed into a
+    :class:`SpanArray`.
+    """
+
+    trace_id: str
+    sid: str
+    start_mus: float
+    duration_mus: float
+    op_name: Optional[str]
+    references: List[SpanId]
+    process_id: str
+    span_kind: Optional[str]  # "server" | "client"
+    tags: object = None
+
+    def __post_init__(self) -> None:
+        self.children_spans: List[SpanId] = []
+        self.ep: Optional[str] = None
+
+    # -- identity ---------------------------------------------------------
+    def GetId(self) -> SpanId:
+        return (self.trace_id, self.sid)
+
+    def IsRoot(self) -> bool:
+        return len(self.references) == 0
+
+    @property
+    def end_mus(self) -> float:
+        return self.start_mus + self.duration_mus
+
+    # -- tree navigation --------------------------------------------------
+    def AddChild(self, child_span_id: SpanId) -> None:
+        self.children_spans.append(child_span_id)
+
+    def GetChildProcess(self, all_processes, all_spans) -> str:
+        """Service at the far (callee) end of a client span.
+
+        A client span has exactly one child (the matching server span);
+        its process names the downstream service (reference spans.py:30-36).
+        """
+        assert self.span_kind == "client"
+        assert len(self.children_spans) == 1
+        child = all_spans[self.children_spans[0]]
+        return all_processes[self.trace_id][child.process_id]
+
+    def GetParentProcess(self, all_processes, all_spans) -> str:
+        """Service at the near (caller) end of a server span.
+
+        Root spans get a synthetic external caller ``client_<op>``
+        (reference spans.py:38-43).
+        """
+        if self.IsRoot():
+            return "client_" + str(self.op_name)
+        assert len(self.references) == 1
+        parent = all_spans[self.references[0]]
+        return all_processes[self.trace_id][parent.process_id]
+
+    # -- ordering ---------------------------------------------------------
+    def __lt__(self, other: "Span") -> bool:
+        return self.start_mus < other.start_mus
+
+    def __repr__(self) -> str:
+        return "Span:(%s, %s, %s, %s, %s, %s)" % (
+            self.trace_id, self.sid, self.op_name,
+            self.start_mus, self.duration_mus, self.span_kind,
+        )
+
+
+def make_skip_span(sid: str) -> Span:
+    """A placeholder span representing a skipped (cache-served) call.
+
+    Mirrors the reference's skip spans: every field is the string "None"
+    and ``trace_id == "None"`` marks it (reference traceweaver_v3.py:953-963).
+    """
+    return Span("None", sid, "None", "None", None, [], "None", None, None)  # type: ignore[arg-type]
+
+
+def is_skip_span(span: Span) -> bool:
+    return span.trace_id == "None"
+
+
+@dataclass
+class SpanArray:
+    """Struct-of-arrays packing of a span partition for device compute.
+
+    ``start``/``end`` are float64 microseconds rebased by ``origin_mus``
+    (so that a later cast to float32 preserves sub-microsecond structure
+    within any realistic window). ``ids`` retains the (trace_id, sid) pairs
+    for translating device argmax indices back to wire-format assignments.
+    """
+
+    start: np.ndarray          # [n] float64, rebased
+    end: np.ndarray            # [n] float64, rebased
+    ids: List[SpanId] = field(default_factory=list)
+    origin_mus: float = 0.0
+
+    @classmethod
+    def from_spans(cls, spans: Sequence[Span], origin_mus: Optional[float] = None) -> "SpanArray":
+        if origin_mus is None:
+            origin_mus = min((float(s.start_mus) for s in spans), default=0.0)
+        start = np.array([float(s.start_mus) - origin_mus for s in spans], dtype=np.float64)
+        end = np.array(
+            [float(s.start_mus) + float(s.duration_mus) - origin_mus for s in spans],
+            dtype=np.float64,
+        )
+        return cls(start=start, end=end, ids=[s.GetId() for s in spans], origin_mus=origin_mus)
+
+    def __len__(self) -> int:
+        return int(self.start.shape[0])
+
+
+class TraceStore:
+    """Holds every parsed span and per-trace process tables.
+
+    The executor-level equivalent of the reference's module-global
+    ``all_spans`` / ``all_processes`` dicts (reference executor.py:122-123),
+    made explicit so multiple corpora can coexist.
+    """
+
+    def __init__(self) -> None:
+        self.all_spans: Dict[SpanId, Span] = {}
+        # trace_id -> {process_id -> service name}
+        self.all_processes: Dict[str, Dict[str, str]] = {}
+        # service name -> [Span] (server spans / client spans)
+        self.in_spans_by_process: Dict[str, List[Span]] = {}
+        self.out_spans_by_process: Dict[str, List[Span]] = {}
+        # synthetic "-loop" service -> original service (Alibaba self-calls)
+        self.service_loop_map: Dict[str, str] = {}
+
+    def services(self) -> List[str]:
+        return list(self.out_spans_by_process.keys())

@@ -1,0 +1,7 @@
+"""Load synthesis: compression, replication, cache-hit injection."""
+
+from traceweaver_tpu.synth.transforms import (  # noqa: F401
+    compress_spans,
+    create_cache_hits,
+    repeat_and_interleave_spans,
+)

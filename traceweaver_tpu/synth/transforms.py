@@ -1,0 +1,188 @@
+"""Synthetic workload transforms.
+
+These perturb recorded span partitions to simulate higher load, more request
+interleaving, and caching, matching the reference's generators byte-for-byte
+where randomness is involved (same seeds, same draw order) so accuracy is
+comparable (reference: src/trace_reconstructor/ports/python/helpers/
+transforms.py):
+
+- :func:`compress_spans` (``repeat_change_spans``, transforms.py:10-40) —
+  divide incoming start times by ``compress_factor`` while preserving each
+  request's internal offsets: densifies arrivals to simulate higher load.
+- :func:`repeat_and_interleave_spans` (``repeat_change_spans_3``,
+  transforms.py:96-151) — filter to well-nested requests, replicate
+  ``repeat_factor`` times, re-id and scatter uniformly over the compressed
+  time range: an interleaving generator.
+- :func:`create_cache_hits` (``create_cache_hits``, transforms.py:153-238) —
+  delete the true outgoing span of an exponentially-skewed sample of
+  requests, mark ground truth ('Skip','Skip'), shorten the incoming span and
+  shift later outgoing spans: simulates cache-served calls.
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+import string
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from traceweaver_tpu.spans import SKIP, Span
+from traceweaver_tpu.metrics.accuracy import get_out_eps_in_order
+
+
+def _sort_by_trace_id(partitions: Dict[str, List[Span]]) -> None:
+    for part in partitions.values():
+        part.sort(key=lambda s: s.trace_id)
+
+
+def _sort_by_time(partitions: Dict[str, List[Span]]) -> None:
+    for part in partitions.values():
+        part.sort(key=lambda s: (s.start_mus, s.start_mus + s.duration_mus))
+
+
+def compress_spans(
+    in_span_partitions: Dict[str, List[Span]],
+    out_span_partitions: Dict[str, List[Span]],
+    repeat_factor: int,
+    compress_factor: float,
+) -> Tuple[Dict[str, List[Span]], Dict[str, List[Span]]]:
+    """Divide arrival times by ``compress_factor``, preserving per-request
+    internal offsets. In-place; returns the partitions re-sorted by time."""
+    if repeat_factor == 1 and compress_factor == 1:
+        return in_span_partitions, out_span_partitions
+
+    _sort_by_trace_id(in_span_partitions)
+    _sort_by_trace_id(out_span_partitions)
+
+    assert len(in_span_partitions) == 1
+    ep_in, in_spans = next(iter(in_span_partitions.items()))
+
+    for i, in_span in enumerate(in_spans):
+        new_start = in_span.start_mus / compress_factor
+        for ep_out, out_spans in out_span_partitions.items():
+            out_span = out_spans[i]
+            if out_span.trace_id != in_span.trace_id:
+                raise AssertionError("spans are not aligned by trace id")
+            offset = int(out_span.start_mus) - int(in_span.start_mus)
+            out_span.start_mus = new_start + offset
+        in_span.start_mus = new_start
+
+    _sort_by_time(in_span_partitions)
+    _sort_by_time(out_span_partitions)
+    return in_span_partitions, out_span_partitions
+
+
+def repeat_and_interleave_spans(
+    in_span_partitions: Dict[str, List[Span]],
+    out_span_partitions: Dict[str, List[Span]],
+    repeat_factor: int,
+    compress_factor: float,
+) -> Tuple[Dict[str, List[Span]], Dict[str, List[Span]]]:
+    """Replicate well-nested requests and scatter them uniformly in time."""
+    if repeat_factor <= 1 and compress_factor <= 1:
+        return in_span_partitions, out_span_partitions
+
+    assert len(in_span_partitions) == 1
+    in_old = copy.deepcopy(in_span_partitions)
+    out_old = copy.deepcopy(out_span_partitions)
+    ep_in, in_spans = next(iter(in_old.items()))
+
+    span_inds = []
+    for ind, in_span in enumerate(in_spans):
+        nested = all(
+            float(in_span.start_mus) <= float(out_old[ep][ind].start_mus)
+            and float(out_old[ep][ind].start_mus) + float(out_old[ep][ind].duration_mus)
+            <= float(in_span.start_mus) + float(in_span.duration_mus)
+            for ep in out_old
+        )
+        if nested:
+            span_inds.append(ind)
+
+    in_span_partitions[ep_in] = []
+    for ep in out_old:
+        out_span_partitions[ep] = []
+
+    span_inds = span_inds * repeat_factor
+    random.shuffle(span_inds)
+    min_t = min(float(s.start_mus) for s in in_spans) / compress_factor
+    max_t = max(float(s.start_mus) for s in in_spans) / compress_factor
+    start_ts = sorted(random.uniform(min_t, max_t) for _ in span_inds)
+
+    for ind, start_t in zip(span_inds, start_ts):
+        trace_id = "".join(
+            random.choice(string.ascii_lowercase + string.digits) for _ in range(32)
+        )
+        in_span = copy.deepcopy(in_spans[ind])
+        in_span.start_mus = float(in_span.start_mus)
+        offset = start_t - in_span.start_mus
+        in_span.trace_id = trace_id
+        in_span.start_mus += offset
+        in_span_partitions[ep_in].append(in_span)
+        for ep in out_old:
+            out_span = copy.deepcopy(out_old[ep][ind])
+            out_span.start_mus = float(out_span.start_mus) + offset
+            out_span.trace_id = trace_id
+            out_span_partitions[ep].append(out_span)
+    return in_span_partitions, out_span_partitions
+
+
+def create_cache_hits(
+    true_assignments: Dict[str, Dict],
+    in_span_partitions: Dict[str, List[Span]],
+    out_span_partitions: Dict[str, List[Span]],
+    cache_rate: float,
+) -> Dict[str, Dict]:
+    """Simulate cache-served calls on the earliest outgoing endpoint.
+
+    Chooses an exponentially-skewed sample of requests (seeded np RNG, same
+    draw order as the reference so identical indices are selected), deletes
+    their true outgoing span on the first endpoint, marks ground truth
+    ('Skip','Skip'), shortens the incoming span by the deleted span's
+    duration, and shifts later endpoints' spans of the same trace earlier.
+    """
+    np.random.seed(10)
+
+    eps = get_out_eps_in_order(out_span_partitions)
+    chosen_ep_number = 0
+    chosen_ep = eps[chosen_ep_number]
+
+    lambda_parameter = 0.001
+    in_ep = next(iter(in_span_partitions))
+    num_spans = len(in_span_partitions[in_ep])
+    # Matches the reference's draw order: one discarded exponential batch,
+    # then the weighted choice that actually selects indices.
+    np.random.exponential(scale=1 / lambda_parameter, size=int(cache_rate * num_spans))
+    p = np.exp(-lambda_parameter * np.arange(num_spans)).astype("float64")
+    p = p / np.sum(p)
+    unique_indices = set(
+        np.random.choice(np.arange(num_spans), size=int(cache_rate * num_spans),
+                         replace=False, p=p).tolist()
+    )
+
+    in_spans = in_span_partitions[in_ep]
+    for i, in_span in enumerate(in_spans):
+        random.randint(0, 999)  # preserved draw (reference transforms.py:213)
+        if i not in unique_indices:
+            continue
+        span_id = true_assignments[chosen_ep][in_span.GetId()]
+        cached = next(
+            (s for s in out_span_partitions[chosen_ep] if s.GetId() == span_id), None
+        )
+        if cached is None:
+            continue
+        true_assignments[chosen_ep][in_span.GetId()] = SKIP
+        trace_id = in_span.GetId()[0]
+        for ep in in_span_partitions:
+            for span in in_span_partitions[ep]:
+                if span.GetId()[0] == trace_id:
+                    span.duration_mus -= cached.duration_mus
+        for j, ep in enumerate(eps):
+            if j > chosen_ep_number:
+                for span in out_span_partitions[ep]:
+                    if span.GetId()[0] == trace_id:
+                        span.start_mus -= cached.duration_mus
+        out_span_partitions[chosen_ep].remove(cached)
+
+    return true_assignments
