@@ -1,0 +1,74 @@
+"""Unit tests for the JAX numeric kernels."""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+import jax.numpy as jnp
+
+from traceweaver_tpu.ops import greedy_round, mixture_logpdf, sinkhorn_log
+
+
+def test_mixture_logpdf_matches_scipy():
+    w = jnp.array([0.3, 0.7, 0.0])
+    mu = jnp.array([0.0, 5.0, 0.0])
+    sd = jnp.array([1.0, 2.0, 1.0])
+    x = jnp.array([-1.0, 0.0, 2.5, 7.0])
+    got = np.asarray(mixture_logpdf(x, w, mu, sd))
+    want = np.log(
+        0.3 * scipy.stats.norm.pdf(np.asarray(x), 0.0, 1.0)
+        + 0.7 * scipy.stats.norm.pdf(np.asarray(x), 5.0, 2.0)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4)  # float32 on device
+
+
+def test_sinkhorn_marginals():
+    rng = np.random.default_rng(0)
+    S = jnp.asarray(rng.normal(size=(6, 8)))
+    r = jnp.array([1.0] * 5 + [3.0])  # last row absorbs surplus
+    c = jnp.ones(8)
+    P = sinkhorn_log(S, r, c, epsilon=0.5, n_iters=200)
+    np.testing.assert_allclose(np.asarray(P.sum(1)), np.asarray(r), rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(P.sum(0)), np.asarray(c), rtol=1e-3)
+
+
+def test_sinkhorn_disabled_rows_get_no_mass():
+    S = jnp.zeros((3, 3))
+    r = jnp.array([1.0, 0.0, 1.0])
+    c = jnp.array([1.0, 1.0, 0.0])
+    P = np.asarray(sinkhorn_log(S, r, c, epsilon=0.5, n_iters=100))
+    assert P[1].sum() < 1e-6
+    assert P[:, 2].sum() < 1e-6
+
+
+def test_sinkhorn_sharp_scores_recover_permutation():
+    # a strongly diagonal score matrix should transport on the diagonal
+    S = jnp.asarray(np.where(np.eye(5), 0.0, -50.0))
+    P = np.asarray(sinkhorn_log(S, jnp.ones(5), jnp.ones(5), epsilon=1.0, n_iters=50))
+    assert (P.argmax(1) == np.arange(5)).all()
+
+
+def test_greedy_round_one_to_one():
+    # two rows prefer the same column; peel must give it to the stronger row
+    plan = jnp.asarray(np.array([
+        [0.9, 0.1, 0.0],   # cols: 2 real + skip
+        [0.8, 0.7, 0.0],
+    ]))
+    assign = np.asarray(greedy_round(
+        plan, jnp.array([True, True]), jnp.array([True, True, True]),
+        jnp.asarray(1), n_steps=2))
+    assert assign[0] == 0 and assign[1] == 1
+
+
+def test_greedy_round_skip_capacity():
+    # three rows want skip (col 2), capacity 2: one row must take a real col
+    plan = jnp.asarray(np.array([
+        [0.1, 0.0, 0.5],
+        [0.2, 0.0, 0.6],
+        [0.3, 0.0, 0.7],
+    ]))
+    assign = np.asarray(greedy_round(
+        plan, jnp.array([True] * 3), jnp.array([True, True, True]),
+        jnp.asarray(2), n_steps=3))
+    assert (assign == 2).sum() == 2
+    assert sorted(assign.tolist())[0] == 0  # someone took the real column

@@ -1,0 +1,131 @@
+"""End-to-end solver tests: TPU Sinkhorn solver and CPU exact oracle."""
+
+import random
+
+import pytest
+
+from traceweaver_tpu.algorithms.weaver_exact import WeaverExact
+from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU, perfect_cut_windows
+from traceweaver_tpu.ingest import build_service_problem, infer_invocation_dag
+from traceweaver_tpu.metrics import (
+    accuracy_end_to_end,
+    accuracy_for_service,
+    get_ground_truth,
+    topk_accuracy_for_service,
+)
+from traceweaver_tpu.spans import SKIP, Span
+from traceweaver_tpu.synth import create_cache_hits
+
+
+def _run(store, algo_factory, method, cache_rate=0.0, need_dag=True):
+    random.seed(10)
+    pred_by, true_by, extras = {}, {}, {}
+    for svc in store.out_spans_by_process:
+        prob = build_service_problem(store, svc)
+        if prob.skipped:
+            continue
+        ta = get_ground_truth(prob.in_span_partitions, prob.out_span_partitions)
+        dag = infer_invocation_dag(
+            prob.in_span_partitions, prob.out_span_partitions, ta, store
+        ) if need_dag else None
+        if svc == "frontend" and cache_rate > 0:
+            ta = create_cache_hits(ta, prob.in_span_partitions,
+                                   prob.out_span_partitions, cache_rate)
+        algo = algo_factory()
+        args = [method, svc, prob.in_span_partitions, prob.out_span_partitions,
+                False, [], ta]
+        if need_dag:
+            args.append(dag)
+        out = algo.FindAssignments(*args)
+        pred = out[0] if isinstance(out, tuple) else out
+        accuracy_for_service(pred, ta, prob.in_span_partitions)
+        pred_by[svc], true_by[svc] = pred, ta
+        extras[svc] = (out, prob, ta)
+    _, e2e = accuracy_end_to_end(pred_by, true_by, store.in_spans_by_process)
+    return e2e, extras
+
+
+def test_weaver_tpu_hotel(hotel_store):
+    e2e, _ = _run(
+        hotel_store,
+        lambda: WeaverTPU(hotel_store.all_spans, hotel_store.all_processes),
+        "MaxScoreBatchSubsetWithSkips",
+    )
+    assert e2e >= 0.97, f"WeaverTPU e2e {e2e:.3f}"
+
+
+def test_weaver_tpu_cache_hits(hotel_store):
+    e2e, extras = _run(
+        hotel_store,
+        lambda: WeaverTPU(hotel_store.all_spans, hotel_store.all_processes),
+        "MaxScoreBatchSubsetWithSkips",
+        cache_rate=0.3,
+    )
+    assert e2e >= 0.90, f"WeaverTPU cached e2e {e2e:.3f}"
+    # predicted Skips exist on the cached endpoint
+    (out, prob, ta) = extras["frontend"]
+    pred = out[0]
+    n_skip_pred = sum(
+        1 for ep in pred for v in pred[ep].values() if tuple(v) == SKIP
+    )
+    assert n_skip_pred > 0
+
+
+def test_weaver_tpu_topk_contains_choice(hotel_store):
+    _, extras = _run(
+        hotel_store,
+        lambda: WeaverTPU(hotel_store.all_spans, hotel_store.all_processes),
+        "MaxScoreBatchSubsetWithSkips",
+    )
+    out, prob, ta = extras["search"]
+    pred, topk = out[0], out[1]
+    acc_topk = topk_accuracy_for_service(topk, ta, prob.in_span_partitions)
+    acc = accuracy_for_service(pred, ta, prob.in_span_partitions)
+    assert acc_topk >= acc  # top-K at least as good as top-1
+    for ep in pred:
+        for key, val in pred[ep].items():
+            assert topk[ep][key][0] == val  # candidate 0 is the commitment
+
+
+def test_weaver_exact_hotel(hotel_store):
+    e2e, _ = _run(
+        hotel_store,
+        lambda: WeaverExact(hotel_store.all_spans, hotel_store.all_processes),
+        "MaxScoreBatch",
+        need_dag=False,
+    )
+    assert e2e >= 0.90, f"WeaverExact e2e {e2e:.3f}"
+
+
+def test_tpu_matches_exact_on_unambiguous_data(hotel_store):
+    """On low-load data both solvers should agree with ground truth (and
+    hence each other) almost everywhere."""
+    e2e_tpu, _ = _run(
+        hotel_store,
+        lambda: WeaverTPU(hotel_store.all_spans, hotel_store.all_processes),
+        "MaxScoreBatchSubsetWithSkips",
+    )
+    e2e_exact, _ = _run(
+        hotel_store,
+        lambda: WeaverExact(hotel_store.all_spans, hotel_store.all_processes),
+        "MaxScoreBatch",
+        need_dag=False,
+    )
+    assert e2e_tpu >= e2e_exact - 0.02
+
+
+def test_perfect_cut_windows_partition_and_disjoint():
+    spans = []
+    # 3 separated bursts of 4 overlapping spans each
+    for burst in range(3):
+        t0 = burst * 10_000
+        for i in range(4):
+            spans.append(Span(f"t{burst}_{i}", "in", t0 + i * 10, 500,
+                              "op", [], "p", "server"))
+    spans.sort(key=lambda s: s.start_mus)
+    wins = perfect_cut_windows(spans, max_size=32)
+    assert [w for w in wins] == [(0, 4), (4, 8), (8, 12)]
+    # cap splitting
+    wins = perfect_cut_windows(spans, max_size=2)
+    assert all(hi - lo <= 2 for lo, hi in wins)
+    assert wins[0][0] == 0 and wins[-1][1] == 12

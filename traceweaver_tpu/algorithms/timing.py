@@ -1,0 +1,383 @@
+"""Per-edge delay-distribution estimation (host side).
+
+The solver scores a candidate (incoming span, outgoing span) pair by the
+log-likelihood of the delay between the predecessor event and the outgoing
+span's start under a per-call-graph-edge delay distribution. This module
+learns those distributions, replicating the reference's estimators:
+
+- :func:`batch_means_params` — order-statistics batch-means estimate of
+  (mean, std) from two sorted event-time vectors (reference:
+  traceweaver_v1.py:47-108 ``ComputeDistParams``);
+- :func:`estimate_edge_params` — graph-aware application across the
+  invocation DAG (reference: traceweaver_v3.py:580-646
+  ``ComputeEpPairDistParams3``);
+- :func:`bootstrap_distributions` — unsupervised bootstrap from raw span
+  streams by the nearest-preceding-parent heuristic (reference:
+  traceweaver_v3.py:108-172 ``BuildDistributions``);
+- :func:`refit_from_assignments` — EM-style per-edge GMM refit with
+  BIC-selected 1..5 components from a completed assignment pass
+  (reference: traceweaver_v3.py:706-818 ``ComputeEpPairDistParams5``).
+
+Distributions are represented uniformly as :class:`EdgeDist` — a Gaussian
+mixture padded to ``MAX_COMPONENTS`` so every edge ships to the device as
+fixed-shape (weights, means, vars) rows.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import networkx as nx
+import numpy as np
+
+from traceweaver_tpu.spans import NA, SKIP, Span
+
+MAX_COMPONENTS = 5
+# Floor on std to avoid singular densities (reference traceweaver_v1.py:130-132
+# substitutes 0.001 when std underflows).
+MIN_STD = 1e-3
+
+EdgeKey = Tuple[str, str]
+
+
+@dataclass
+class EdgeDist:
+    """Gaussian-mixture delay distribution for one call-graph edge."""
+
+    weights: np.ndarray  # [MAX_COMPONENTS]
+    means: np.ndarray    # [MAX_COMPONENTS]
+    stds: np.ndarray     # [MAX_COMPONENTS]
+
+    @classmethod
+    def gaussian(cls, mean: float, std: float) -> "EdgeDist":
+        w = np.zeros(MAX_COMPONENTS)
+        m = np.zeros(MAX_COMPONENTS)
+        s = np.full(MAX_COMPONENTS, 1.0)
+        w[0] = 1.0
+        m[0] = mean
+        s[0] = max(float(std), MIN_STD)
+        return cls(w, m, s)
+
+    @classmethod
+    def from_samples_gmm(cls, samples: Sequence[float],
+                         max_components: int = MAX_COMPONENTS,
+                         random_state: int = 100) -> "EdgeDist":
+        """BIC-selected GMM fit (reference traceweaver_v3.py:764-786)."""
+        x = np.asarray(samples, dtype=np.float64).reshape(-1, 1)
+        if len(x) == 0:
+            return cls.gaussian(0.0, MIN_STD)
+        n_unique = len(np.unique(x))
+        if n_unique == 1 or len(x) < 4:
+            return cls.gaussian(float(np.mean(x)), float(np.std(x)))
+        from sklearn import mixture
+
+        best, best_bic = None, np.inf
+        for n in range(1, min(n_unique, max_components) + 1):
+            try:
+                model = mixture.GaussianMixture(
+                    n_components=n, covariance_type="diag",
+                    random_state=random_state).fit(x)
+            except ValueError:
+                continue
+            bic = model.bic(x)
+            if bic < best_bic:
+                best, best_bic = model, bic
+        if best is None:
+            return cls.gaussian(float(np.mean(x)), float(np.std(x)))
+        k = best.n_components
+        w = np.zeros(MAX_COMPONENTS)
+        m = np.zeros(MAX_COMPONENTS)
+        s = np.full(MAX_COMPONENTS, 1.0)
+        w[:k] = best.weights_
+        m[:k] = best.means_.ravel()
+        # Floor component stds at 1µs: delays are integer microseconds, and
+        # a near-zero-variance component would otherwise turn into a density
+        # spike that dominates every feasible candidate's score.
+        s[:k] = np.maximum(np.sqrt(best.covariances_.ravel()), 1.0)
+        return cls(w, m, s)
+
+    def logpdf(self, x: np.ndarray) -> np.ndarray:
+        """Mixture log-density (numpy; the device version lives in ops)."""
+        x = np.asarray(x, dtype=np.float64)[..., None]
+        comp = (
+            -0.5 * ((x - self.means) / self.stds) ** 2
+            - np.log(self.stds)
+            - 0.5 * math.log(2 * math.pi)
+        )
+        w = np.where(self.weights > 0, self.weights, 0.0)
+        logw = np.where(w > 0, np.log(np.maximum(w, 1e-300)), -np.inf)
+        return np.asarray(np.logaddexp.reduce(comp + logw, axis=-1))
+
+
+def batch_means_params(t1: Sequence[float], t2: Sequence[float],
+                       nbatches: int = 10) -> Tuple[float, float]:
+    """(mean, std) of elementwise delay between two sorted time vectors.
+
+    The std is estimated from the spread of batch means scaled back by
+    sqrt(batch_size) — robust to the unknown pairing within a batch
+    (reference traceweaver_v1.py:55-76).
+    """
+    t1 = list(t1)
+    t2 = list(t2)
+    assert len(t1) == len(t2) and len(t1) > 0
+    mean = (sum(t2) - sum(t1)) / len(t1)
+    batch_size = math.ceil(float(len(t1)) / nbatches)
+    batch_means = []
+    for i in range(nbatches):
+        lo, hi = i * batch_size, min(len(t1), (i + 1) * batch_size)
+        if hi - lo > 0:
+            batch_means.append((sum(t2[lo:hi]) - sum(t1[lo:hi])) / (hi - lo))
+    if len(batch_means) >= 2:
+        import scipy.stats
+
+        std = math.sqrt(batch_size) * float(scipy.stats.tstd(batch_means))
+        if math.isnan(std):
+            std = MIN_STD
+    else:
+        std = MIN_STD
+    return mean, std
+
+
+def has_longer_path(dag: nx.DiGraph, src: str, dst: str) -> bool:
+    """True if src reaches dst by some path of length > 1 (so the direct
+    edge is a shortcut and its delay is not a primary dependency;
+    reference traceweaver_v1.py:245-254 ``AlsoNonPrimaryAncestor``)."""
+    for path in nx.all_simple_paths(dag, source=src, target=dst, cutoff=2):
+        if len(path) - 1 > 1:
+            return True
+    return False
+
+
+def primary_pred_edges(dag: nx.DiGraph, out_ep: str) -> List[str]:
+    """Direct predecessors of ``out_ep`` whose edge is primary (not a
+    shortcut past a longer path)."""
+    return [
+        p for p, _ in dag.in_edges(out_ep) if not has_longer_path(dag, p, out_ep)
+    ]
+
+
+def estimate_edge_params(
+    in_span_partitions: Dict[str, List[Span]],
+    out_span_partitions: Dict[str, List[Span]],
+    dag: nx.DiGraph,
+    lo: int,
+    hi: int,
+) -> Dict[EdgeKey, EdgeDist]:
+    """Graph-aware batch-means estimation over span index window [lo, hi).
+
+    Edges estimated (reference traceweaver_v3.py:619-646):
+    - (in_ep, e) for every root endpoint e (no DAG predecessors): delay
+      between sorted incoming starts and sorted e starts;
+    - (p, e) for every primary DAG edge: sorted p ends vs sorted e starts;
+    - (e, in_ep) for every endpoint: sorted e ends vs sorted incoming ends.
+    """
+    in_ep = next(iter(in_span_partitions))
+    dists: Dict[EdgeKey, EdgeDist] = {}
+
+    def est(ep1: str, ep2: str, t1: List[float], t2: List[float]) -> None:
+        mean, std = batch_means_params(sorted(t1)[lo:hi], sorted(t2)[lo:hi])
+        dists[(ep1, ep2)] = EdgeDist.gaussian(mean, std)
+
+    in_starts = [s.start_mus for s in in_span_partitions[in_ep]]
+    in_ends = [s.start_mus + s.duration_mus for s in in_span_partitions[in_ep]]
+
+    for out_ep, out_spans in out_span_partitions.items():
+        starts = [s.start_mus for s in out_spans]
+        ends = [s.start_mus + s.duration_mus for s in out_spans]
+        preds = primary_pred_edges(dag, out_ep)
+        if len(dag.in_edges(out_ep)) == 0:
+            est(in_ep, out_ep, in_starts, starts)
+        for p in preds:
+            if p == in_ep:
+                est(p, out_ep, in_starts, starts)
+            else:
+                p_ends = [s.start_mus + s.duration_mus for s in out_span_partitions[p]]
+                est(p, out_ep, p_ends, starts)
+        est(out_ep, in_ep, ends, in_ends)
+    return dists
+
+
+def bootstrap_distributions(
+    in_span_partitions: Dict[str, List[Span]],
+    out_span_partitions: Dict[str, List[Span]],
+    out_eps: List[str],
+    store_processes=None,
+    store_spans=None,
+) -> Dict[EdgeKey, EdgeDist]:
+    """Unsupervised bootstrap: attribute each span to its nearest plausible
+    preceding parent in a merged time-sorted stream (reference
+    traceweaver_v3.py:108-172).
+    """
+    in_ep = next(iter(in_span_partitions))
+    tagged: List[Tuple[Span, str]] = []
+    for span in in_span_partitions[in_ep]:
+        tagged.append((span, in_ep))
+    for out_ep in out_eps:
+        for span in out_span_partitions[out_ep]:
+            tagged.append((span, out_ep))
+    tagged.sort(key=lambda t: t[0].start_mus)
+    large_delay = max(s.duration_mus for s in in_span_partitions[in_ep])
+    order = {ep: i for i, ep in enumerate(out_eps)}
+
+    values: Dict[EdgeKey, List[float]] = {}
+
+    for i, (span, ep) in enumerate(tagged):
+        if span.span_kind == "client":
+            sent = span.start_mus
+            dur = span.duration_mus
+            parent: Optional[Tuple[Span, str, str]] = None
+            for j in range(i - 1, -1, -1):  # no slice copies: O(n^2) otherwise
+                pspan, pep = tagged[j]
+                if (sent + dur) - pspan.start_mus > large_delay:
+                    break
+                if pspan.span_kind == "server":
+                    parent = (pspan, pep, "server")
+                    break
+                if (pspan.span_kind == "client"
+                        and pspan.start_mus + pspan.duration_mus < span.start_mus
+                        and order.get(pep, 1 << 30) < order.get(ep, 1 << 30)):
+                    parent = (pspan, pep, "client")
+                    break
+            if parent is not None:
+                pspan, pep, kind = parent
+                delay = (sent - pspan.start_mus if kind == "server"
+                         else sent - (pspan.start_mus + pspan.duration_mus))
+                values.setdefault((pep, ep), []).append(delay)
+        elif span.span_kind == "server":
+            sent = span.start_mus
+            dur = span.duration_mus
+            parent = None
+            for j in range(i - 1, -1, -1):
+                pspan, pep = tagged[j]
+                if (sent + dur) - pspan.start_mus > large_delay:
+                    break
+                if (pspan.span_kind == "client"
+                        and pspan.start_mus + pspan.duration_mus < sent + dur):
+                    parent = (pspan, pep, "client")
+                    break
+            if parent is not None:
+                pspan, pep, _ = parent
+                values.setdefault((pep, ep), []).append(
+                    (sent + dur) - (pspan.start_mus + pspan.duration_mus)
+                )
+            values.setdefault((ep, ep), []).append(dur)
+
+    return {
+        key: EdgeDist.gaussian(float(np.mean(v)), float(np.std(v)))
+        for key, v in values.items()
+    }
+
+
+def refit_from_assignments(
+    in_span_partitions: Dict[str, List[Span]],
+    out_span_partitions: Dict[str, List[Span]],
+    dag: nx.DiGraph,
+    assignments: Dict[str, Dict],
+    all_spans: Dict,
+) -> Dict[EdgeKey, EdgeDist]:
+    """EM refit: per-edge delay samples from a completed assignment pass,
+    fit as BIC-selected GMMs (reference traceweaver_v3.py:706-818).
+
+    Spans are resolved from ``out_span_partitions`` (not ``all_spans``) so
+    that synthetic transforms applied to the partitions — load compression,
+    cache-hit shifts — stay on one consistent timeline.
+    """
+    if dag is None:
+        # no precedence information: every endpoint hangs off the incoming span
+        dag = nx.DiGraph()
+        dag.add_nodes_from(out_span_partitions.keys())
+    in_ep = next(iter(in_span_partitions))
+    dists: Dict[EdgeKey, EdgeDist] = {}
+    by_id = {
+        ep: {s.GetId(): s for s in spans}
+        for ep, spans in out_span_partitions.items()
+    }
+
+    def span_of(assign_map, in_span, ep):
+        sid = assign_map.get(in_span.GetId())
+        if sid is None or tuple(sid) in (NA, SKIP):
+            return None
+        sid = tuple(sid)
+        return by_id[ep].get(sid) or all_spans.get(sid)
+
+    for out_ep in out_span_partitions:
+        preds = primary_pred_edges(dag, out_ep)
+        # (in_ep -> out_ep): out.start - in.start
+        if len(dag.in_edges(out_ep)) == 0 or in_ep in preds:
+            samples = []
+            for in_span in in_span_partitions[in_ep]:
+                out = span_of(assignments[out_ep], in_span, out_ep)
+                if out is not None:
+                    samples.append(out.start_mus - in_span.start_mus)
+            dists[(in_ep, out_ep)] = EdgeDist.from_samples_gmm(samples)
+        # (p -> out_ep): out.start - p_out.end
+        for p in preds:
+            if p == in_ep:
+                continue
+            samples = []
+            for in_span in in_span_partitions[in_ep]:
+                p_out = span_of(assignments[p], in_span, p)
+                out = span_of(assignments[out_ep], in_span, out_ep)
+                if p_out is not None and out is not None:
+                    samples.append(
+                        out.start_mus - (p_out.start_mus + p_out.duration_mus)
+                    )
+            dists[(p, out_ep)] = EdgeDist.from_samples_gmm(samples)
+        # (out_ep -> in_ep): in.end - out.end
+        samples = []
+        for in_span in in_span_partitions[in_ep]:
+            out = span_of(assignments[out_ep], in_span, out_ep)
+            if out is not None:
+                samples.append(
+                    (in_span.start_mus + in_span.duration_mus)
+                    - (out.start_mus + out.duration_mus)
+                )
+        dists[(out_ep, in_ep)] = EdgeDist.from_samples_gmm(samples)
+    return dists
+
+
+def true_distributions(
+    in_span_partitions: Dict[str, List[Span]],
+    out_span_partitions: Dict[str, List[Span]],
+    out_eps: List[str],
+    true_assignments: Dict[str, Dict],
+) -> Dict[EdgeKey, EdgeDist]:
+    """Oracle distributions from ground truth (reference
+    traceweaver_v3.py:66-106 ``BuildTrueDistributions``) — used by the
+    ``WithTrueDist`` ablation."""
+    in_ep = next(iter(in_span_partitions))
+    by_id = {
+        ep: {s.GetId(): s for s in spans}
+        for ep, spans in out_span_partitions.items()
+    }
+    values: Dict[EdgeKey, List[float]] = {}
+    for in_span in in_span_partitions[in_ep]:
+        prev_span: Optional[Span] = None
+        prev_ep: Optional[str] = None
+        for depth, out_ep in enumerate(out_eps):
+            sid = true_assignments[out_ep].get(in_span.GetId())
+            if sid is None or tuple(sid) == SKIP:
+                continue
+            out = by_id[out_ep].get(tuple(sid))
+            if out is None:
+                continue
+            if prev_span is None:
+                values.setdefault((in_ep, out_ep), []).append(
+                    out.start_mus - in_span.start_mus
+                )
+            else:
+                values.setdefault((prev_ep, out_ep), []).append(
+                    out.start_mus - (prev_span.start_mus + prev_span.duration_mus)
+                )
+            prev_span, prev_ep = out, out_ep
+        if prev_span is not None:
+            values.setdefault((prev_ep, in_ep), []).append(
+                (in_span.start_mus + in_span.duration_mus)
+                - (prev_span.start_mus + prev_span.duration_mus)
+            )
+    return {
+        key: EdgeDist.gaussian(float(np.mean(v)), float(np.std(v)))
+        for key, v in values.items()
+    }

@@ -1,0 +1,5 @@
+"""JAX/Pallas numeric kernels: Sinkhorn OT, mixture scoring, rounding."""
+
+from traceweaver_tpu.ops.sinkhorn import sinkhorn_log  # noqa: F401
+from traceweaver_tpu.ops.scores import mixture_logpdf, pair_scores  # noqa: F401
+from traceweaver_tpu.ops.rounding import greedy_round  # noqa: F401

@@ -1,0 +1,39 @@
+"""Device-side delay scoring: Gaussian-mixture log-densities.
+
+The host learns per-edge delay distributions (:mod:`traceweaver_tpu.
+algorithms.timing`); they ship to the device as fixed-shape (weights,
+means, stds) rows and are evaluated here, batched over candidate matrices
+(replacing the reference's per-pair ``GetEpPairCost`` scipy calls,
+traceweaver_v1.py:117-148, with one fused vectorized evaluation).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+LOG_2PI = math.log(2.0 * math.pi)
+
+
+def mixture_logpdf(x: jnp.ndarray, weights: jnp.ndarray, means: jnp.ndarray,
+                   stds: jnp.ndarray) -> jnp.ndarray:
+    """Log-density of a Gaussian mixture.
+
+    x: [...]; weights/means/stds: [..., K] broadcastable against x[..., None].
+    Components with weight 0 are padding.
+    """
+    z = (x[..., None] - means) / stds
+    comp = -0.5 * z * z - jnp.log(stds) - 0.5 * LOG_2PI
+    logw = jnp.where(weights > 0, jnp.log(jnp.maximum(weights, 1e-30)), -jnp.inf)
+    return logsumexp(comp + logw, axis=-1)
+
+
+def pair_scores(t_prev: jnp.ndarray, out_start: jnp.ndarray,
+                weights: jnp.ndarray, means: jnp.ndarray,
+                stds: jnp.ndarray) -> jnp.ndarray:
+    """Score matrix S[i, j] = log p(out_start_j - t_prev_i) under one edge's
+    mixture. t_prev: [N]; out_start: [M]; mixture params: [K]."""
+    delta = out_start[None, :] - t_prev[:, None]  # [N, M]
+    return mixture_logpdf(delta, weights, means, stds)
