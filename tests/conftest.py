@@ -2,17 +2,23 @@
 
 Tests run JAX on a virtual 8-device CPU mesh standing in for a TPU slice
 (the driver separately dry-runs the multi-chip path via __graft_entry__).
-The env vars must be set before the first jax import anywhere.
+The sandbox's sitecustomize pins JAX_PLATFORMS=axon (the real chip), so we
+must override both the env var and the jax config before anything imports
+jax.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -1,0 +1,7 @@
+"""Device mesh + sharding helpers."""
+
+from traceweaver_tpu.parallel.mesh import (  # noqa: F401
+    em_step_sharded,
+    make_mesh,
+    shard_solve_windows,
+)

@@ -1,0 +1,178 @@
+"""Device-mesh sharding for the windowed solver.
+
+The reference has no distributed compute at all (SURVEY.md §2.8) — its
+concurrency is a ThreadPool over services (reference executor.py:1015-1026)
+and backgrounded shell jobs. Here the natural batch axis is the *window*
+axis produced by perfect-cut segmentation: windows are independent
+subproblems, so they shard cleanly across TPU cores over ICI:
+
+- :func:`shard_solve_windows` — data-parallel inference: window tensors are
+  placed with a ``NamedSharding`` over the ``data`` mesh axis and the jitted
+  solve partitions automatically (XLA SPMD inserts any needed collectives).
+- :func:`em_step_sharded` — one full *training* step of the EM loop under
+  ``shard_map``: each shard solves its windows and computes plan-weighted
+  sufficient statistics for every call-graph edge's delay distribution;
+  ``jax.lax.psum`` over the mesh reduces the statistics, and every device
+  computes the same updated (mean, std) — the distributed analogue of the
+  reference's ``ComputeEpPairDistParams5`` refit (traceweaver_v3.py:706-818)
+  fused with the solve.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from traceweaver_tpu.algorithms.weaver_tpu import solve_windows
+
+BATCHED = ("in_start", "in_end", "in_valid", "out_start", "out_end",
+           "out_valid", "skip_cap", "force_skip")
+REPLICATED = ("pred_mask", "root_mask", "is_last",
+              "edge_wt", "edge_mu", "edge_sd",
+              "in_wt", "in_mu", "in_sd",
+              "ret_wt", "ret_mu", "ret_sd")
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "data",
+              backend: Optional[str] = None) -> Mesh:
+    """Mesh over the default backend's devices; falls back to virtual CPU
+    devices when more devices are requested than the default backend has
+    (single-chip dev box standing in for a slice)."""
+    devices = jax.devices(backend) if backend else jax.devices()
+    if n_devices is not None and len(devices) < n_devices:
+        devices = jax.devices("cpu")
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise RuntimeError(
+                f"cannot assemble a {n_devices}-device mesh: default backend "
+                f"and CPU fallback offer only {len(devices)} devices (start "
+                "the process with XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={n_devices})"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def _pad_batch(arrays: Dict[str, np.ndarray], multiple: int) -> Tuple[Dict[str, np.ndarray], int]:
+    b = arrays["in_start"].shape[0]
+    pad = (-b) % multiple
+    if pad == 0:
+        return arrays, b
+    out = dict(arrays)
+    for k in BATCHED:
+        a = arrays[k]
+        out[k] = np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)], axis=0
+        )
+    return out, b
+
+
+def shard_solve_windows(arrays: Dict[str, np.ndarray], mesh: Mesh,
+                        **kwargs):
+    """Run :func:`solve_windows` with the window axis sharded over ``mesh``.
+
+    Pads the batch to a multiple of the mesh size, places the batched
+    tensors with a window-axis ``NamedSharding``, and lets the jitted solve
+    partition under XLA SPMD. Returns outputs trimmed to the true batch.
+    """
+    axis = mesh.axis_names[0]
+    arrays, true_b = _pad_batch(arrays, mesh.devices.size)
+    batched_sharding = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
+    args = {}
+    for k in BATCHED:
+        args[k] = jax.device_put(arrays[k], batched_sharding)
+    for k in REPLICATED:
+        args[k] = jax.device_put(arrays[k], replicated)
+    out = solve_windows(
+        args["in_start"], args["in_end"], args["in_valid"],
+        args["out_start"], args["out_end"], args["out_valid"],
+        args["skip_cap"], args["force_skip"],
+        args["pred_mask"], args["root_mask"], args["is_last"],
+        args["edge_wt"], args["edge_mu"], args["edge_sd"],
+        args["in_wt"], args["in_mu"], args["in_sd"],
+        args["ret_wt"], args["ret_mu"], args["ret_sd"],
+        **kwargs,
+    )
+    return tuple(np.asarray(o)[:true_b] for o in out)
+
+
+@lru_cache(maxsize=32)
+def _build_em_step(mesh: Mesh, epsilon: float, n_sinkhorn: int):
+    """Compile-once factory for the sharded EM step (jit caches by function
+    identity, so the closure must be built once per (mesh, hypers))."""
+    axis = mesh.axis_names[0]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(tuple(P(axis) for _ in BATCHED),
+                  tuple(P() for _ in REPLICATED)),
+        out_specs=(P(axis), P(), P()),
+        check_rep=False,
+    )
+    def step(batched, replicated):
+        (in_start, in_end, in_valid, out_start, out_end, out_valid,
+         skip_cap, force_skip) = batched
+        (pred_mask, root_mask, is_last,
+         edge_wt, edge_mu, edge_sd,
+         in_wt, in_mu, in_sd,
+         ret_wt, ret_mu, ret_sd) = replicated
+
+        assign, _, _, _ = solve_windows(
+            in_start, in_end, in_valid, out_start, out_end, out_valid,
+            skip_cap, force_skip, pred_mask, root_mask, is_last,
+            edge_wt, edge_mu, edge_sd, in_wt, in_mu, in_sd,
+            ret_wt, ret_mu, ret_sd,
+            epsilon=epsilon, n_sinkhorn=n_sinkhorn,
+        )  # [b, E, W]
+
+        M = out_start.shape[2]
+        K = in_wt.shape[1]
+        safe = jnp.clip(assign, 0, M - 1)
+        # delay of the chosen candidate measured from the incoming start
+        chosen_start = jnp.take_along_axis(out_start, safe, axis=2)  # [b, E, W]
+        delay = chosen_start - in_start[:, None, :]
+        real = (assign >= 0) & (assign < M) & in_valid[:, None, :]
+        w = real.astype(jnp.float32)
+        n = jax.lax.psum(jnp.sum(w, axis=(0, 2)), axis)           # [E]
+        s1 = jax.lax.psum(jnp.sum(w * delay, axis=(0, 2)), axis)  # [E]
+        s2 = jax.lax.psum(jnp.sum(w * delay * delay, axis=(0, 2)), axis)
+
+        mean = s1 / jnp.maximum(n, 1.0)
+        var = jnp.maximum(s2 / jnp.maximum(n, 1.0) - mean * mean, 1.0)
+        E = mean.shape[0]
+        new_mu = jnp.zeros((E, K), dtype=jnp.float32).at[:, 0].set(mean)
+        new_sd = jnp.ones((E, K), dtype=jnp.float32).at[:, 0].set(jnp.sqrt(var))
+        return assign, new_mu, new_sd
+
+    return jax.jit(step)
+
+
+def em_step_sharded(arrays: Dict[str, np.ndarray], mesh: Mesh,
+                    epsilon: float = 1.0, n_sinkhorn: int = 40):
+    """One distributed EM step: sharded solve + psum'd M-step.
+
+    E-step: every shard solves its windows (hard assignments). M-step: each
+    shard accumulates, per endpoint, the plan-weighted delay sufficient
+    statistics (count, sum, sum of squares of ``out.start − t_origin``),
+    reduced with ``psum`` over the mesh; the update
+    ``mean = Σd/n, var = Σd²/n − mean²`` is computed identically on every
+    device. Returns (assign, new_in_mu, new_in_sd).
+
+    The compiled step is cached per (mesh, epsilon, n_sinkhorn) — repeated
+    calls in a training loop reuse one XLA program per input shape.
+    """
+    arrays, true_b = _pad_batch(arrays, mesh.devices.size)
+    step = _build_em_step(mesh, epsilon, n_sinkhorn)
+    batched = tuple(jnp.asarray(arrays[k]) for k in BATCHED)
+    replicated = tuple(jnp.asarray(arrays[k]) for k in REPLICATED)
+    assign, new_mu, new_sd = step(batched, replicated)
+    return (np.asarray(assign)[:true_b], np.asarray(new_mu), np.asarray(new_sd))
