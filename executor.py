@@ -1,0 +1,12 @@
+"""Repo-root executor shim: same CLI as the reference's executor script.
+
+Lets reference-style invocations (``python executor.py --relative_path ...``)
+run against the TPU-native framework unmodified.
+"""
+
+import sys
+
+from traceweaver_tpu.runtime.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Shared runner for the experiment reproductions (reference:
+# exps/exp*/run_experiment.sh). Each config launches one executor process in
+# the background; callers `wait` after queueing all configs.
+#
+# Data location defaults to the recorded reference datasets; override with
+#   TW_DATA=/path/to/data bash run_experiment.sh [clear_cache]
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+TW_DATA="${TW_DATA:-/root/reference/data}"
+PYTHON="${PYTHON:-python3}"
+
+run_executor() {
+    # args: rel_data compressed cache_rate fix test_name load compress repeat
+    #       exec_parallel results_dir clear_cache predictor_indices
+    "$PYTHON" "$REPO_ROOT/executor.py" \
+        --absolute_path "$TW_DATA/$1" \
+        --compressed "$2" \
+        --cache_rate "$3" \
+        --fix "$4" \
+        --test_name "$5" \
+        --load_level "$6" \
+        --compress_factor "$7" \
+        --repeat_factor "$8" \
+        --execute_parallel "$9" \
+        --results_directory "${10}" \
+        --clear_cache "${11}" \
+        --predictor_indices "${12}" &
+}

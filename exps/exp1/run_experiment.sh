@@ -1,0 +1,24 @@
+#!/bin/bash
+# exp1 — accuracy vs load, 3 apps (reference exps/exp1/run_experiment.sh):
+# hotel/node/media x loads {25..150}, predictors 3,4,7,10
+# (WAP5, FCFS, vPath, flagship) -> fig4a (accuracy vs load) and
+# fig4b (accuracy vs response-time percentile).
+set -u
+source "$(dirname "$0")/../common.sh"
+
+clear_cache="${1:-0}"
+suffix="test"
+results_directory="$(cd "$(dirname "$0")" && pwd)/results/"
+rm -rf "$results_directory" && mkdir -p "$results_directory"
+predictor_indices="3,4,7,10"
+
+for load in 25 50 75 100 125 150; do
+    run_executor "hotel_reservation/hotel_load$load/" 0 0 2 "hotel_$suffix" "$load" 1 1 0 "$results_directory" "$clear_cache" "$predictor_indices"
+    run_executor "nodejs_microservices/node_load$load/" 0 0 0 "node_$suffix" "$load" 1 1 0 "$results_directory" "$clear_cache" "$predictor_indices"
+    run_executor "media_microservices/media_load$load/" 0 0 1 "media_$suffix" "$load" 1 1 0 "$results_directory" "$clear_cache" "$predictor_indices"
+done
+wait
+echo "All tests have concluded."
+
+python3 "$REPO_ROOT/utils/plot_accuracy_vs_load_multiple_apps.py" "$results_directory" "$suffix" "$results_directory/fig4a.pdf"
+python3 "$REPO_ROOT/utils/plot_accuracy_vs_response_times_multiple_apps.py" "$results_directory" "$suffix" "$results_directory/fig4b.pdf"

@@ -1,0 +1,19 @@
+#!/bin/bash
+# exp2 — accuracy vs cache-hit rate (reference exps/exp2/run_experiment.sh):
+# hotel@load150, cache rate 0.0..0.70 step 0.05, predictors 3,4,10 -> fig4c.
+set -u
+source "$(dirname "$0")/../common.sh"
+
+clear_cache="${1:-0}"
+suffix="cache_rate"
+results_directory="$(cd "$(dirname "$0")" && pwd)/results/"
+rm -rf "$results_directory" && mkdir -p "$results_directory"
+predictor_indices="3,4,10"
+
+for rate in 0.0 0.05 0.1 0.15 0.2 0.25 0.3 0.35 0.4 0.45 0.5 0.55 0.6 0.65 0.7; do
+    run_executor "hotel_reservation/hotel_load150/" 0 "$rate" 2 "$suffix" 150 1 1 0 "$results_directory" "$clear_cache" "$predictor_indices"
+done
+wait
+echo "All tests have concluded."
+
+python3 "$REPO_ROOT/utils/plot_accuracy_vs_cache_hit_rate.py" "$results_directory" "$suffix" "$results_directory/fig4c.pdf"

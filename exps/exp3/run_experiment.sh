@@ -1,0 +1,20 @@
+#!/bin/bash
+# exp3 — accuracy vs interleaving intensity (reference
+# exps/exp3/run_experiment.sh): nodejs-with-arbitrary-file-IO variants
+# node_0 .. node_1, predictors 7,10 -> fig4d.
+set -u
+source "$(dirname "$0")/../common.sh"
+
+clear_cache="${1:-0}"
+suffix="interleaving"
+results_directory="$(cd "$(dirname "$0")" && pwd)/results/"
+rm -rf "$results_directory" && mkdir -p "$results_directory"
+predictor_indices="7,10"
+
+for level in 0 0.2 0.4 0.6 0.8 1; do
+    run_executor "nodejs_microservices_with_arbitrary_file_io/node_$level/" 0 0 0 "node_${level}_${suffix}" 50 1 1 0 "$results_directory" "$clear_cache" "$predictor_indices"
+done
+wait
+echo "All tests have concluded."
+
+python3 "$REPO_ROOT/utils/plot_accuracy_vs_interleaving_intensity.py" "$results_directory" "$suffix" "$results_directory/fig4d.pdf"

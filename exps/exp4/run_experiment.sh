@@ -1,0 +1,21 @@
+#!/bin/bash
+# exp4 — ablation ladder (reference exps/exp4/run_experiment.sh):
+# hotel+media x loads, predictors 2,8,9,10 (greedy V1-style, no-iterations,
+# parallel-scoring, full flagship) -> fig5.
+set -u
+source "$(dirname "$0")/../common.sh"
+
+clear_cache="${1:-0}"
+suffix="ablation"
+results_directory="$(cd "$(dirname "$0")" && pwd)/results/"
+rm -rf "$results_directory" && mkdir -p "$results_directory"
+predictor_indices="2,8,9,10"
+
+for load in 25 50 75 100 125 150; do
+    run_executor "hotel_reservation/hotel_load$load/" 0 0 2 "hotel_$suffix" "$load" 1 1 0 "$results_directory" "$clear_cache" "$predictor_indices"
+    run_executor "media_microservices/media_load$load/" 0 0 1 "media_$suffix" "$load" 1 1 0 "$results_directory" "$clear_cache" "$predictor_indices"
+done
+wait
+echo "All tests have concluded."
+
+python3 "$REPO_ROOT/utils/plot_accuracy_vs_load_ablation_study.py" "$results_directory" "$suffix" "$results_directory/fig5.pdf"

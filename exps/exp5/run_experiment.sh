@@ -1,0 +1,33 @@
+#!/bin/bash
+# exp5 — Alibaba scale sweep (reference exps/exp5/run_experiment.sh):
+# 15 call graphs x compress factor {1, 200, 1000, 4000, 10000, 15000},
+# fix=5 (Alibaba format), predictors 3,4,7,10 -> fig6a/fig6b.
+#
+# The reference release ships call_graph_data only as a git-LFS pointer
+# (BASELINE.md artifact gap); regenerate the inputs first with
+#   python -m traceweaver_tpu.alibaba.synthesize --out $TW_DATA/alibaba_microservices/call_graph_data
+# or run the full pipeline from clusterdata CSVs (traceweaver_tpu/alibaba/).
+set -u
+source "$(dirname "$0")/../common.sh"
+
+clear_cache="${1:-0}"
+suffix="load_multiple"
+results_directory="$(cd "$(dirname "$0")" && pwd)/results/"
+rm -rf "$results_directory" && mkdir -p "$results_directory"
+predictor_indices="3,4,7,10"
+
+if [ ! -d "$TW_DATA/alibaba_microservices/call_graph_data/call_graph_0" ]; then
+    echo "alibaba call_graph_data not found under $TW_DATA — see header" >&2
+    exit 1
+fi
+
+for compress in 1 200 1000 4000 10000 15000; do
+    for cg in 0 1 2 3 4 5 6 7 8 9 10 11 12 13 14; do
+        run_executor "alibaba_microservices/call_graph_data/call_graph_$cg" 0 0 5 "alibaba_cg_${cg}_$suffix" 1 "$compress" 1 0 "$results_directory" "$clear_cache" "$predictor_indices"
+    done
+    wait
+done
+echo "All tests have concluded."
+
+python3 "$REPO_ROOT/utils/plot_accuracy_vs_load_multiple_cgs.py" "$results_directory" "$suffix" "$results_directory/fig6a.pdf"
+python3 "$REPO_ROOT/utils/plot_accuracy_vs_confidence_multiple_cgs.py" "$results_directory" "$suffix" "$results_directory/fig6b.pdf"

@@ -1,0 +1,114 @@
+"""Alibaba pipeline tests: schema, repair, convert, group, synthesize."""
+
+import csv
+import os
+
+import pytest
+
+from traceweaver_tpu.alibaba import (
+    CallRecord,
+    call_graph_signature,
+    convert_trace_to_jaeger,
+    repair_trace,
+)
+from traceweaver_tpu.alibaba.preprocess import split_all
+from traceweaver_tpu.alibaba.synthesize import synthesize_corpus
+
+
+def _rec(tid, rpc_id, caller, callee, ts=1000, rt=10):
+    return CallRecord(tid, ts, rpc_id, caller, "rpc", callee, "if", rt)
+
+
+def test_repair_sorts_and_validates():
+    recs = [_rec("t", "0.1", "A", "B"), _rec("t", "0", "USER", "A"),
+            _rec("t", "0.1.1", "B", "C")]
+    fixed = repair_trace(recs)
+    assert [r.rpc_id for r in fixed] == ["0", "0.1", "0.1.1"]
+
+
+def test_repair_rejects_orphans_and_multiroots():
+    assert repair_trace([_rec("t", "0", "U", "A"),
+                         _rec("t", "0.2.1", "B", "C")]) is None
+    assert repair_trace([_rec("t", "0", "U", "A"),
+                         _rec("t", "1", "U", "B")]) is None
+
+
+def test_repair_dedupes_mirrored_rows():
+    good = _rec("t", "0.1", "A", "B", rt=10)
+    mirror = _rec("t", "0.1", "A", "B", rt=-10)
+    fixed = repair_trace([_rec("t", "0", "U", "A"), good, mirror])
+    assert len(fixed) == 2
+    assert fixed[1].rt_ms == 10
+
+
+def test_repair_fills_missing_caller_from_parent():
+    recs = [_rec("t", "0", "USER", "A"), _rec("t", "0.1", "(?)", "B")]
+    fixed = repair_trace(recs)
+    assert fixed[1].caller == "A"
+
+
+def test_convert_emits_server_client_pairs():
+    recs = repair_trace([_rec("t1", "0", "USER", "A"),
+                         _rec("t1", "0.1", "A", "B")])
+    doc = convert_trace_to_jaeger(recs)
+    spans = doc["data"][0]["spans"]
+    assert len(spans) == 3  # root server + child server/client pair
+    kinds = [(s["spanID"], s["tags"][0]["value"]) for s in spans]
+    assert ("0", "server") in kinds
+    assert ("0.1", "server") in kinds and ("0.1", "client") in kinds
+    client = next(s for s in spans if s["tags"][0]["value"] == "client")
+    assert client["processID"] == "A"  # lives on the caller
+    assert client["startTime"] == 1000 * 1000  # ms -> µs
+
+
+def test_signature_groups_same_topology():
+    a = [_rec("x", "0", "U", "A"), _rec("x", "0.1", "A", "B")]
+    b = [_rec("y", "0", "U", "A", ts=9999), _rec("y", "0.1", "A", "B", ts=9999)]
+    c = [_rec("z", "0", "U", "A"), _rec("z", "0.1", "A", "C")]
+    assert call_graph_signature(a) == call_graph_signature(b)
+    assert call_graph_signature(a) != call_graph_signature(c)
+
+
+def test_split_all(tmp_path):
+    rows = [["0", "t1", "100", "0", "U", "rpc", "A", "if", "5"],
+            ["1", "t2", "200", "0", "U", "rpc", "B", "if", "5"]]
+    csv_path = tmp_path / "MSCallGraph_0.csv"
+    with open(csv_path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["", "traceid", "timestamp", "rpcid", "um", "rpctype",
+                    "dm", "interface", "rt"])
+        w.writerows(rows)
+    n = split_all([str(csv_path)], str(tmp_path / "out"))
+    assert n == 2
+    assert (tmp_path / "out" / "shard0" / "t1.csv").exists()
+
+
+def test_synthesize_and_reconstruct(tmp_path):
+    from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
+    from traceweaver_tpu.ingest import (
+        build_service_problem,
+        infer_invocation_dag,
+        load_corpus,
+    )
+    from traceweaver_tpu.metrics import accuracy_for_service, get_ground_truth
+
+    dirs = synthesize_corpus(str(tmp_path), n_graphs=2, traces_per_graph=40,
+                             seed=7)
+    assert len(dirs) == 2
+    store = load_corpus(dirs[0], fix=5, max_traces=40, cache=False)
+    assert store.services()
+    solved = 0
+    for svc in store.out_spans_by_process:
+        prob = build_service_problem(store, svc)
+        if prob.skipped:
+            continue
+        ta = get_ground_truth(prob.in_span_partitions, prob.out_span_partitions)
+        dag = infer_invocation_dag(prob.in_span_partitions,
+                                   prob.out_span_partitions, ta, store)
+        algo = WeaverTPU(store.all_spans, store.all_processes)
+        out = algo.FindAssignments(
+            "MaxScoreBatchSubsetWithSkips", svc, prob.in_span_partitions,
+            prob.out_span_partitions, False, [], ta, dag)
+        assert accuracy_for_service(out[0], ta, prob.in_span_partitions) > 0.8
+        solved += 1
+    assert solved >= 1

@@ -1,0 +1,83 @@
+"""Executor + CLI integration tests."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+from tests.conftest import ref_data
+
+
+def test_run_experiment_writes_pickles(tmp_path, hotel_store):
+    from traceweaver_tpu.runtime.executor import ExecutorConfig, run_experiment
+
+    cfg = ExecutorConfig(
+        data_path="",  # store provided directly
+        results_directory=str(tmp_path) + "/",
+        fix=2,
+        cache_rate=0.0,
+        test_name="hotel",
+        load_level=25,
+        predictor_indices=[4, 7],  # FCFS, vPath
+        execute_parallel=False,
+    )
+    res = run_experiment(cfg, store=hotel_store)
+    assert set(res.accuracy_overall) == {"FCFS", "vPath"}
+    assert all(0 <= v <= 100 for v in res.accuracy_overall.values())
+    suffix = "_hotel_25_1_1_0.0.pickle"
+    for kind in ("bin_acc", "accuracy", "e2e", "confidence_scores",
+                 "process_acc"):
+        path = tmp_path / (kind + suffix)
+        assert path.exists(), f"missing {path}"
+    with open(tmp_path / ("accuracy" + suffix), "rb") as f:
+        accuracy = pickle.load(f)
+    assert accuracy == res.accuracy_overall
+
+
+def test_run_experiment_flagship_topk(tmp_path, hotel_store):
+    from traceweaver_tpu.runtime.executor import ExecutorConfig, run_experiment
+
+    cfg = ExecutorConfig(
+        data_path="",
+        results_directory=str(tmp_path) + "/",
+        fix=2,
+        cache_rate=0.0,
+        test_name="hotel",
+        predictor_indices=[10],
+        execute_parallel=True,
+    )
+    res = run_experiment(cfg, store=hotel_store)
+    assert "MaxScoreBatchSubsetWithSkips" in res.accuracy_overall
+    assert "MaxScoreBatchSubsetWithSkipsTopK" in res.accuracy_overall
+    assert res.accuracy_overall["MaxScoreBatchSubsetWithSkips"] >= 95.0
+    assert res.confidence_scores  # populated for the flagship method
+
+
+def test_cli_end_to_end(tmp_path):
+    data = ref_data("hotel_reservation/hotel_load25")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "executor.py"),
+         "--absolute_path", data, "--fix", "2", "--cache_rate", "0.0",
+         "--results_directory", str(tmp_path) + "/",
+         "--predictor_indices", "4", "--max_traces", "20",
+         "--test_name", "clitest"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "End-to-end accuracy for method FCFS" in out.stdout
+    assert (tmp_path / "accuracy_clitest_0_1_1_0.0.pickle").exists()
+
+
+def test_cli_requires_path(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "executor.py", "--fix", "2", "--cache_rate", "0.0",
+         "--results_directory", str(tmp_path)],
+        capture_output=True, text=True,
+        cwd=os.path.join(os.path.dirname(__file__), ".."), timeout=60,
+    )
+    assert out.returncode != 0
+    assert "relative_path" in out.stderr or "absolute_path" in out.stderr

@@ -1,0 +1,85 @@
+"""Plot-script contract tests: synthetic result pickles -> PDF figures."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import pytest
+
+UTILS = os.path.join(os.path.dirname(__file__), "..", "utils")
+
+ALL_METHODS = [
+    "MaxScoreBatchSubsetWithSkipsTopK", "MaxScoreBatchSubsetWithSkips",
+    "MaxScoreBatchParallel", "MaxScoreBatchParallelWithoutIterations",
+    "MaxScore", "WAP5", "vPath", "FCFS",
+]
+
+
+def _accuracy_pickle(path):
+    with open(path, "wb") as f:
+        pickle.dump({m: 90.0 for m in ALL_METHODS}, f)
+
+
+def _bins_pickle(path):
+    bins = {m: [((b + 1) * 10, 0.9, 5.0) for b in range(10)]
+            for m in ALL_METHODS}
+    with open(path, "wb") as f:
+        pickle.dump(bins, f)
+
+
+def _run(script, results_dir, suffix, outfile):
+    return subprocess.run(
+        [sys.executable, os.path.join(UTILS, script),
+         str(results_dir) + "/", suffix, str(outfile)],
+        capture_output=True, text=True, cwd=UTILS, timeout=120,
+    )
+
+
+def test_fig4a_and_fig5(tmp_path):
+    for app in ("hotel", "media", "node"):
+        for load in (25, 50, 75, 100, 125, 150):
+            _accuracy_pickle(tmp_path / f"accuracy_{app}_t_{load}_1_1_0.0.pickle")
+            _bins_pickle(tmp_path / f"bin_acc_{app}_t_{load}_1_1_0.0.pickle")
+    for script, fig in [
+        ("plot_accuracy_vs_load_multiple_apps.py", "fig4a.pdf"),
+        ("plot_accuracy_vs_response_times_multiple_apps.py", "fig4b.pdf"),
+        ("plot_accuracy_vs_load_ablation_study.py", "fig5.pdf"),
+    ]:
+        out = _run(script, tmp_path, "t", tmp_path / fig)
+        assert out.returncode == 0, out.stderr
+        assert (tmp_path / fig).stat().st_size > 0
+
+
+def test_fig4c(tmp_path):
+    for rate in (0.0, 0.05, 0.1, 0.15, 0.2, 0.25, 0.3, 0.35,
+                 0.4, 0.45, 0.5, 0.55, 0.6, 0.65, 0.7):
+        _accuracy_pickle(tmp_path / f"accuracy_t_150_1_1_{rate}.pickle")
+    out = _run("plot_accuracy_vs_cache_hit_rate.py", tmp_path, "t",
+               tmp_path / "fig4c.pdf")
+    assert out.returncode == 0, out.stderr
+
+
+def test_fig4d(tmp_path):
+    for rate in (0, 0.2, 0.4, 0.6, 0.8, 1):
+        _accuracy_pickle(tmp_path / f"accuracy_node_{rate}_t_50_1_1_0.0.pickle")
+    out = _run("plot_accuracy_vs_interleaving_intensity.py", tmp_path, "t",
+               tmp_path / "fig4d.pdf")
+    assert out.returncode == 0, out.stderr
+
+
+def test_fig6(tmp_path):
+    for cg in range(15):
+        for compress in (1, 200, 1000, 4000, 10000, 15000):
+            _accuracy_pickle(
+                tmp_path / f"accuracy_alibaba_cg_{cg}_t_1_{compress}_1_0.0.pickle"
+            )
+        with open(tmp_path / f"confidence_scores_alibaba_cg_{cg}_t_1_15000_1_0.0.pickle", "wb") as f:
+            pickle.dump({"svc": [0.9, 3, 100]}, f)
+    out = _run("plot_accuracy_vs_load_multiple_cgs.py", tmp_path, "t",
+               tmp_path / "fig6a.pdf")
+    assert out.returncode == 0, out.stderr
+    out = _run("plot_accuracy_vs_confidence_multiple_cgs.py", tmp_path, "t",
+               tmp_path / "fig6b.pdf")
+    assert out.returncode == 0, out.stderr
+    assert "Pearson" in out.stdout

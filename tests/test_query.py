@@ -1,0 +1,54 @@
+"""Query-engine tests over executor output."""
+
+import pickle
+
+import pytest
+
+from traceweaver_tpu.query import delay_culprit, extract_hop_latencies, filter_traces
+from traceweaver_tpu.spans import Span
+
+
+def _span(tid, sid, start, dur):
+    return Span(tid, sid, start, dur, "op", [], "p", "client")
+
+
+def _e2e_pickle(path):
+    true_traces, pred_traces = {}, {}
+    for i in range(20):
+        tid = f"t{i:02d}"
+        dur = 100 + i * 50  # monotone latency; hop 1 dominates
+        spans = [_span(tid, "a", 0, 10), _span(tid, "b", 20, dur)]
+        true_traces[tid] = spans
+        pred_traces[tid] = spans if i % 4 else [None, spans[1]]
+    with open(path, "wb") as f:
+        pickle.dump({"FCFS": [true_traces, pred_traces]}, f)
+
+
+def test_filter_traces_percentile():
+    traces = {
+        f"t{i}": [_span(f"t{i}", "a", i * 10, 100 + i)] for i in range(10)
+    }
+    top = filter_traces(traces, percentile=0.8)
+    assert len(top) == 2  # top 20%
+    assert all(t[1][0].duration_mus >= 108 for t in top)
+
+
+def test_extract_hops():
+    traces = [("t", [_span("t", "a", 0, 5), _span("t", "b", 10, 7)])]
+    hops = extract_hop_latencies(traces)
+    assert hops[0][0][3] == 5 and hops[1][0][3] == 7
+
+
+def test_delay_culprit_end_to_end(tmp_path):
+    path = tmp_path / "e2e_test.pickle"
+    _e2e_pickle(path)
+    out = tmp_path / "query.pickle"
+    results = delay_culprit(str(path), percentile=0.5, out_path=str(out))
+    r = results["FCFS"]
+    assert r["worst_true"][0] == 1  # hop 1 has the big duration
+    assert r["worst_pred"][0] == 1
+    assert r["n_pred"] <= r["n_true"]
+    assert out.exists()
+    with open(out, "rb") as f:
+        ql = pickle.load(f)
+    assert "FCFS" in ql and len(ql["FCFS"]) == 2

@@ -1,0 +1,131 @@
+"""Synthetic MSCallGraph generator — regenerates the exp5 input artifact.
+
+The reference release ships ``data/alibaba_microservices/call_graph_data``
+only as a 134-byte git-LFS pointer and the upstream clusterdata CSVs are
+external downloads (BASELINE.md artifact gaps), so exp5 cannot run from the
+repo alone. This generator produces MSCallGraph-format call records for a
+configurable number of service topologies — trees with Alibaba-like shape
+(fan-out 1-3, depth 2-4, occasional self-calls that exercise the ``-loop``
+remapping, executor.py:386-399) — and pushes them through the *real*
+repair → convert → group pipeline so the output exercises the same code
+paths real clusterdata would.
+
+Usage::
+
+    python -m traceweaver_tpu.alibaba.synthesize --out DIR \
+        [--n-graphs 15] [--traces-per-graph 1000] [--seed 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+from typing import Dict, List
+
+from traceweaver_tpu.alibaba.convert import repair_trace
+from traceweaver_tpu.alibaba.grouping import group_traces
+from traceweaver_tpu.alibaba.schema import CallRecord
+
+
+def _random_topology(rng: random.Random, n_services: int):
+    """A call tree as a list of (rpc_id, caller_idx, callee_idx)."""
+    depth = rng.randint(2, 4)
+    calls = []
+    root_svc = 0
+
+    def expand(rpc_id: str, svc: int, level: int) -> None:
+        if level >= depth:
+            return
+        fanout = rng.randint(1, 3) if level < depth - 1 else rng.randint(0, 2)
+        for i in range(fanout):
+            # occasional self-call (caller == callee) to exercise -loop logic
+            if rng.random() < 0.08:
+                child_svc = svc
+            else:
+                child_svc = rng.randrange(n_services)
+            child_id = f"{rpc_id}.{i + 1}"
+            calls.append((child_id, svc, child_svc))
+            expand(child_id, child_svc, level + 1)
+
+    calls.append(("0", -1, root_svc))
+    expand("0", root_svc, 0)
+    return calls
+
+
+def synthesize_corpus(
+    out_root: str,
+    n_graphs: int = 15,
+    traces_per_graph: int = 1000,
+    seed: int = 10,
+    base_gap_ms: int = 40,
+) -> List[str]:
+    """Generate, repair, convert, and group; returns the call_graph dirs."""
+    rng = random.Random(seed)
+    services = [f"MS_{i:05d}" for i in range(60)]
+    traces: Dict[str, List[CallRecord]] = {}
+
+    t_now = 1_600_000_000_000  # epoch ms
+    for g in range(n_graphs):
+        n_services = rng.randint(3, 12)
+        svc_ids = rng.sample(range(len(services)), n_services)
+        topology = _random_topology(rng, n_services)
+        # per-edge base latency in ms (int; the dataset is ms-resolution)
+        edge_delay = {
+            rpc_id: rng.randint(2, 25) for rpc_id, _, _ in topology
+        }
+        for t in range(traces_per_graph):
+            tid = f"cg{g}_{t:06d}_{rng.randrange(1 << 32):08x}"
+            t_now += rng.randint(base_gap_ms // 2, base_gap_ms * 2)
+            records: List[CallRecord] = []
+
+            def emit(rpc_id: str, caller: int, callee: int,
+                     start_ms: int) -> int:
+                """Returns the call's duration (ms)."""
+                kids = [c for c in topology if
+                        ".".join(c[0].split(".")[:-1]) == rpc_id]
+                cursor = start_ms + edge_delay[rpc_id] + rng.randint(0, 4)
+                child_total = 0
+                for (kid_id, kc, kd) in kids:
+                    dur = emit(kid_id, kc, kd, cursor)
+                    cursor += dur + rng.randint(1, 6)
+                    child_total = cursor - start_ms
+                own = rng.randint(2, 12)
+                total = max(edge_delay[rpc_id] + child_total + own, 1)
+                records.append(CallRecord(
+                    trace_id=tid,
+                    timestamp_ms=start_ms,
+                    rpc_id=rpc_id,
+                    caller=services[svc_ids[caller]] if caller >= 0 else "USER",
+                    rpc_type="rpc",
+                    callee=services[svc_ids[callee]],
+                    interface=f"if_{rpc_id}",
+                    rt_ms=total,
+                ))
+                return total
+
+            _, root_caller, root_callee = topology[0]
+            emit("0", root_caller, root_callee, t_now)
+            repaired = repair_trace(records)
+            if repaired is not None:
+                traces[tid] = repaired
+
+    return group_traces(traces, out_root, top_n=n_graphs, min_traces=2)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--out", required=True)
+    p.add_argument("--n-graphs", type=int, default=15)
+    p.add_argument("--traces-per-graph", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=10)
+    args = p.parse_args(argv)
+    dirs = synthesize_corpus(args.out, args.n_graphs, args.traces_per_graph,
+                             args.seed)
+    print(f"wrote {len(dirs)} call-graph datasets under {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,7 @@
+"""Query engine over reconstructed end-to-end traces."""
+
+from traceweaver_tpu.query.delay_culprit import (  # noqa: F401
+    delay_culprit,
+    extract_hop_latencies,
+    filter_traces,
+)

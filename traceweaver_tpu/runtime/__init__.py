@@ -1,0 +1,3 @@
+"""Executor runtime: library API + reference-compatible CLI."""
+
+from traceweaver_tpu.runtime.executor import ExecutorConfig, run_experiment  # noqa: F401

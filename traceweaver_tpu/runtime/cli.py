@@ -1,0 +1,119 @@
+"""Reference-compatible command-line interface.
+
+Accepts the same flags as the reference executor script
+(reference: src/trace_reconstructor/ports/python/executor.py:39-74) so the
+``exps/exp*`` experiment drivers can invoke this executor with unchanged
+argument lists::
+
+    python -m traceweaver_tpu.runtime.cli \
+        --relative_path data/hotel_reservation/hotel_load25 \
+        --fix 2 --cache_rate 0.0 --results_directory out/ \
+        --predictor_indices 4,7,10
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def get_project_root() -> str:
+    """Repo root (the reference resolves this by inspect-walking from
+    helpers/misc.py:7-9; here the package location is authoritative)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="Map incoming and outgoing spans at each service.")
+    p.add_argument("--relative_path", type=ascii, default=None,
+                   help="relative location for directory with Jaeger-style spans")
+    p.add_argument("--absolute_path", type=ascii, default=None,
+                   help="absolute location for directory with Jaeger-style spans")
+    p.add_argument("--compressed", type=int, default=0, choices=[0, 1],
+                   help="is directory compressed?")
+    p.add_argument("--load_level", type=int, default=0,
+                   help="provide load level if static test")
+    p.add_argument("--test_name", type=ascii, default="test",
+                   help="custom name for tracing test")
+    p.add_argument("--parallel", type=int, default=0, choices=[0, 1],
+                   help="treat sibling relationships as parallel?")
+    p.add_argument("--instrumented", type=int, default=0, choices=[0, 1],
+                   help="treat some hops as instrumented?")
+    p.add_argument("--cache_rate", type=float, required=True, default=0,
+                   help="rate of artificial caching to apply if needed")
+    p.add_argument("--fix", type=int, required=True, default=0,
+                   help="do spans require format fixing?")
+    p.add_argument("--repeat_factor", type=int, default=1,
+                   help="factor by which spans are duplicated")
+    p.add_argument("--compress_factor", type=float, default=1,
+                   help="factor by which to reduce spacing between spans")
+    p.add_argument("--execute_parallel", type=int, default=1,
+                   help="run each service's reconstruction in parallel?")
+    p.add_argument("--results_directory", type=ascii, required=True,
+                   help="directory to store results")
+    p.add_argument("--clear_cache", type=int, default=0,
+                   help="clear cache of processed, time-ordered file names")
+    p.add_argument("--predictor_indices", type=str, default="",
+                   help="comma-separated list of algorithm indices to run")
+    p.add_argument("--max_traces", type=int, default=1000,
+                   help="trace ingestion cap (reference hardcodes 1000)")
+    return p
+
+
+def main(argv=None) -> int:
+    from traceweaver_tpu.runtime.executor import (
+        ExecutorConfig,
+        load_replica_table,
+        run_experiment,
+    )
+
+    args = build_parser().parse_args(argv)
+    if args.relative_path is None and args.absolute_path is None:
+        print("At least one of --relative_path and --absolute_path is required",
+              file=sys.stderr)
+        return 2
+
+    root = get_project_root()
+    if args.absolute_path:
+        data_path = args.absolute_path.strip("'")
+    else:
+        rel = args.relative_path.strip("'")
+        data_path = rel if os.path.isdir(rel) else os.path.join(root, rel)
+
+    try:
+        indices = [int(x) for x in args.predictor_indices.split(",") if x != ""]
+    except ValueError as e:
+        print(f"Error converting predictor indices: {e}", file=sys.stderr)
+        return 1
+
+    replica_table = load_replica_table(
+        os.path.join(root, "data/misc/service_to_replica_new.pickle")
+    )
+
+    cfg = ExecutorConfig(
+        data_path=data_path,
+        results_directory=args.results_directory.strip("'"),
+        fix=args.fix,
+        cache_rate=args.cache_rate,
+        load_level=args.load_level,
+        test_name=args.test_name.strip("'"),
+        parallel=bool(args.parallel),
+        instrumented=bool(args.instrumented),
+        repeat_factor=args.repeat_factor,
+        compress_factor=args.compress_factor,
+        execute_parallel=bool(args.execute_parallel),
+        clear_cache=bool(args.clear_cache),
+        compressed=bool(args.compressed),
+        predictor_indices=indices,
+        max_traces=args.max_traces,
+        service_to_replica=replica_table,
+    )
+    run_experiment(cfg)  # prints per-method accuracy as it goes
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,294 @@
+"""The experiment executor.
+
+Library equivalent of the reference's 1244-line ``executor.py`` script
+(reference: src/trace_reconstructor/ports/python/executor.py): load a trace
+corpus, run the selected predictors over every solvable service (optionally
+with load compression and cache-hit injection), aggregate per-service and
+end-to-end accuracies, and persist the same five result-pickle families the
+reference's plot scripts and query engine consume
+(executor.py:1235-1244):
+
+``bin_acc_* accuracy_* e2e_* confidence_scores_* process_acc_*``
+each suffixed ``_{test}_{load}_{compress}_{repeat}_{cache}.pickle``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import math
+import os
+import pickle
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from traceweaver_tpu.algorithms import make_predictors
+from traceweaver_tpu.ingest import (
+    build_service_problem,
+    infer_invocation_dag,
+    load_corpus,
+)
+from traceweaver_tpu.metrics import (
+    accuracy_end_to_end,
+    accuracy_for_service,
+    bin_accuracy_by_response_times,
+    construct_end_to_end_traces,
+    get_ground_truth,
+    topk_accuracy_end_to_end,
+    topk_accuracy_for_service,
+)
+from traceweaver_tpu.spans import TraceStore
+from traceweaver_tpu.synth import compress_spans, create_cache_hits
+
+# method-name groups controlling dispatch, mirroring the reference
+SIX_TUPLE_METHODS = {
+    "MaxScoreBatchSubsetWithSkips",
+    "MaxScoreBatchSubsetWithTrueSkips",
+    "MaxScoreBatchSubsetWithTrueDist",
+    "MaxScoreBatchParallelWithoutIterations",
+}
+NEEDS_DAG_METHODS = SIX_TUPLE_METHODS | {"MaxScoreBatchParallel"}
+# cache-hit injection applies to every method except these
+# (reference executor.py:963)
+NO_CACHE_METHODS = {"MaxScoreBatch", "MaxScoreBatchParallel", "FCFS",
+                    "ArrivalOrder"}
+CONFIDENCE_METHODS = {"MaxScoreBatch", "MaxScoreBatchSubsetWithSkips"}
+
+
+@dataclass
+class ExecutorConfig:
+    """All reference CLI flags (executor.py:39-74) as one typed object."""
+
+    data_path: str
+    results_directory: str
+    fix: int
+    cache_rate: float = 0.0
+    load_level: int = 0
+    test_name: str = "test"
+    parallel: bool = False
+    instrumented: bool = False
+    repeat_factor: int = 1
+    compress_factor: float = 1.0
+    execute_parallel: bool = True
+    clear_cache: bool = False
+    compressed: bool = False
+    predictor_indices: List[int] = field(default_factory=list)
+    max_traces: int = 1000
+    # replica table for compress-factor scaling; absent in the reference
+    # release (SURVEY.md §6 artifact gap) so defaults to 1 replica per service
+    service_to_replica: Optional[Dict[str, list]] = None
+
+    def replica_count(self, process: str, store: TraceStore) -> int:
+        table = self.service_to_replica
+        if table is None:
+            return 1
+        if process in table:
+            return len(table[process])
+        if process.endswith("-loop") and process in store.service_loop_map:
+            origin = store.service_loop_map[process]
+            if origin in table:
+                return len(table[origin])
+        # services outside the table (e.g. Alibaba MS_*) scale as 1 replica,
+        # same as running with no table at all
+        return 1
+
+
+def load_replica_table(path: str) -> Optional[Dict[str, list]]:
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    return None
+
+
+def _solve_service(cfg: ExecutorConfig, store: TraceStore, method: str,
+                   predictor, process: str):
+    """Per-service pipeline (reference ``process_single_process``,
+    executor.py:915-999). Returns None when the service is skipped."""
+    prob = build_service_problem(store, process)
+    if prob.skipped:
+        return None
+
+    true_assignments = get_ground_truth(
+        prob.in_span_partitions, prob.out_span_partitions
+    )
+    invocation_graph = infer_invocation_dag(
+        prob.in_span_partitions, prob.out_span_partitions, true_assignments,
+        store,
+    )
+
+    if cfg.compress_factor > 1:
+        replicas = cfg.replica_count(process, store)
+        load_factor = max(1, math.ceil(cfg.compress_factor / replicas))
+        compress_spans(prob.in_span_partitions, prob.out_span_partitions,
+                       cfg.repeat_factor, load_factor)
+        true_assignments = get_ground_truth(
+            prob.in_span_partitions, prob.out_span_partitions
+        )
+
+    if process == "frontend" and method not in NO_CACHE_METHODS:
+        true_assignments = create_cache_hits(
+            true_assignments, prob.in_span_partitions,
+            prob.out_span_partitions, cache_rate=cfg.cache_rate,
+        )
+
+    parallel = cfg.parallel or method in (
+        "MaxScoreBatchParallel", "MaxScoreBatchParallelWithoutIterations"
+    )
+    instrumented_hops: List[int] = []
+
+    start = time.time()
+    args = [method, process, prob.in_span_partitions,
+            prob.out_span_partitions, parallel, instrumented_hops,
+            true_assignments]
+    kwargs = {}
+    if method in NEEDS_DAG_METHODS:
+        args.append(invocation_graph)
+    if method == "MaxScoreBatchSubsetWithTrueSkips":
+        kwargs = dict(true_skips=True)
+    elif method == "MaxScoreBatchSubsetWithTrueDist":
+        kwargs = dict(true_dist=True)
+    out = predictor.FindAssignments(*args, **kwargs)
+    elapsed = time.time() - start
+
+    pred_topk = not_best = num_spans = candidates = None
+    if isinstance(out, tuple) and len(out) == 6:
+        pred, pred_topk, not_best, num_spans, candidates, _unassigned = out
+    elif isinstance(out, tuple) and len(out) == 4:
+        pred, not_best, num_spans, candidates = out
+    else:
+        pred = out
+
+    acc = accuracy_for_service(pred, true_assignments, prob.in_span_partitions)
+    acc_topk = None
+    if pred_topk is not None:
+        acc_topk = topk_accuracy_for_service(
+            pred_topk, true_assignments, prob.in_span_partitions
+        )
+    return dict(process=process, true=true_assignments, pred=pred,
+                pred_topk=pred_topk, acc=acc, acc_topk=acc_topk,
+                not_best=not_best, num_spans=num_spans,
+                candidates=candidates, seconds=elapsed)
+
+
+@dataclass
+class ExperimentResults:
+    accuracy_overall: Dict[str, float]
+    accuracy_per_process: Dict[Tuple[str, str], float]
+    accuracy_percentile_bins: Dict[str, list]
+    traces_overall: Dict[str, list]
+    confidence_scores: Dict[str, list]
+    candidates_per_process: Dict[str, dict]
+    store: TraceStore
+
+
+def run_experiment(cfg: ExecutorConfig,
+                   store: Optional[TraceStore] = None) -> ExperimentResults:
+    random.seed(10)
+    if store is None:
+        store = load_corpus(cfg.data_path, cfg.fix, max_traces=cfg.max_traces,
+                            clear_cache=cfg.clear_cache)
+
+    predictors = make_predictors(store.all_spans, store.all_processes)
+    if cfg.predictor_indices:
+        bad = [i for i in cfg.predictor_indices
+               if not 0 <= i < len(predictors)]
+        if bad:
+            raise ValueError(
+                f"predictor indices out of range {bad}; valid: 0.."
+                f"{len(predictors) - 1}"
+            )
+        predictors = [predictors[i] for i in cfg.predictor_indices]
+
+    accuracy_overall: Dict[str, float] = {}
+    accuracy_per_process: Dict[Tuple[str, str], float] = {}
+    accuracy_percentile_bins: Dict[str, list] = {}
+    traces_overall: Dict[str, list] = {}
+    confidence_scores: Dict[str, list] = {}
+    candidates_per_process: Dict[str, dict] = {}
+
+    for method, predictor in predictors:
+        random.seed(10)
+        services = list(store.out_spans_by_process.keys())
+
+        results = []
+        if cfg.execute_parallel:
+            with concurrent.futures.ThreadPoolExecutor() as pool:
+                futures = [
+                    pool.submit(_solve_service, cfg, store, method, predictor, p)
+                    for p in services
+                ]
+                for fut in concurrent.futures.as_completed(futures):
+                    results.append(fut.result())
+        else:
+            for p in services:
+                results.append(_solve_service(cfg, store, method, predictor, p))
+        results = [r for r in results if r is not None]
+
+        true_by = {r["process"]: r["true"] for r in results}
+        pred_by = {r["process"]: r["pred"] for r in results}
+        topk_by = {r["process"]: r["pred_topk"] for r in results
+                   if r["pred_topk"] is not None}
+
+        for r in results:
+            accuracy_per_process[(method, r["process"])] = r["acc"]
+            if method in CONFIDENCE_METHODS and r["not_best"] is not None:
+                confidence_scores[r["process"]] = [
+                    r["acc"], r["not_best"], r["num_spans"]
+                ]
+            if r["candidates"] is not None:
+                candidates_per_process[r["process"]] = r["candidates"]
+
+        trace_acc, acc_e2e = accuracy_end_to_end(
+            pred_by, true_by, store.in_spans_by_process
+        )
+        accuracy_overall[method] = acc_e2e * 100
+        accuracy_percentile_bins[method] = bin_accuracy_by_response_times(
+            trace_acc, store.all_spans
+        )
+        if method == "MaxScoreBatchSubsetWithSkips" and len(topk_by) == len(pred_by):
+            trace_acc2, acc_e2e2 = topk_accuracy_end_to_end(
+                topk_by, true_by, store.in_spans_by_process
+            )
+            accuracy_overall[method + "TopK"] = acc_e2e2 * 100
+            accuracy_percentile_bins[method + "TopK"] = (
+                bin_accuracy_by_response_times(trace_acc2, store.all_spans)
+            )
+        true_e2e, pred_e2e = construct_end_to_end_traces(
+            pred_by, true_by, store.in_spans_by_process, store.all_spans
+        )
+        traces_overall[method] = [true_e2e, pred_e2e]
+        print("End-to-end accuracy for method %s: %.3f%%" % (method, acc_e2e * 100))
+
+    res = ExperimentResults(
+        accuracy_overall=accuracy_overall,
+        accuracy_per_process=accuracy_per_process,
+        accuracy_percentile_bins=accuracy_percentile_bins,
+        traces_overall=traces_overall,
+        confidence_scores=confidence_scores,
+        candidates_per_process=candidates_per_process,
+        store=store,
+    )
+    if cfg.results_directory:
+        write_result_pickles(cfg, res)
+    return res
+
+
+def write_result_pickles(cfg: ExecutorConfig, res: ExperimentResults) -> None:
+    """Same file naming as the reference (executor.py:1235-1244)."""
+    os.makedirs(cfg.results_directory or ".", exist_ok=True)
+    suffix = "_%s_%s_%s_%s_%s.pickle" % (
+        cfg.test_name, cfg.load_level, int(cfg.compress_factor),
+        int(cfg.repeat_factor), cfg.cache_rate,
+    )
+
+    def dump(kind: str, obj) -> None:
+        path = os.path.join(cfg.results_directory, kind + suffix)
+        with open(path, "wb") as f:
+            pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+    dump("bin_acc", res.accuracy_percentile_bins)
+    dump("accuracy", res.accuracy_overall)
+    dump("e2e", res.traces_overall)
+    dump("confidence_scores", res.confidence_scores)
+    dump("process_acc", res.accuracy_per_process)

@@ -1,0 +1,32 @@
+"""fig4c — accuracy vs injected cache-hit rate (hotel@load150).
+
+argv: results_dir test_name_suffix outfile (reference:
+utils/plot_accuracy_vs_cache_hit_rate.py tail).
+"""
+
+import pickle
+import sys
+
+from plotstyle import plot_lines
+
+results_directory, suffix, outfile = sys.argv[1], sys.argv[2], sys.argv[3]
+
+METHODS = ["MaxScoreBatchSubsetWithSkips", "WAP5", "FCFS"]
+LABELS = ["TraceWeaver", "WAP5", "FCFS"]
+RATES = [0.0, 0.05, 0.1, 0.15, 0.2, 0.25, 0.3, 0.35,
+         0.4, 0.45, 0.5, 0.55, 0.6, 0.65, 0.7]
+LOAD = 150
+
+xs, ys = [], []
+for method in METHODS:
+    x, y = [], []
+    for j, rate in enumerate(RATES):
+        path = (f"{results_directory}accuracy_{suffix}_{LOAD}_1_1_"
+                f"{rate}.pickle")
+        with open(path, "rb") as f:
+            y.append(pickle.load(f)[method])
+        x.append(j * 5)
+    xs.append(x)
+    ys.append(y)
+
+plot_lines(xs, ys, LABELS, "Cache %", "Accuracy %", outfile, ylim=(0, 100))

@@ -1,0 +1,40 @@
+"""fig6b — per-service accuracy vs confidence score scatter at the highest
+compress factor, with the Pearson correlation printed. argv: results_dir
+test_name_suffix outfile (reference:
+utils/plot_accuracy_vs_confidence_multiple_cgs.py tail). Confidence =
+1 − not_best/num_spans (reference executor.py:1038-1039).
+"""
+
+import pickle
+import sys
+
+from scipy.stats import pearsonr
+
+from plotstyle import plot_scatter
+
+results_directory, suffix, outfile = sys.argv[1], sys.argv[2], sys.argv[3]
+
+COMPRESS = 15000
+CALL_GRAPHS = list(range(15))
+
+combined = {}
+for cg in CALL_GRAPHS:
+    path = (f"{results_directory}confidence_scores_alibaba_cg_{cg}_{suffix}"
+            f"_1_{COMPRESS}_1_0.0.pickle")
+    try:
+        with open(path, "rb") as f:
+            scores = pickle.load(f)
+    except FileNotFoundError:
+        continue
+    for process, values in scores.items():
+        combined.setdefault(process, []).append(values)
+
+x, y = [], []
+for values in combined.values():
+    for acc, not_best, num_spans in values:
+        x.append(acc * 100)
+        y.append((1 - not_best / num_spans) * 100)
+
+plot_scatter(x, y, "Accuracy (%)", "Confidence Score", outfile)
+if len(x) >= 2:
+    print("Pearson coefficient:", pearsonr(x, y))

@@ -1,0 +1,31 @@
+"""fig4d — accuracy vs request-interleaving intensity (node app).
+
+argv: results_dir test_name_suffix outfile (reference:
+utils/plot_accuracy_vs_interleaving_intensity.py tail).
+"""
+
+import pickle
+import sys
+
+from plotstyle import plot_lines
+
+results_directory, suffix, outfile = sys.argv[1], sys.argv[2], sys.argv[3]
+
+METHODS = ["MaxScoreBatchSubsetWithSkips", "vPath"]
+LABELS = ["TraceWeaver", "vPath"]
+RATES = [0, 0.2, 0.4, 0.6, 0.8, 1]
+LOAD = 50
+
+xs, ys = [], []
+for method in METHODS:
+    y = []
+    for rate in RATES:
+        path = (f"{results_directory}accuracy_node_{rate}_{suffix}_{LOAD}"
+                "_1_1_0.0.pickle")
+        with open(path, "rb") as f:
+            y.append(pickle.load(f)[method])
+    xs.append(list(range(1, len(RATES) + 1)))
+    ys.append(y)
+
+plot_lines(xs, ys, LABELS, "Intensity Level of Request Interleaving",
+           "Accuracy %", outfile, ylim=(0, 100))

@@ -1,0 +1,41 @@
+"""fig5 — ablation ladder vs load (hotel+media).
+
+argv: results_dir test_name_suffix outfile (reference:
+utils/plot_accuracy_vs_load_ablation_study.py tail).
+"""
+
+import pickle
+import sys
+
+import numpy as np
+
+from plotstyle import plot_lines
+
+results_directory, suffix, outfile = sys.argv[1], sys.argv[2], sys.argv[3]
+
+METHODS = ["MaxScoreBatchSubsetWithSkipsTopK", "MaxScoreBatchSubsetWithSkips",
+           "MaxScoreBatchParallel", "MaxScoreBatchParallelWithoutIterations",
+           "MaxScore"]
+LABELS = ["1: TraceWeaver w/ TopK", "2: TraceWeaver",
+          "3: (2) w/o invocation order", "4: (3) w/o GMM iterations",
+          "5: (4) w/o joint optimization"]
+LOADS = [25, 50, 75, 100, 125, 150]
+APPS = ["hotel", "media"]
+
+xs, ys = [], []
+for method in METHODS:
+    x, y = [], []
+    for load in LOADS:
+        accs = []
+        for app in APPS:
+            path = (f"{results_directory}accuracy_{app}_{suffix}_{load}"
+                    "_1_1_0.0.pickle")
+            with open(path, "rb") as f:
+                accs.append(pickle.load(f)[method])
+        x.append(load * 100 / 150)
+        y.append(float(np.mean(accs)))
+    xs.append(x)
+    ys.append(y)
+
+plot_lines(xs, ys, LABELS, "System load %", "Accuracy % (avg. across apps)",
+           outfile, ylim=(0, 100), xlim=(10, 100))

@@ -1,0 +1,38 @@
+"""fig4a — end-to-end accuracy vs system load, averaged over 3 apps.
+
+argv: results_dir test_name_suffix outfile (reference:
+utils/plot_accuracy_vs_load_multiple_apps.py:75-96).
+"""
+
+import pickle
+import sys
+
+import numpy as np
+
+from plotstyle import plot_lines
+
+results_directory, suffix, outfile = sys.argv[1], sys.argv[2], sys.argv[3]
+
+METHODS = ["MaxScoreBatchSubsetWithSkipsTopK", "MaxScoreBatchSubsetWithSkips",
+           "WAP5", "vPath", "FCFS"]
+LABELS = ["TraceWeaver (Top K)", "TraceWeaver", "WAP5", "vPath", "FCFS"]
+LOADS = [25, 50, 75, 100, 125, 150]
+APPS = ["hotel", "media", "node"]
+
+xs, ys = [], []
+for method in METHODS:
+    x, y = [], []
+    for load in LOADS:
+        accs = []
+        for app in APPS:
+            path = (f"{results_directory}accuracy_{app}_{suffix}_{load}"
+                    "_1_1_0.0.pickle")
+            with open(path, "rb") as f:
+                accs.append(pickle.load(f)[method])
+        x.append(load * 100 / 150)
+        y.append(float(np.mean(accs)))
+    xs.append(x)
+    ys.append(y)
+
+plot_lines(xs, ys, LABELS, "System load %", "Accuracy % (avg. across apps)",
+           outfile, ylim=(0, 100), xlim=(10, 100))

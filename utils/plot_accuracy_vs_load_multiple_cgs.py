@@ -1,0 +1,36 @@
+"""fig6a — accuracy distribution over 15 Alibaba call graphs per compress
+factor (grouped boxplots). argv: results_dir test_name_suffix outfile
+(reference: utils/plot_accuracy_vs_load_multiple_cgs.py tail).
+"""
+
+import pickle
+import sys
+
+from plotstyle import plot_grouped_boxes
+
+results_directory, suffix, outfile = sys.argv[1], sys.argv[2], sys.argv[3]
+
+METHODS = ["MaxScoreBatchSubsetWithSkipsTopK", "MaxScoreBatchSubsetWithSkips",
+           "WAP5", "vPath", "FCFS"]
+LABELS = ["TraceWeaver (Top K)", "TraceWeaver", "WAP5", "vPath", "FCFS"]
+COMPRESS_LEVELS = [1, 200, 1000, 4000, 10000, 15000]
+CALL_GRAPHS = list(range(15))
+
+ys = []
+for method in METHODS:
+    series = []
+    for compress in COMPRESS_LEVELS:
+        samples = []
+        for cg in CALL_GRAPHS:
+            path = (f"{results_directory}accuracy_alibaba_cg_{cg}_{suffix}"
+                    f"_1_{compress}_1_0.0.pickle")
+            try:
+                with open(path, "rb") as f:
+                    samples.append(pickle.load(f)[method] / 100.0)
+            except FileNotFoundError:
+                continue
+        series.append(samples)
+    ys.append(series)
+
+plot_grouped_boxes(COMPRESS_LEVELS, ys, LABELS, "Compress factor",
+                   "Accuracy (over call graphs)", outfile)

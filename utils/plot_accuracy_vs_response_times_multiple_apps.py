@@ -1,0 +1,40 @@
+"""fig4b — accuracy per response-time percentile bin, averaged over apps
+and loads. argv: results_dir test_name_suffix outfile (reference:
+utils/plot_accuracy_vs_response_times_multiple_apps.py tail).
+"""
+
+import pickle
+import sys
+
+import numpy as np
+
+from plotstyle import plot_lines
+
+results_directory, suffix, outfile = sys.argv[1], sys.argv[2], sys.argv[3]
+
+METHODS = ["MaxScoreBatchSubsetWithSkipsTopK", "MaxScoreBatchSubsetWithSkips",
+           "WAP5", "vPath", "FCFS"]
+LABELS = ["TraceWeaver (Top K)", "TraceWeaver", "WAP5", "vPath", "FCFS"]
+LOADS = [25, 50, 75, 100, 125, 150]
+APPS = ["hotel", "media", "node"]
+
+per_method = {}
+for load in LOADS:
+    for app in APPS:
+        path = (f"{results_directory}bin_acc_{app}_{suffix}_{load}"
+                "_1_1_0.0.pickle")
+        with open(path, "rb") as f:
+            bins = pickle.load(f)
+        for method, acc in bins.items():
+            bucket = per_method.setdefault(method, {})
+            for percentile, a, _ms in acc:
+                bucket.setdefault(percentile, []).append(a * 100)
+
+xs, ys = [], []
+for method in METHODS:
+    percentiles = sorted(per_method[method])
+    xs.append(percentiles)
+    ys.append([float(np.mean(per_method[method][p])) for p in percentiles])
+
+plot_lines(xs, ys, LABELS, "Latency Percentile Bins",
+           "Accuracy % (avg. across apps)", outfile, ylim=(0, 100))

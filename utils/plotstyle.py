@@ -1,0 +1,95 @@
+"""Shared styling for the paper-figure plot scripts.
+
+Clean-room reimplementation of the styling the reference's 7 plot scripts
+share (reference utils/plot_*.py): consistent colors/markers, dotted grid,
+inward ticks, PDF-friendly fonttype. Every script keeps the reference argv
+contract: ``script.py results_dir test_name_suffix outfile``.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+warnings.filterwarnings("ignore")
+
+PCOLORS = ["#000080", "#008000", "#990000", "#a5669f", "#db850d", "#00112d"]
+MARKERS = ["s", "o", "x", "^", "v", "*", "p", "h"]
+LIGHT_GREY = (0.5, 0.5, 0.5)
+LABEL_FONTSIZE = 16
+
+matplotlib.rcParams["pdf.fonttype"] = 42
+matplotlib.rcParams["ps.fonttype"] = 42
+
+
+def _style_axes(ax):
+    ax.grid(linestyle=":", linewidth=1, color="grey")
+    ax.tick_params(axis="both", direction="in", labelsize=LABEL_FONTSIZE)
+    for side in ("top", "bottom", "left", "right"):
+        ax.spines[side].set_color(LIGHT_GREY)
+    ax.spines["top"].set_linestyle(":")
+    ax.spines["right"].set_linestyle(":")
+
+
+def plot_lines(xs, ys, labels, xlabel, ylabel, outfile,
+               ylim=(0, 100), xlim=None):
+    fig, ax = plt.subplots()
+    for i, (x, y, label) in enumerate(zip(xs, ys, labels)):
+        c = PCOLORS[i % len(PCOLORS)]
+        ax.plot(x, y, "-", color=c, lw=2.5, marker=MARKERS[i % len(MARKERS)],
+                mew=1.5, markersize=9, markeredgecolor=c, label=label,
+                zorder=10, clip_on=False)
+    ax.set_xlabel(xlabel, fontsize=LABEL_FONTSIZE)
+    ax.set_ylabel(ylabel, fontsize=LABEL_FONTSIZE)
+    if ylim:
+        ax.set_ylim(*ylim)
+    if xlim:
+        ax.set_xlim(*xlim)
+    _style_axes(ax)
+    leg = ax.legend(loc="best", fontsize=LABEL_FONTSIZE - 3)
+    leg.get_frame().set_linewidth(0.0)
+    plt.tight_layout()
+    plt.savefig(outfile)
+    plt.close(fig)
+
+
+def plot_grouped_boxes(ticks, ys, labels, xlabel, ylabel, outfile):
+    """One box group per tick; ys[i] is a list (per tick) of sample lists."""
+    fig, ax = plt.subplots()
+    n = len(ys)
+    group_width = n + 1.0
+    for i, (series, label) in enumerate(zip(ys, labels)):
+        c = PCOLORS[i % len(PCOLORS)]
+        offset = (n - 1) / 2.0 - i
+        positions = np.arange(len(series)) * group_width - offset * 0.8
+        bp = ax.boxplot(series, positions=positions, sym="", widths=0.6)
+        for part in ("boxes", "whiskers", "caps", "medians"):
+            plt.setp(bp[part], color=c)
+        ax.plot([], c=c, label=str(label))
+    ax.set_xticks(np.arange(len(ticks)) * group_width)
+    ax.set_xticklabels([str(t) for t in ticks])
+    ax.set_xlabel(xlabel, fontsize=LABEL_FONTSIZE)
+    ax.set_ylabel(ylabel, fontsize=LABEL_FONTSIZE)
+    ax.set_ylim(0, 1.05)
+    _style_axes(ax)
+    leg = ax.legend(loc="best", fontsize=LABEL_FONTSIZE - 3)
+    leg.get_frame().set_linewidth(0.0)
+    plt.tight_layout()
+    plt.savefig(outfile)
+    plt.close(fig)
+
+
+def plot_scatter(x, y, xlabel, ylabel, outfile):
+    fig, ax = plt.subplots()
+    ax.scatter(x, y, color=PCOLORS[0], s=28, zorder=10)
+    ax.set_xlabel(xlabel, fontsize=LABEL_FONTSIZE)
+    ax.set_ylabel(ylabel, fontsize=LABEL_FONTSIZE)
+    _style_axes(ax)
+    plt.tight_layout()
+    plt.savefig(outfile)
+    plt.close(fig)
