@@ -1,0 +1,148 @@
+"""Native (C++) layer: loader parity with the pure-Python parser, native
+scheme equivalence with the Python baselines."""
+
+import numpy as np
+import pytest
+
+from traceweaver_tpu import native
+from traceweaver_tpu.ingest import build_service_problem, load_corpus
+from traceweaver_tpu.ingest.jaeger import time_ordered_trace_files
+from traceweaver_tpu.spans import NA
+
+from tests.conftest import ref_data
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def _store_fingerprint(store):
+    spans = {
+        sid: (s.trace_id, s.sid, int(s.start_mus), int(s.duration_mus),
+              s.op_name, tuple(s.references), s.process_id, s.span_kind)
+        for sid, s in store.all_spans.items()
+    }
+    parts = {
+        svc: [s.GetId() for s in spans_list]
+        for svc, spans_list in store.in_spans_by_process.items()
+    }
+    out_parts = {
+        svc: [s.GetId() for s in spans_list]
+        for svc, spans_list in store.out_spans_by_process.items()
+    }
+    return spans, parts, out_parts, store.all_processes
+
+
+@pytest.mark.parametrize("relpath,fix", [
+    ("hotel_reservation/hotel_load25", 2),
+    ("media_microservices/media_load25", 1),
+    ("nodejs_microservices/node_load25", 0),
+])
+def test_native_corpus_matches_python(relpath, fix):
+    directory = ref_data(relpath)
+    # Seed-sensitive steps (media repair ids) run identically in both paths
+    # only if the RNG state matches at the start of each load.
+    import random
+
+    random.seed(10)
+    nat = load_corpus(directory, fix=fix, max_traces=30, cache=False,
+                      native="auto")
+    random.seed(10)
+    pure = load_corpus(directory, fix=fix, max_traces=30, cache=False,
+                       native="never")
+    assert _store_fingerprint(nat) == _store_fingerprint(pure)
+
+
+def test_native_root_start_time_matches_python():
+    import json
+    import os
+
+    directory = ref_data("hotel_reservation/hotel_load25")
+    files = sorted(f for f in os.listdir(directory) if f.endswith("json"))[:5]
+    for f in files:
+        path = os.path.join(directory, f)
+        native_t = native.root_start_time(path)
+        with open(path) as fh:
+            data = json.load(fh)["data"]
+        root = next(s for s in data[0]["spans"] if not s.get("references"))
+        assert native_t == float(root["startTime"])
+
+
+def test_time_ordering_native_and_python_agree(monkeypatch):
+    directory = ref_data("hotel_reservation/hotel_load25")
+    files_native = time_ordered_trace_files(directory, cache=False)
+    monkeypatch.setenv("TW_DISABLE_NATIVE", "1")
+    files_python = time_ordered_trace_files(directory, cache=False)
+    assert files_native == files_python
+
+
+def _problem_arrays(prob):
+    in_ep, in_spans = next(iter(prob.in_span_partitions.items()))
+    eps = list(prob.out_span_partitions)
+    trace_ids = {}
+
+    def tid(trace):
+        return trace_ids.setdefault(trace, len(trace_ids))
+
+    in_start = [float(s.start_mus) for s in in_spans]
+    in_end = [float(s.end_mus) for s in in_spans]
+    in_trace = [tid(s.trace_id) for s in in_spans]
+    out_start, out_end, out_ep_idx, out_trace, out_ids = [], [], [], [], []
+    for e, ep in enumerate(eps):
+        for s in prob.out_span_partitions[ep]:
+            out_start.append(float(s.start_mus))
+            out_end.append(float(s.end_mus))
+            out_ep_idx.append(e)
+            out_trace.append(tid(s.trace_id))
+            out_ids.append(s.GetId())
+    return (eps, in_spans, out_ids,
+            (in_start, in_end, in_trace, out_start, out_end, out_ep_idx,
+             out_trace))
+
+
+@pytest.mark.parametrize("scheme,cls_name", [
+    ("vpath", "VPath"),
+    ("vpath_old", "VPathOld"),
+    ("fcfs", "FCFS"),
+])
+def test_native_scheme_matches_python(hotel_store, scheme, cls_name):
+    import traceweaver_tpu.algorithms as algos
+    from traceweaver_tpu.metrics import get_ground_truth
+
+    cls = getattr(algos, cls_name)
+    for svc in ["frontend", "search"]:
+        prob = build_service_problem(hotel_store, svc)
+        if prob.skipped:
+            continue
+        ta = get_ground_truth(prob.in_span_partitions,
+                              prob.out_span_partitions)
+        py = cls(hotel_store.all_spans, hotel_store.all_processes)
+        expected = py.FindAssignments(
+            cls_name, svc,
+            {k: list(v) for k, v in prob.in_span_partitions.items()},
+            {k: list(v) for k, v in prob.out_span_partitions.items()},
+            False, [], ta,
+        )
+
+        eps, in_spans, out_ids, arrays = _problem_arrays(prob)
+        assign = native.run_scheme(scheme, *arrays[:3], *arrays[3:],
+                                   n_eps=len(eps))
+        assert assign is not None
+        got = {
+            ep: {
+                in_spans[i].GetId():
+                    (out_ids[assign[e, i]] if assign[e, i] >= 0 else NA)
+                for i in range(len(in_spans))
+            }
+            for e, ep in enumerate(eps)
+        }
+        for ep in eps:
+            exp_ep = {k: v for k, v in expected[ep].items()}
+            assert got[ep] == exp_ep, f"{scheme} mismatch on {svc}/{ep}"
+
+
+def test_parse_files_error_reporting(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert native.parse_files([str(bad)]) is None
+    assert "bad.json" in native.last_error()

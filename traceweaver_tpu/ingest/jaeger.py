@@ -13,6 +13,13 @@ Replicates the ingestion semantics of the reference executor
   validation (violating traces dropped);
 - time-ordered directory listing with an on-disk cache;
 - corpus assembly into a :class:`~traceweaver_tpu.spans.TraceStore`.
+
+Two parsing front-ends feed one shared semantic core
+(:func:`_records_to_spans`): the pure-Python ``json`` path, and the native
+C++ streaming loader (``traceweaver_tpu.native``), which parses files in
+parallel off the GIL and hands back interned struct-of-arrays data. The
+repair shims and every RNG-dependent step stay in Python so both paths are
+bit-identical.
 """
 
 from __future__ import annotations
@@ -23,10 +30,11 @@ import pickle
 import random
 import string
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from traceweaver_tpu.spans import Span, SpanId, TraceStore
 from traceweaver_tpu.ingest import repair
+from traceweaver_tpu import native as native_mod
 
 # FIX mode -> required root-span operation name. ``None`` (Alibaba) means
 # "ingest every trace" (reference executor.py:756-762).
@@ -50,6 +58,9 @@ def _random_id(n: int = 16, suffix: str = "") -> str:
 # ---------------------------------------------------------------------------
 
 def _root_start_time(path: str) -> float:
+    native_t = native_mod.root_start_time(path)
+    if native_t is not None:
+        return native_t
     try:
         with open(path, "r") as f:
             data = json.load(f).get("data", [])
@@ -112,82 +123,91 @@ def time_ordered_trace_files(directory: str, clear_cache: bool = False,
 # Span-level parsing (reference executor.py:342-488)
 # ---------------------------------------------------------------------------
 
-def _parse_spans_json(
-    spans_json: List[dict],
+class RawSpan(NamedTuple):
+    """One span record, front-end neutral (built from a JSON dict or from
+    the native loader's arrays)."""
+
+    trace_id: str
+    sid: str
+    start_mus: float
+    duration_mus: float
+    op_name: Optional[str]
+    ref: Optional[SpanId]       # first CHILD_OF reference
+    process_id: str
+    span_kind: Optional[str]    # "client" | "server" | None
+    caller: Optional[str]       # Alibaba converter fields
+    callee: Optional[str]
+    tags: object = None
+
+
+def _records_to_spans(
+    records: List[RawSpan],
     self_loop_map: Dict[str, List[str]],
     service_loop_map: Dict[str, str],
     alibaba: bool,
-) -> Optional[Dict[SpanId, Span]]:
-    """Build Span objects from one trace's raw span records.
+) -> Optional[Tuple[Dict[SpanId, Span], List[str]]]:
+    """Build Span objects from one trace's records. Returns
+    ``(spans, final_process_ids)`` — the per-record process ids after
+    Alibaba self-loop remapping (they seed the identity process table) —
+    or None if the trace is dropped.
 
-    In Alibaba mode (``alibaba=True``): client span ids get a ``.client``
-    suffix and server spans are re-parented onto the suffixed client id
-    (executor.py:377-384); self-calls (caller==callee) are remapped onto a
-    synthetic ``<random>-loop`` service shared across traces via
-    ``self_loop_map`` (executor.py:386-399); parent⊇child time containment is
-    validated from the root and the whole trace is dropped (returns None) on
-    violation (executor.py:433-448).
+    In Alibaba mode: client span ids get a ``.client`` suffix and server
+    spans are re-parented onto the suffixed client id (executor.py:377-384);
+    self-calls (caller==callee) are remapped onto a synthetic
+    ``<random>-loop`` service shared across traces via ``self_loop_map``
+    (executor.py:386-399); parent⊇child time containment is validated from
+    the root and the whole trace is dropped on violation
+    (executor.py:433-448).
     """
     spans: Dict[SpanId, Span] = {}
+    final_pids: List[str] = []
     overall_trace_id = None
 
-    for rec in spans_json:
-        span_kind = None
-        for tag in rec.get("tags", []):
-            if tag.get("key") == "span.kind":
-                span_kind = tag.get("value")
-
-        process_id = rec["processID"]
-        trace_id = rec["traceID"]
-        sid = rec["spanID"]
-        start_mus = rec["startTime"]
-        duration_mus = rec["duration"]
-        op_name = rec.get("requestType", rec.get("operationName"))
+    for rec in records:
+        trace_id = rec.trace_id
+        sid = rec.sid
+        process_id = rec.process_id
+        references: List[SpanId] = [rec.ref] if rec.ref is not None else []
 
         if overall_trace_id is None:
             overall_trace_id = trace_id
         elif trace_id != overall_trace_id:
             raise ValueError("Different trace ids for spans in the same trace")
 
-        references: List[SpanId] = [
-            (ref["traceID"], ref["spanID"]) for ref in rec.get("references", [])
-        ]
-
         if alibaba:
-            if span_kind == "client":
+            if rec.span_kind == "client":
                 sid = sid + ".client"
-            if span_kind == "server" and len(references) == 1:
+            if rec.span_kind == "server" and len(references) == 1:
                 # The Alibaba converter emits a server+client record pair per
                 # call sharing one spanID: the server half's parent is its own
                 # id's client half (executor.py:382-384).
                 references[0] = (references[0][0], sid + ".client")
             # Self-loop calls: remap the callee (and the server span's
             # process) onto a stable synthetic "-loop" service.
-            if rec.get("caller") == rec.get("callee"):
+            if rec.caller is not None and rec.caller == rec.callee:
                 sanitized = sid[:-7] if sid.endswith(".client") else sid
                 if sanitized not in self_loop_map:
                     new_callee = _random_id(suffix="-loop")
-                    self_loop_map[sanitized] = [rec["callee"], new_callee]
-                    service_loop_map[new_callee] = rec["callee"]
-                rec["callee"] = self_loop_map[sanitized][1]
-                if span_kind == "server":
+                    self_loop_map[sanitized] = [rec.callee, new_callee]
+                    service_loop_map[new_callee] = rec.callee
+                if rec.span_kind == "server":
                     process_id = self_loop_map[sanitized][1]
-                    rec["processID"] = process_id
 
+        final_pids.append(process_id)
         spans[(trace_id, sid)] = Span(
             trace_id=trace_id,
             sid=sid,
-            start_mus=start_mus,
-            duration_mus=duration_mus,
-            op_name=op_name,
+            start_mus=rec.start_mus,
+            duration_mus=rec.duration_mus,
+            op_name=rec.op_name,
             references=references,
             process_id=process_id,
-            span_kind=span_kind,
-            tags=rec.get("tags"),
+            span_kind=rec.span_kind,
+            tags=rec.tags,
         )
 
     if not alibaba:
-        return spans
+        return spans, final_pids
 
     # Alibaba mode: link children temporarily, validate containment, and
     # propagate self-loop process ids down to descendant client spans.
@@ -233,18 +253,65 @@ def _parse_spans_json(
 
     for span in spans.values():
         span.children_spans = []
-    return spans
+    return spans, final_pids
 
 
-def _parse_processes(trace_json: dict, alibaba_spans: bool) -> Dict[str, str]:
-    if alibaba_spans:
-        # Alibaba conversion carries no process table: process ids double as
-        # service names (executor.py:484-488).
-        return {rec["processID"]: rec["processID"] for rec in trace_json["spans"]}
-    return {
-        pid: entry["serviceName"]
-        for pid, entry in trace_json.get("processes", {}).items()
-    }
+def _record_from_json(rec: dict) -> RawSpan:
+    span_kind = None
+    for tag in rec.get("tags", []):
+        if tag.get("key") == "span.kind":
+            span_kind = tag.get("value")
+    refs = rec.get("references", [])
+    ref = (refs[0]["traceID"], refs[0]["spanID"]) if refs else None
+    return RawSpan(
+        trace_id=rec["traceID"],
+        sid=rec["spanID"],
+        start_mus=rec["startTime"],
+        duration_mus=rec["duration"],
+        op_name=rec.get("requestType", rec.get("operationName")),
+        ref=ref,
+        process_id=rec["processID"],
+        span_kind=span_kind,
+        caller=rec.get("caller"),
+        callee=rec.get("callee"),
+        tags=rec.get("tags"),
+    )
+
+
+def _assemble_trace(
+    records: List[RawSpan],
+    fix: int,
+    self_loop_map: Dict[str, List[str]],
+    service_loop_map: Dict[str, str],
+    raw_processes: Dict[str, str],
+) -> Optional[Tuple[Dict[SpanId, Span], Dict[str, str], bool]]:
+    """Shared post-parse pipeline for one trace, used by both front-ends:
+    record→Span conversion, process-table construction, fix-mode repair,
+    root detection. ``raw_processes`` is the file's pid→service table
+    (ignored for Alibaba-format traces, whose process ids double as service
+    names post self-loop remap, executor.py:484-488). Returns
+    ``(spans, processes, has_root)`` or None when the trace is dropped.
+    """
+    alibaba = FIX_ROOT_OPS[fix] is None
+    parsed = _records_to_spans(records, self_loop_map, service_loop_map,
+                               alibaba)
+    if parsed is None:
+        return None
+    spans, final_pids = parsed
+    # The Alibaba converter emits caller/callee/requestType together
+    # (reference real-parser.py:308-359), so caller presence detects the
+    # converted format.
+    alibaba_format = bool(records) and records[0].caller is not None
+    if alibaba_format:
+        processes = {pid: pid for pid in final_pids}
+    else:
+        processes = raw_processes
+    if fix == 0:
+        spans = repair.fix_nodejs(spans, processes)
+    elif fix == 1:
+        spans, processes = repair.fix_media(spans, processes)
+    has_root = any(s.IsRoot() for s in spans.values())
+    return spans, processes, has_root
 
 
 # ---------------------------------------------------------------------------
@@ -260,9 +327,6 @@ def parse_trace_file(
     """Parse one trace file. Returns (trace_id, spans, processes) or None
     if the trace was dropped (time-containment violation in Alibaba mode).
     """
-    first_span = FIX_ROOT_OPS[fix]
-    alibaba = first_span is None
-
     with open(path, "r") as f:
         payload = json.load(f)
 
@@ -270,18 +334,16 @@ def parse_trace_file(
     processes: Dict[str, str] = {}
     for trace_json in payload["data"]:
         trace_id = trace_json["traceID"]
-        spans = _parse_spans_json(
-            trace_json["spans"], self_loop_map, service_loop_map, alibaba
-        )
-        if spans is None:
+        records = [_record_from_json(rec) for rec in trace_json["spans"]]
+        raw_processes = {
+            pid: entry["serviceName"]
+            for pid, entry in trace_json.get("processes", {}).items()
+        }
+        assembled = _assemble_trace(records, fix, self_loop_map,
+                                    service_loop_map, raw_processes)
+        if assembled is None:
             return None
-        alibaba_format = "requestType" in trace_json["spans"][0]
-        processes = _parse_processes(trace_json, alibaba_format)
-        if fix == 0:
-            spans = repair.fix_nodejs(spans, processes)
-        elif fix == 1:
-            spans, processes = repair.fix_media(spans, processes)
-        has_root = any(s.IsRoot() for s in spans.values())
+        spans, processes, has_root = assembled
         if has_root:
             results.append((trace_id, spans))
 
@@ -338,6 +400,86 @@ def ingest_trace(
     return 1
 
 
+_KIND_NAMES = {0: None, 1: "client", 2: "server"}
+
+# Files parsed per native batch: bounds peak DOM/corpus memory while keeping
+# the parse thread pool saturated.
+_NATIVE_CHUNK = 512
+
+
+def _native_file_traces(
+    nc: "native_mod.NativeCorpus",
+    fix: int,
+    self_loop_map: Dict[str, List[str]],
+    service_loop_map: Dict[str, str],
+):
+    """Yield ``(trace_id, spans, processes)`` per input file of a native
+    corpus — same semantics as :func:`parse_trace_file` (including the
+    drop-on-containment-violation behavior, yielding None for such files).
+    """
+    strings = nc.strings
+    procs_by_trace = nc.processes_by_trace()
+
+    # Trace indices grouped by file, preserving file order (traces arrive
+    # file-ordered from the native loader).
+    per_file: List[List[int]] = [[] for _ in range(nc.n_files)]
+    for t in range(nc.n_traces):
+        per_file[int(nc.trace_file[t])].append(t)
+
+    for file_idx in range(nc.n_files):
+        results = []
+        processes: Dict[str, str] = {}
+        dropped = False
+        for t in per_file[file_idx]:
+            lo = int(nc.trace_offsets[t])
+            hi = int(nc.trace_offsets[t + 1])
+            trace_id = strings[nc.trace_id[t]]
+            records = []
+            for i in range(lo, hi):
+                psid = int(nc.parent_sid[i])
+                ref = (
+                    (strings[nc.parent_trace[i]], strings[psid])
+                    if psid >= 0 else None
+                )
+                op = int(nc.op[i])
+                pidx = int(nc.process[i])
+                if pidx < 0:
+                    # Match the Python front-end, which raises KeyError on a
+                    # span without a processID.
+                    raise KeyError(
+                        f"span {strings[nc.sid[i]]!r} has no processID"
+                    )
+                caller = int(nc.caller[i])
+                callee = int(nc.callee[i])
+                records.append(RawSpan(
+                    trace_id=strings[nc.trace[i]],
+                    sid=strings[nc.sid[i]],
+                    start_mus=int(nc.start[i]),
+                    duration_mus=int(nc.duration[i]),
+                    op_name=strings[op] if op >= 0 else None,
+                    ref=ref,
+                    process_id=strings[pidx],
+                    span_kind=_KIND_NAMES[int(nc.kind[i])],
+                    caller=strings[caller] if caller >= 0 else None,
+                    callee=strings[callee] if callee >= 0 else None,
+                ))
+            assembled = _assemble_trace(records, fix, self_loop_map,
+                                        service_loop_map,
+                                        procs_by_trace.get(t, {}))
+            if assembled is None:
+                dropped = True
+                break
+            spans, processes, has_root = assembled
+            if has_root:
+                results.append((trace_id, spans))
+        if dropped:
+            yield None
+            continue
+        assert len(results) == 1, "expected exactly one rooted trace per file"
+        trace_id, spans = results[0]
+        yield trace_id, spans, processes
+
+
 def load_corpus(
     directory: str,
     fix: int,
@@ -345,18 +487,46 @@ def load_corpus(
     clear_cache: bool = False,
     cache: bool = True,
     write_cache: bool = False,
+    native: str = "auto",
 ) -> TraceStore:
     """Load a directory of Jaeger-JSON traces into a TraceStore.
 
     ``max_traces`` mirrors the reference's hard cap (executor.py:873:
     ``if cnt > 1000: break`` — i.e. up to max_traces+1 ingested).
+
+    ``native``: "auto" uses the C++ streaming loader when available,
+    "never" forces the pure-Python parser. Both produce identical stores.
     """
     store = TraceStore()
     self_loop_map: Dict[str, List[str]] = {}
+    files = time_ordered_trace_files(directory, clear_cache=clear_cache,
+                                     cache=cache, write_cache=write_cache)
     cnt = 0
-    for path in time_ordered_trace_files(directory, clear_cache=clear_cache,
-                                         cache=cache, write_cache=write_cache):
-        parsed = parse_trace_file(path, fix, self_loop_map, store.service_loop_map)
+    use_native = native != "never" and native_mod.available()
+    if use_native:
+        for chunk_start in range(0, len(files), _NATIVE_CHUNK):
+            chunk = files[chunk_start:chunk_start + _NATIVE_CHUNK]
+            nc = native_mod.parse_files(chunk)
+            if nc is None:
+                use_native = False  # fall through to Python for the rest
+                files = files[chunk_start:]
+                break
+            for parsed in _native_file_traces(
+                nc, fix, self_loop_map, store.service_loop_map
+            ):
+                if parsed is None:
+                    continue
+                trace_id, spans, processes = parsed
+                cnt += ingest_trace(store, trace_id, spans, processes, fix)
+                if cnt > max_traces:
+                    nc.close()
+                    return store
+            nc.close()
+        else:
+            return store
+    for path in files:
+        parsed = parse_trace_file(path, fix, self_loop_map,
+                                  store.service_loop_map)
         if parsed is None:
             continue
         trace_id, spans, processes = parsed

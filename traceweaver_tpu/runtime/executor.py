@@ -135,6 +135,8 @@ def _solve_service(cfg: ExecutorConfig, store: TraceStore, method: str,
     parallel = cfg.parallel or method in (
         "MaxScoreBatchParallel", "MaxScoreBatchParallelWithoutIterations"
     )
+    # Always empty, matching the reference: --instrumented is parsed there
+    # too but instrumented_hops is hardcoded [] (executor.py:954, 1135).
     instrumented_hops: List[int] = []
 
     start = time.time()
@@ -200,6 +202,20 @@ def run_experiment(cfg: ExecutorConfig,
             )
         predictors = [predictors[i] for i in cfg.predictor_indices]
 
+    # Result keys must be unique even though the registry legitimately holds
+    # the same method name twice (index 1 = WeaverExact, index 9 = WeaverTPU,
+    # both "MaxScoreBatchParallel"); the solver still sees the real name.
+    seen: Dict[str, int] = {}
+    keyed_predictors = []
+    for method, predictor in predictors:
+        if method in seen:
+            seen[method] += 1
+            keyed_predictors.append((f"{method}#{seen[method]}", method,
+                                     predictor))
+        else:
+            seen[method] = 0
+            keyed_predictors.append((method, method, predictor))
+
     accuracy_overall: Dict[str, float] = {}
     accuracy_per_process: Dict[Tuple[str, str], float] = {}
     accuracy_percentile_bins: Dict[str, list] = {}
@@ -207,7 +223,7 @@ def run_experiment(cfg: ExecutorConfig,
     confidence_scores: Dict[str, list] = {}
     candidates_per_process: Dict[str, dict] = {}
 
-    for method, predictor in predictors:
+    for result_key, method, predictor in keyed_predictors:
         random.seed(10)
         services = list(store.out_spans_by_process.keys())
 
@@ -231,7 +247,7 @@ def run_experiment(cfg: ExecutorConfig,
                    if r["pred_topk"] is not None}
 
         for r in results:
-            accuracy_per_process[(method, r["process"])] = r["acc"]
+            accuracy_per_process[(result_key, r["process"])] = r["acc"]
             if method in CONFIDENCE_METHODS and r["not_best"] is not None:
                 confidence_scores[r["process"]] = [
                     r["acc"], r["not_best"], r["num_spans"]
@@ -242,23 +258,24 @@ def run_experiment(cfg: ExecutorConfig,
         trace_acc, acc_e2e = accuracy_end_to_end(
             pred_by, true_by, store.in_spans_by_process
         )
-        accuracy_overall[method] = acc_e2e * 100
-        accuracy_percentile_bins[method] = bin_accuracy_by_response_times(
+        accuracy_overall[result_key] = acc_e2e * 100
+        accuracy_percentile_bins[result_key] = bin_accuracy_by_response_times(
             trace_acc, store.all_spans
         )
         if method == "MaxScoreBatchSubsetWithSkips" and len(topk_by) == len(pred_by):
             trace_acc2, acc_e2e2 = topk_accuracy_end_to_end(
                 topk_by, true_by, store.in_spans_by_process
             )
-            accuracy_overall[method + "TopK"] = acc_e2e2 * 100
-            accuracy_percentile_bins[method + "TopK"] = (
+            accuracy_overall[result_key + "TopK"] = acc_e2e2 * 100
+            accuracy_percentile_bins[result_key + "TopK"] = (
                 bin_accuracy_by_response_times(trace_acc2, store.all_spans)
             )
         true_e2e, pred_e2e = construct_end_to_end_traces(
             pred_by, true_by, store.in_spans_by_process, store.all_spans
         )
-        traces_overall[method] = [true_e2e, pred_e2e]
-        print("End-to-end accuracy for method %s: %.3f%%" % (method, acc_e2e * 100))
+        traces_overall[result_key] = [true_e2e, pred_e2e]
+        print("End-to-end accuracy for method %s: %.3f%%"
+              % (result_key, acc_e2e * 100))
 
     res = ExperimentResults(
         accuracy_overall=accuracy_overall,
