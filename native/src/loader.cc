@@ -8,9 +8,9 @@
 // trace.h:4-7, main.cpp:6-21} — all bodies `//!TODO` there). Field
 // extraction mirrors the reference Python parser
 // (reference: src/trace_reconstructor/ports/python/executor.py:342-488):
-//   - span.kind from the tags array;
+//   - span.kind from the tags array (verbatim value, last tag wins);
 //   - operationName with Alibaba's requestType taking precedence;
-//   - first CHILD_OF reference as the parent edge;
+//   - the full references list (parent edges);
 //   - caller/callee (Alibaba converter fields) when present;
 //   - the top-level processes table (pid -> serviceName).
 // Dataset repair and Alibaba client/server rewrites stay in Python so that
@@ -38,9 +38,12 @@ struct Corpus {
   // Span SoA (parallel arrays).
   std::vector<double> start_mus, duration_mus;
   std::vector<int32_t> trace_sidx, sid_sidx, op_sidx, process_sidx;
-  std::vector<int32_t> kind;  // 0 = absent, 1 = client, 2 = server
-  std::vector<int32_t> parent_trace_sidx, parent_sid_sidx;  // -1 = root
-  std::vector<int32_t> caller_sidx, callee_sidx;            // -1 = absent
+  std::vector<int32_t> kind_sidx;  // verbatim span.kind tag value, -1 absent
+  std::vector<int32_t> caller_sidx, callee_sidx;  // -1 = absent
+  // References, flattened (a span may carry several): refs of span i are
+  // [ref_offsets[i], ref_offsets[i+1]) in ref_trace/ref_sid.
+  std::vector<int64_t> ref_offsets{0};
+  std::vector<int32_t> ref_trace_sidx, ref_sid_sidx;
 
   // Trace boundaries: spans of trace t are [offsets[t], offsets[t+1]).
   std::vector<int64_t> trace_offsets{0};
@@ -82,20 +85,21 @@ bool read_file(const char* path, std::string* out) {
   return got == static_cast<size_t>(n);
 }
 
-int32_t span_kind_of(const Json& span) {
+// Verbatim span.kind tag value, last occurrence winning — matching the
+// Python front-end's tag loop exactly. Returns nullptr when absent.
+const std::string* span_kind_of(const Json& span) {
   const Json* tags = span.find("tags");
-  if (!tags || !tags->is_arr()) return 0;
+  if (!tags || !tags->is_arr()) return nullptr;
+  const std::string* kind = nullptr;
   for (const Json& tag : tags->arr) {
     const std::string* key = tag.find_str("key");
     if (key && *key == "span.kind") {
       const std::string* value = tag.find_str("value");
-      if (!value) return 0;
-      if (*value == "client") return 1;
-      if (*value == "server") return 2;
-      return 0;
+      kind = value;  // may be nullptr for a non-string value, like Python's
+                     // tag.get("value") -> None
     }
   }
-  return 0;
+  return kind;
 }
 
 // Extract one trace object ({traceID, spans, processes}) into the corpus.
@@ -127,19 +131,23 @@ bool extract_trace(const Json& trace, int file_idx, Corpus* c) {
 
     const std::string* pid = s.find_str("processID");
 
-    int32_t parent_trace = -1, parent_sid = -1;
+    // Every reference, in order (Python keeps the full list; parity).
     const Json* refs = s.find("references");
-    if (refs && refs->is_arr() && !refs->arr.empty()) {
-      const std::string* ref_trace = refs->arr[0].find_str("traceID");
-      const std::string* ref_sid = refs->arr[0].find_str("spanID");
-      if (ref_trace && ref_sid) {
-        parent_trace = c->intern(*ref_trace);
-        parent_sid = c->intern(*ref_sid);
+    if (refs && refs->is_arr()) {
+      for (const Json& ref : refs->arr) {
+        const std::string* ref_trace = ref.find_str("traceID");
+        const std::string* ref_sid = ref.find_str("spanID");
+        if (ref_trace && ref_sid) {
+          c->ref_trace_sidx.push_back(c->intern(*ref_trace));
+          c->ref_sid_sidx.push_back(c->intern(*ref_sid));
+        }
       }
     }
+    c->ref_offsets.push_back(static_cast<int64_t>(c->ref_trace_sidx.size()));
 
     const std::string* caller = s.find_str("caller");
     const std::string* callee = s.find_str("callee");
+    const std::string* kind = span_kind_of(s);
 
     c->start_mus.push_back(start);
     c->duration_mus.push_back(dur);
@@ -147,9 +155,7 @@ bool extract_trace(const Json& trace, int file_idx, Corpus* c) {
     c->sid_sidx.push_back(c->intern(*sid));
     c->op_sidx.push_back(op ? c->intern(*op) : -1);
     c->process_sidx.push_back(pid ? c->intern(*pid) : -1);
-    c->kind.push_back(span_kind_of(s));
-    c->parent_trace_sidx.push_back(parent_trace);
-    c->parent_sid_sidx.push_back(parent_sid);
+    c->kind_sidx.push_back(kind ? c->intern(*kind) : -1);
     c->caller_sidx.push_back(caller ? c->intern(*caller) : -1);
     c->callee_sidx.push_back(callee ? c->intern(*callee) : -1);
   }
@@ -259,12 +265,20 @@ const int32_t* tw_span_op(const tw::Corpus* c) { return c->op_sidx.data(); }
 const int32_t* tw_span_process(const tw::Corpus* c) {
   return c->process_sidx.data();
 }
-const int32_t* tw_span_kind(const tw::Corpus* c) { return c->kind.data(); }
-const int32_t* tw_span_parent_trace(const tw::Corpus* c) {
-  return c->parent_trace_sidx.data();
+const int32_t* tw_span_kind(const tw::Corpus* c) {
+  return c->kind_sidx.data();
 }
-const int32_t* tw_span_parent_sid(const tw::Corpus* c) {
-  return c->parent_sid_sidx.data();
+long tw_num_refs(const tw::Corpus* c) {
+  return static_cast<long>(c->ref_trace_sidx.size());
+}
+const int64_t* tw_span_ref_offsets(const tw::Corpus* c) {
+  return c->ref_offsets.data();
+}
+const int32_t* tw_ref_trace(const tw::Corpus* c) {
+  return c->ref_trace_sidx.data();
+}
+const int32_t* tw_ref_sid(const tw::Corpus* c) {
+  return c->ref_sid_sidx.data();
 }
 const int32_t* tw_span_caller(const tw::Corpus* c) {
   return c->caller_sidx.data();

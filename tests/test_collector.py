@@ -1,0 +1,284 @@
+"""Span-collector pipeline: HPACK codec, HTTP/2 replay, strace reassembly,
+thread attribution — end-to-end on a synthetic capture."""
+
+import pytest
+
+from traceweaver_tpu.collector import (
+    CollectorReport,
+    Decoder,
+    Encoder,
+    collect_from_strace_log,
+    looks_like_http2,
+    parse_strace_log,
+    replay_connection,
+    unescape_strace,
+)
+from traceweaver_tpu.collector.hpack import (
+    HpackError,
+    decode_integer,
+    encode_integer,
+    huffman_decode,
+    huffman_encode,
+)
+from traceweaver_tpu.collector.http2 import (
+    FLAG_END_HEADERS,
+    FLAG_END_STREAM,
+    HEADERS,
+    PREFACE,
+    SETTINGS,
+)
+from traceweaver_tpu.collector.ebpf import (
+    BPF_PROGRAM,
+    DataEvent,
+    looks_like_http,
+    parse_event,
+)
+
+
+# ---------------------------------------------------------------------------
+# HPACK
+# ---------------------------------------------------------------------------
+
+def test_integer_coding_rfc_examples():
+    # RFC 7541 C.1: 10 in 5-bit prefix; 1337 in 5-bit prefix; 42 in 8-bit
+    assert encode_integer(10, 5) == bytes([0x0A])
+    assert encode_integer(1337, 5) == bytes([0x1F, 0x9A, 0x0A])
+    assert encode_integer(42, 8) == bytes([0x2A])
+    for value, prefix in [(0, 1), (10, 5), (1337, 5), (2 ** 30, 7)]:
+        data = encode_integer(value, prefix)
+        got, pos = decode_integer(data, 0, prefix)
+        assert (got, pos) == (value, len(data))
+
+
+def test_rfc7541_c31_and_c41_request_vectors():
+    expected = [(":method", "GET"), (":scheme", "http"), (":path", "/"),
+                (":authority", "www.example.com")]
+    raw = bytes.fromhex("828684410f7777772e6578616d706c652e636f6d")
+    assert Decoder().decode(raw) == expected
+    huffman = bytes.fromhex("828684418cf1e3c2e5f23a6ba0ab90f4ff")
+    assert Decoder().decode(huffman) == expected
+
+
+def test_huffman_roundtrip_and_padding():
+    for payload in [b"", b"a", b"www.example.com", bytes(range(256))]:
+        assert huffman_decode(huffman_encode(payload)) == payload
+    # 'a' = 00011 (5 bits); trailing 000 padding is not an EOS prefix
+    with pytest.raises(HpackError):
+        huffman_decode(b"\x18")
+
+
+def test_hpack_roundtrip_with_dynamic_table():
+    headers = [
+        (":method", "POST"),
+        (":path", "/rate.Rate/GetRates"),
+        ("uber-trace-id", "abc123:def:0:1"),
+        ("x-custom", "hello world"),
+        (":method", "POST"),           # now indexable
+        ("x-custom", "hello world"),   # dynamic-table hit
+    ]
+    for huffman in (False, True):
+        enc = Encoder(huffman=huffman)
+        blob = enc.encode(headers)
+        assert Decoder().decode(blob) == headers
+        if not huffman:
+            # repeated fields must compress to 1-byte indexed forms
+            assert len(enc.encode(headers)) < len(blob)
+
+
+# ---------------------------------------------------------------------------
+# HTTP/2 framing helpers
+# ---------------------------------------------------------------------------
+
+def _frame(ftype: int, flags: int, stream_id: int, payload: bytes) -> bytes:
+    return (len(payload).to_bytes(3, "big") + bytes([ftype, flags])
+            + stream_id.to_bytes(4, "big") + payload)
+
+
+def _client_request_bytes(encoder: Encoder, stream_id: int, path: str,
+                          trace_id: str) -> bytes:
+    block = encoder.encode([
+        (":method", "POST"), (":scheme", "http"), (":path", path),
+        (":authority", "svc"), ("uber-trace-id", f"{trace_id}:1:0:1"),
+        ("content-type", "application/grpc"),
+    ])
+    return (_frame(HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM, stream_id,
+                   block))
+
+
+def _server_response_bytes(encoder: Encoder, stream_id: int) -> bytes:
+    block = encoder.encode([(":status", "200"),
+                            ("content-type", "application/grpc")])
+    return _frame(HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM, stream_id,
+                  block)
+
+
+def test_replay_connection_recovers_requests_and_responses():
+    enc_c = Encoder()
+    enc_s = Encoder()
+    inbound = (PREFACE + _frame(SETTINGS, 0, 0, b"")
+               + _client_request_bytes(enc_c, 1, "/a", "t1")
+               + _client_request_bytes(enc_c, 3, "/b", "t2"))
+    outbound = (_frame(SETTINGS, 0, 0, b"")
+                + _server_response_bytes(enc_s, 1)
+                + _server_response_bytes(enc_s, 3))
+    assert looks_like_http2(inbound, outbound)
+    in_events, out_events = replay_connection(inbound, outbound)
+    reqs = [e for e in in_events if e.kind == "request"]
+    resps = [e for e in out_events if e.kind == "response"]
+    assert [e.stream_id for e in reqs] == [1, 3]
+    assert [e.stream_id for e in resps] == [1, 3]
+    assert dict(reqs[0].headers)[":path"] == "/a"
+    assert dict(reqs[1].headers)["uber-trace-id"].startswith("t2:")
+
+
+def test_replay_tolerates_truncated_tail():
+    enc = Encoder()
+    stream = PREFACE + _client_request_bytes(enc, 1, "/a", "t1")
+    truncated = stream + b"\x00\x00\xff\x01\x04"  # partial frame header+
+    in_events, _ = replay_connection(truncated, b"")
+    assert [e.kind for e in in_events if e.kind == "request"] == ["request"]
+
+
+# ---------------------------------------------------------------------------
+# strace reassembly
+# ---------------------------------------------------------------------------
+
+def _strace_escape(data: bytes) -> str:
+    out = []
+    for i, b in enumerate(data):
+        if b == 0x22:
+            out.append('\\"')
+        elif b == 0x5C:
+            out.append("\\\\")
+        elif 0x20 <= b < 0x7F:
+            out.append(chr(b))
+        else:
+            # strace pads octal to 3 digits when the next character is a
+            # digit, so "\0" + literal '0' can't re-parse as "\00"
+            nxt = data[i + 1] if i + 1 < len(data) else None
+            if nxt is not None and 0x30 <= nxt <= 0x37:
+                out.append("\\%03o" % b)
+            else:
+                out.append("\\%o" % b)
+    return "".join(out)
+
+
+def test_unescape_strace_octal_and_hex():
+    assert unescape_strace("\\0\\1\\377abc") == b"\x00\x01\xffabc"
+    assert unescape_strace("\\x00\\x41\\xff") == b"\x00A\xff"
+    assert unescape_strace('\\"quoted\\"\\n') == b'"quoted"\n'
+    payload = bytes(range(256))
+    assert unescape_strace(_strace_escape(payload)) == payload
+
+
+def _strace_lines_for(pid: int, op: str, fd: int, data: bytes, split_at=None):
+    """Render one syscall as log lines, optionally as unfinished/resumed."""
+    esc = _strace_escape(data)
+    if split_at is None:
+        return [f'{pid} {op}({fd}, "{esc}", {len(data)}) = {len(data)}']
+    if op == "read":
+        return [
+            f"{pid} read({fd},  <unfinished ...>",
+            f'{pid} <... read resumed>"{esc}", {len(data)}) = {len(data)}',
+        ]
+    return [
+        f'{pid} write({fd}, "{esc}", {len(data)} <unfinished ...>',
+        f"{pid} <... write resumed> ) = {len(data)}",
+    ]
+
+
+def test_strace_reassembly_with_unfinished_and_fd_reuse():
+    payload1 = b"hello-first-generation"
+    payload2 = b"second-generation"
+    lines = []
+    lines += _strace_lines_for(11, "read", 7, payload1[:10])
+    lines += _strace_lines_for(12, "read", 7, payload1[10:], split_at=1)
+    lines += ["11 close(7) = 0"]
+    lines += _strace_lines_for(13, "read", 7, payload2)
+    streams = parse_strace_log("\n".join(lines))
+    assert set(streams) == {(7, 0), (7, 1)}
+    assert streams[(7, 0)].inbound == payload1
+    assert streams[(7, 1)].inbound == payload2
+    assert streams[(7, 0)].pid_at("in", 0) == 11
+    assert streams[(7, 0)].pid_at("in", 15) == 12
+    assert streams[(7, 1)].pid_at("in", 0) == 13
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: synthetic capture -> causal pairs -> thread predictability
+# ---------------------------------------------------------------------------
+
+def _synthetic_capture() -> str:
+    """A server process: two incoming requests handled by threads 101/102 on
+    fd 7; each handler issues one downstream request on fd 9 carrying the
+    same trace id (thread 201 for both)."""
+    enc_in = Encoder()
+    enc_down = Encoder(huffman=True)
+    enc_resp = Encoder()
+
+    in_stream = (PREFACE + _frame(SETTINGS, 0, 0, b"")
+                 + _client_request_bytes(enc_in, 1, "/hotels", "trace-A"))
+    in_stream2 = _client_request_bytes(enc_in, 3, "/hotels", "trace-B")
+    down = (PREFACE + _frame(SETTINGS, 0, 0, b"")
+            + _client_request_bytes(enc_down, 1, "/rates", "trace-A"))
+    down2 = _client_request_bytes(enc_down, 3, "/rates", "trace-B")
+    resp = (_frame(SETTINGS, 0, 0, b"")
+            + _server_response_bytes(enc_resp, 1)
+            + _server_response_bytes(enc_resp, 3))
+
+    lines = []
+    # thread 101 reads request A (split across an unfinished/resumed pair)
+    lines += _strace_lines_for(101, "read", 7, in_stream[:40], split_at=1)
+    lines += _strace_lines_for(101, "read", 7, in_stream[40:])
+    # thread 201 writes downstream request A
+    lines += _strace_lines_for(201, "write", 9, down, split_at=1)
+    # thread 102 reads request B; 201 writes downstream B
+    lines += _strace_lines_for(102, "read", 7, in_stream2)
+    lines += _strace_lines_for(201, "write", 9, down2)
+    # responses flow back
+    lines += _strace_lines_for(101, "write", 7, resp)
+    return "\n".join(lines)
+
+
+def test_collector_end_to_end():
+    report = collect_from_strace_log(_synthetic_capture())
+    assert isinstance(report, CollectorReport)
+    assert set(report.events_by_stream) == {(7, 0), (9, 0)}
+
+    incoming = [r for r in report.requests if r.direction == "in"]
+    outgoing = [r for r in report.requests if r.direction == "out"]
+    assert {r.key for r in incoming} == {"trace-A", "trace-B"}
+    assert {r.key for r in outgoing} == {"trace-A", "trace-B"}
+    assert {r.pid for r in incoming} == {101, 102}
+    assert {r.pid for r in outgoing} == {201}
+
+    assert len(report.causal_pairs) == 2
+    for parent, child in report.causal_pairs:
+        assert parent.key == child.key
+        assert parent.fd == 7 and child.fd == 9
+    # downstream thread is constant -> perfectly predictable
+    assert report.thread_predictability == 1.0
+
+
+# ---------------------------------------------------------------------------
+# eBPF module (gated: program text + event mirror only)
+# ---------------------------------------------------------------------------
+
+def test_ebpf_program_text_and_event_mirror():
+    assert "BPF_PERF_OUTPUT(events)" in BPF_PROGRAM
+    assert "kretprobe__ksys_read" in BPF_PROGRAM
+    import ctypes
+
+    ev = DataEvent(pid=42, fd=7, op=1, len=3)
+    raw = ctypes.string_at(ctypes.addressof(ev), ctypes.sizeof(ev))
+    parsed = parse_event(raw)
+    assert (parsed.pid, parsed.fd, parsed.op, parsed.len) == (42, 7, 1, 3)
+    # truncated submit (header only) still parses
+    parsed2 = parse_event(raw[: ctypes.sizeof(DataEvent) - 4096])
+    assert parsed2.pid == 42
+
+
+def test_http_heuristic():
+    assert looks_like_http(b"GET /index HTTP/1.1\r\n")
+    assert looks_like_http(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+    assert not looks_like_http(b"\x16\x03\x01")  # TLS hello

@@ -132,7 +132,7 @@ class RawSpan(NamedTuple):
     start_mus: float
     duration_mus: float
     op_name: Optional[str]
-    ref: Optional[SpanId]       # first CHILD_OF reference
+    refs: Tuple[SpanId, ...]    # full references list, in order
     process_id: str
     span_kind: Optional[str]    # "client" | "server" | None
     caller: Optional[str]       # Alibaba converter fields
@@ -167,7 +167,7 @@ def _records_to_spans(
         trace_id = rec.trace_id
         sid = rec.sid
         process_id = rec.process_id
-        references: List[SpanId] = [rec.ref] if rec.ref is not None else []
+        references: List[SpanId] = list(rec.refs)
 
         if overall_trace_id is None:
             overall_trace_id = trace_id
@@ -261,15 +261,16 @@ def _record_from_json(rec: dict) -> RawSpan:
     for tag in rec.get("tags", []):
         if tag.get("key") == "span.kind":
             span_kind = tag.get("value")
-    refs = rec.get("references", [])
-    ref = (refs[0]["traceID"], refs[0]["spanID"]) if refs else None
+    refs = tuple(
+        (ref["traceID"], ref["spanID"]) for ref in rec.get("references", [])
+    )
     return RawSpan(
         trace_id=rec["traceID"],
         sid=rec["spanID"],
         start_mus=rec["startTime"],
         duration_mus=rec["duration"],
         op_name=rec.get("requestType", rec.get("operationName")),
-        ref=ref,
+        refs=refs,
         process_id=rec["processID"],
         span_kind=span_kind,
         caller=rec.get("caller"),
@@ -400,8 +401,6 @@ def ingest_trace(
     return 1
 
 
-_KIND_NAMES = {0: None, 1: "client", 2: "server"}
-
 # Files parsed per native batch: bounds peak DOM/corpus memory while keeping
 # the parse thread pool saturated.
 _NATIVE_CHUNK = 512
@@ -436,11 +435,6 @@ def _native_file_traces(
             trace_id = strings[nc.trace_id[t]]
             records = []
             for i in range(lo, hi):
-                psid = int(nc.parent_sid[i])
-                ref = (
-                    (strings[nc.parent_trace[i]], strings[psid])
-                    if psid >= 0 else None
-                )
                 op = int(nc.op[i])
                 pidx = int(nc.process[i])
                 if pidx < 0:
@@ -449,6 +443,7 @@ def _native_file_traces(
                     raise KeyError(
                         f"span {strings[nc.sid[i]]!r} has no processID"
                     )
+                kind = int(nc.kind[i])
                 caller = int(nc.caller[i])
                 callee = int(nc.callee[i])
                 records.append(RawSpan(
@@ -457,9 +452,9 @@ def _native_file_traces(
                     start_mus=int(nc.start[i]),
                     duration_mus=int(nc.duration[i]),
                     op_name=strings[op] if op >= 0 else None,
-                    ref=ref,
+                    refs=tuple(nc.span_refs(i)),
                     process_id=strings[pidx],
-                    span_kind=_KIND_NAMES[int(nc.kind[i])],
+                    span_kind=strings[kind] if kind >= 0 else None,
                     caller=strings[caller] if caller >= 0 else None,
                     callee=strings[callee] if callee >= 0 else None,
                 ))
@@ -504,13 +499,19 @@ def load_corpus(
     cnt = 0
     use_native = native != "never" and native_mod.available()
     if use_native:
-        for chunk_start in range(0, len(files), _NATIVE_CHUNK):
-            chunk = files[chunk_start:chunk_start + _NATIVE_CHUNK]
+        chunk_start = 0
+        while chunk_start < len(files):
+            # Each file holds one ingestible trace, so don't parse far past
+            # the remaining trace budget (+ slack for dropped/filtered files).
+            budget = max_traces + 1 - cnt
+            size = min(_NATIVE_CHUNK, max(budget + 8, 16))
+            chunk = files[chunk_start:chunk_start + size]
             nc = native_mod.parse_files(chunk)
             if nc is None:
                 use_native = False  # fall through to Python for the rest
                 files = files[chunk_start:]
                 break
+            chunk_start += size
             for parsed in _native_file_traces(
                 nc, fix, self_loop_map, store.service_loop_map
             ):
@@ -519,9 +520,7 @@ def load_corpus(
                 trace_id, spans, processes = parsed
                 cnt += ingest_trace(store, trace_id, spans, processes, fix)
                 if cnt > max_traces:
-                    nc.close()
                     return store
-            nc.close()
         else:
             return store
     for path in files:

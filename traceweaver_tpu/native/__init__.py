@@ -86,15 +86,19 @@ def _configure(lib: ctypes.CDLL) -> None:
         fn.restype = _c_double_p
         fn.argtypes = [ctypes.c_void_p]
     for name in ("tw_span_trace", "tw_span_sid", "tw_span_op",
-                 "tw_span_process", "tw_span_kind", "tw_span_parent_trace",
-                 "tw_span_parent_sid", "tw_span_caller", "tw_span_callee",
+                 "tw_span_process", "tw_span_kind", "tw_ref_trace",
+                 "tw_ref_sid", "tw_span_caller", "tw_span_callee",
                  "tw_trace_id", "tw_trace_file", "tw_process_trace",
                  "tw_process_pid", "tw_process_service"):
         fn = getattr(lib, name)
         fn.restype = _c_int32_p
         fn.argtypes = [ctypes.c_void_p]
-    lib.tw_trace_span_offsets.restype = _c_int64_p
-    lib.tw_trace_span_offsets.argtypes = [ctypes.c_void_p]
+    lib.tw_num_refs.restype = ctypes.c_long
+    lib.tw_num_refs.argtypes = [ctypes.c_void_p]
+    for name in ("tw_trace_span_offsets", "tw_span_ref_offsets"):
+        fn = getattr(lib, name)
+        fn.restype = _c_int64_p
+        fn.argtypes = [ctypes.c_void_p]
     lib.tw_root_start_time.restype = ctypes.c_double
     lib.tw_root_start_time.argtypes = [ctypes.c_char_p]
     scheme_args = [
@@ -109,8 +113,12 @@ def _configure(lib: ctypes.CDLL) -> None:
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
-    """The loaded native library, building it first if needed; None if the
-    build or load fails (callers then use the pure-Python path)."""
+    """The loaded native library, building it first if needed. Returns None
+    when ``TW_DISABLE_NATIVE`` is set or the build/load fails (callers then
+    use the pure-Python path). The env guard lives here — every entry point
+    below routes through this accessor."""
+    if os.environ.get("TW_DISABLE_NATIVE"):
+        return None
     global _lib, _lib_failed
     with _lock:
         if _lib is not None:
@@ -131,33 +139,43 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 
 def available() -> bool:
-    if os.environ.get("TW_DISABLE_NATIVE"):
-        return False
     return get_lib() is not None
 
 
-class NativeCorpus:
-    """Owning wrapper over a parsed corpus with zero-copy numpy views.
+def _decode(raw: bytes) -> str:
+    # Python's json keeps lone surrogates from \uD800-style escapes; the
+    # C++ loader encodes them as 3-byte sequences that surrogatepass maps
+    # back to the same characters, keeping both front-ends identical.
+    try:
+        return raw.decode("utf-8")
+    except UnicodeDecodeError:
+        try:
+            return raw.decode("utf-8", "surrogatepass")
+        except UnicodeDecodeError:
+            return raw.decode("utf-8", "replace")
 
-    The views alias native memory; they are copied before the handle is
-    released (see :meth:`close`) only where the caller keeps them.
+
+class NativeCorpus:
+    """Snapshot of a parsed corpus as owned numpy arrays.
+
+    Everything is copied out of native memory during construction and the
+    C++ corpus is freed immediately, so there is no lifetime coupling
+    between the arrays and the FFI handle.
     """
 
     def __init__(self, lib: ctypes.CDLL, handle: int, n_files: int):
-        self._lib = lib
-        self._handle = handle
         self.n_files = n_files
         n = lib.tw_num_spans(handle)
         t = lib.tw_num_traces(handle)
         p = lib.tw_num_process_entries(handle)
+        r = lib.tw_num_refs(handle)
         self.n_spans = n
         self.n_traces = t
 
         def arr(fn, length, ctype):
-            ptr = fn(handle)
             if length == 0:
                 return np.empty(0, dtype=ctype)
-            return np.ctypeslib.as_array(ptr, shape=(length,))
+            return np.ctypeslib.as_array(fn(handle), shape=(length,)).copy()
 
         self.start = arr(lib.tw_span_start, n, np.float64)
         self.duration = arr(lib.tw_span_duration, n, np.float64)
@@ -166,8 +184,9 @@ class NativeCorpus:
         self.op = arr(lib.tw_span_op, n, np.int32)
         self.process = arr(lib.tw_span_process, n, np.int32)
         self.kind = arr(lib.tw_span_kind, n, np.int32)
-        self.parent_trace = arr(lib.tw_span_parent_trace, n, np.int32)
-        self.parent_sid = arr(lib.tw_span_parent_sid, n, np.int32)
+        self.ref_offsets = arr(lib.tw_span_ref_offsets, n + 1, np.int64)
+        self.ref_trace = arr(lib.tw_ref_trace, r, np.int32)
+        self.ref_sid = arr(lib.tw_ref_sid, r, np.int32)
         self.caller = arr(lib.tw_span_caller, n, np.int32)
         self.callee = arr(lib.tw_span_callee, n, np.int32)
         self.trace_offsets = arr(lib.tw_trace_span_offsets, t + 1, np.int64)
@@ -179,12 +198,21 @@ class NativeCorpus:
 
         n_strings = lib.tw_num_strings(handle)
         self.strings: List[str] = [
-            lib.tw_string(handle, i).decode("utf-8", "replace")
-            for i in range(n_strings)
+            _decode(lib.tw_string(handle, i)) for i in range(n_strings)
         ]
+        lib.tw_corpus_free(handle)
 
     def string(self, idx: int) -> Optional[str]:
         return None if idx < 0 else self.strings[idx]
+
+    def span_refs(self, i: int) -> List[Tuple[str, str]]:
+        """The full (traceID, spanID) reference list of span ``i``."""
+        lo = int(self.ref_offsets[i])
+        hi = int(self.ref_offsets[i + 1])
+        return [
+            (self.strings[self.ref_trace[j]], self.strings[self.ref_sid[j]])
+            for j in range(lo, hi)
+        ]
 
     # processes tables grouped per trace index
     def processes_by_trace(self) -> Dict[int, Dict[str, str]]:
@@ -195,21 +223,13 @@ class NativeCorpus:
         return out
 
     def close(self) -> None:
-        if self._handle:
-            self._lib.tw_corpus_free(self._handle)
-            self._handle = 0
-
-    def __del__(self):  # pragma: no cover - GC timing
-        try:
-            self.close()
-        except Exception:
-            pass
+        """Kept for API compatibility; arrays own their memory already."""
 
 
 def parse_files(paths: Sequence[str]) -> Optional[NativeCorpus]:
     """Parse Jaeger-JSON files into a NativeCorpus; None if native parsing
     is unavailable or any file fails to parse."""
-    lib = get_lib() if not os.environ.get("TW_DISABLE_NATIVE") else None
+    lib = get_lib()
     if lib is None or not paths:
         return None
     arr = (ctypes.c_char_p * len(paths))(
@@ -231,7 +251,7 @@ def last_error() -> str:
 def root_start_time(path: str) -> Optional[float]:
     """Root-span start time of a trace file (+inf when rootless); None when
     the native library is unavailable."""
-    lib = get_lib() if not os.environ.get("TW_DISABLE_NATIVE") else None
+    lib = get_lib()
     if lib is None:
         return None
     return lib.tw_root_start_time(os.fsencode(path))
@@ -260,7 +280,7 @@ def run_scheme(
 
     ``name`` is one of ``fcfs`` / ``vpath`` / ``vpath_old``.
     """
-    lib = get_lib() if not os.environ.get("TW_DISABLE_NATIVE") else None
+    lib = get_lib()
     if lib is None:
         return None
     fn = {

@@ -204,17 +204,22 @@ def run_experiment(cfg: ExecutorConfig,
 
     # Result keys must be unique even though the registry legitimately holds
     # the same method name twice (index 1 = WeaverExact, index 9 = WeaverTPU,
-    # both "MaxScoreBatchParallel"); the solver still sees the real name.
+    # both "MaxScoreBatchParallel"). The LAST occurrence keeps the bare name
+    # — matching the reference's overwrite order, which downstream plot
+    # scripts look up — and earlier ones get a "#k" suffix. The solver still
+    # sees the real method name.
+    total: Dict[str, int] = {}
+    for method, _ in predictors:
+        total[method] = total.get(method, 0) + 1
     seen: Dict[str, int] = {}
     keyed_predictors = []
     for method, predictor in predictors:
-        if method in seen:
-            seen[method] += 1
-            keyed_predictors.append((f"{method}#{seen[method]}", method,
-                                     predictor))
+        seen[method] = seen.get(method, 0) + 1
+        if seen[method] == total[method]:
+            key = method
         else:
-            seen[method] = 0
-            keyed_predictors.append((method, method, predictor))
+            key = f"{method}#{seen[method]}"
+        keyed_predictors.append((key, method, predictor))
 
     accuracy_overall: Dict[str, float] = {}
     accuracy_per_process: Dict[Tuple[str, str], float] = {}
