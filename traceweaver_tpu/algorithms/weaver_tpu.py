@@ -59,7 +59,11 @@ from traceweaver_tpu.spans import NA, SKIP, Span
 NEG = -1.0e9
 SKIP_MARGIN = 4.0    # log-space margin a real candidate must beat to avoid skip
 SKIP_FLOOR = -60.0   # skip score floor so candidate-less rows still take skip
-DEFAULT_MAX_WINDOW = 32
+# Perfect-cut segments are solved whole (global one-to-one marginals) up to
+# this cap; only beyond it do we fall back to capped sub-windows, which can
+# double-assign an outgoing span across the artificial boundary. 1024 keeps
+# the dense [W, M] score block ≤ ~8 MB — comfortably VMEM-tileable.
+DEFAULT_MAX_WINDOW = 1024
 DEFAULT_TOPK = 5
 
 
@@ -86,7 +90,7 @@ def solve_windows(
     epsilon: float = 1.0,
     n_sinkhorn: int = 40,
     topk: int = DEFAULT_TOPK,
-    n_sweeps: int = 3,
+    n_sweeps: int = 5,
 ):
     """Solve every window by Gauss-Seidel coordinate descent over endpoints.
 
@@ -294,10 +298,17 @@ def pack_problem(
     force_skip_ids: Optional[Dict[str, set]] = None,
     max_window: int = DEFAULT_MAX_WINDOW,
     parallel: bool = False,
+    windows: Optional[List[Tuple[int, int]]] = None,
 ) -> PackedProblem:
-    """Build the dense [B, ...] window tensors for :func:`solve_windows`."""
+    """Build the dense [B, ...] window tensors for :func:`solve_windows`.
+
+    ``windows`` (index pairs into the sorted ``in_spans``) may be supplied to
+    pack a subset — the caller groups same-size-class windows so padding
+    stays bounded; when omitted, perfect cuts over the whole stream are used.
+    """
     E = len(out_eps)
-    windows = perfect_cut_windows(in_spans, max_window)
+    if windows is None:
+        windows = perfect_cut_windows(in_spans, max_window)
     B = len(windows)
     W = _bucket(max(hi - lo for lo, hi in windows))
 
@@ -433,12 +444,13 @@ class WeaverTPU:
     """
 
     def __init__(self, all_spans, all_processes, max_window: int = DEFAULT_MAX_WINDOW,
-                 epsilon: float = 1.0, n_sinkhorn: int = 40):
+                 epsilon: float = 1.0, n_sinkhorn: int = 40, n_sweeps: int = 5):
         self.all_spans = all_spans
         self.all_processes = all_processes
         self.max_window = max_window
         self.epsilon = epsilon
         self.n_sinkhorn = n_sinkhorn
+        self.n_sweeps = n_sweeps
 
     # -- helpers -----------------------------------------------------------
     @staticmethod
@@ -457,37 +469,48 @@ class WeaverTPU:
 
     def _solve_once(self, in_spans, out_span_partitions, out_eps, dists,
                     in_ep, dag, force_skip_ids, parallel):
-        packed = pack_problem(
-            in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
-            force_skip_ids=force_skip_ids, max_window=self.max_window,
-            parallel=parallel,
-        )
-        a = packed.arrays
-        assign, topk_cols, not_best, feas = solve_windows(
-            a["in_start"], a["in_end"], a["in_valid"],
-            a["out_start"], a["out_end"], a["out_valid"],
-            a["skip_cap"], a["force_skip"],
-            a["pred_mask"], a["root_mask"], a["is_last"],
-            a["edge_wt"], a["edge_mu"], a["edge_sd"],
-            a["in_wt"], a["in_mu"], a["in_sd"],
-            a["ret_wt"], a["ret_mu"], a["ret_sd"],
-            epsilon=self.epsilon, n_sinkhorn=self.n_sinkhorn,
-        )
-        return packed, (np.asarray(assign), np.asarray(topk_cols),
-                        np.asarray(not_best), np.asarray(feas))
+        """Solve all perfect-cut windows, grouped by size class so one jit
+        variant serves each power-of-two width with bounded padding.
+
+        Returns a list of ``(packed, (assign, topk, not_best, feas))``.
+        """
+        all_windows = perfect_cut_windows(in_spans, self.max_window)
+        groups: Dict[int, List[Tuple[int, int]]] = {}
+        for w in all_windows:
+            groups.setdefault(_bucket(w[1] - w[0]), []).append(w)
+
+        results = []
+        for wclass in sorted(groups):
+            packed = pack_problem(
+                in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
+                force_skip_ids=force_skip_ids, parallel=parallel,
+                windows=groups[wclass],
+            )
+            a = packed.arrays
+            assign, topk_cols, not_best, feas = solve_windows(
+                a["in_start"], a["in_end"], a["in_valid"],
+                a["out_start"], a["out_end"], a["out_valid"],
+                a["skip_cap"], a["force_skip"],
+                a["pred_mask"], a["root_mask"], a["is_last"],
+                a["edge_wt"], a["edge_mu"], a["edge_sd"],
+                a["in_wt"], a["in_mu"], a["in_sd"],
+                a["ret_wt"], a["ret_mu"], a["ret_sd"],
+                epsilon=self.epsilon, n_sinkhorn=self.n_sinkhorn,
+                n_sweeps=self.n_sweeps,
+            )
+            results.append((packed, (np.asarray(assign), np.asarray(topk_cols),
+                                     np.asarray(not_best), np.asarray(feas))))
+        return results
 
     @staticmethod
     def _decode(packed: PackedProblem, assign: np.ndarray,
-                topk_cols: np.ndarray):
-        """Device indices -> wire-format assignment dicts."""
+                topk_cols: np.ndarray, all_assignments, all_topk):
+        """Device indices -> wire-format assignment dicts (merged in place)."""
         B, E, W = assign.shape
         M = packed.arrays["out_start"].shape[2]
-        all_assignments: Dict[str, Dict] = {ep: {} for ep in packed.out_eps}
-        all_topk: Dict[str, Dict] = {ep: {} for ep in packed.out_eps}
-        idx = 0
         for b, (lo, hi) in enumerate(packed.windows):
             for i in range(hi - lo):
-                in_id = packed.in_ids[idx]
+                in_id = packed.in_ids[lo + i]
                 for e, ep in enumerate(packed.out_eps):
                     col = int(assign[b, e, i])
                     if col == M:
@@ -510,8 +533,6 @@ class WeaverTPU:
                     if out_id in tks:
                         tks.remove(out_id)
                     all_topk[ep][in_id] = [out_id] + tks[: topk_cols.shape[3] - 1]
-                idx += 1
-        return all_assignments, all_topk
 
     # -- plugin entry point ------------------------------------------------
     def FindAssignments(self, method, process, in_span_partitions,
@@ -560,24 +581,28 @@ class WeaverTPU:
         all_assignments = all_topk = None
         not_best_count = 0
         per_span_candidates: Dict = {}
+        in_ids = [s.GetId() for s in in_spans]
         for it in range(iterations):
-            packed, (assign, topk_cols, not_best, feas) = self._solve_once(
+            batches = self._solve_once(
                 in_spans, out_span_partitions, out_eps, dists, in_ep,
                 invocation_graph, force_skip_ids, parallel_mode,
             )
-            all_assignments, all_topk = self._decode(packed, assign, topk_cols)
+            all_assignments = {ep: {} for ep in out_eps}
+            all_topk = {ep: {} for ep in out_eps}
             # confidence: a span is "not best" if OT overrode the row argmax
-            span_not_best = np.zeros(packed.n_in, dtype=bool)
-            span_cands = np.zeros(packed.n_in, dtype=np.int64)
-            idx = 0
-            for b, (lo, hi) in enumerate(packed.windows):
-                for i in range(hi - lo):
-                    span_not_best[idx] = bool(not_best[b, :, i].any())
-                    span_cands[idx] = int(np.maximum(feas[b, :, i], 1).prod())
-                    idx += 1
+            span_not_best = np.zeros(n_in, dtype=bool)
+            span_cands = np.ones(n_in, dtype=np.int64)
+            for packed, (assign, topk_cols, not_best, feas) in batches:
+                self._decode(packed, assign, topk_cols,
+                             all_assignments, all_topk)
+                for b, (lo, hi) in enumerate(packed.windows):
+                    for i in range(hi - lo):
+                        span_not_best[lo + i] = bool(not_best[b, :, i].any())
+                        span_cands[lo + i] = int(
+                            np.maximum(feas[b, :, i], 1).prod())
             not_best_count = int(span_not_best.sum())
             per_span_candidates = {
-                packed.in_ids[i]: int(span_cands[i]) for i in range(packed.n_in)
+                in_ids[i]: int(span_cands[i]) for i in range(n_in)
             }
             if it + 1 < iterations:
                 dists = timing.refit_from_assignments(
@@ -587,7 +612,7 @@ class WeaverTPU:
 
         cnt_unassigned = sum(
             1
-            for in_id in packed.in_ids
+            for in_id in in_ids
             if any(all_assignments[ep][in_id] == NA for ep in out_eps)
         )
 
