@@ -81,3 +81,19 @@ def test_media_reroot(media_store):
     roots = [s for s in media_store.all_spans.values() if s.IsRoot()]
     assert roots
     assert all(s.op_name == "ComposeReview" for s in roots)
+
+
+def test_fit_invocation_dag_recovers_chain():
+    # mock evaluator: misfit = number of chain edges missing from the DAG —
+    # the greedy search must add exactly the chain a->b->c and stop
+    from traceweaver_tpu.ingest import fit_invocation_dag
+
+    chain = [("a", "b"), ("b", "c")]
+    parts = {"a": [], "b": [], "c": []}
+
+    def evaluate(dag):
+        return sum(1 for e in chain if not dag.has_edge(*e))
+
+    dag, cost = fit_invocation_dag(parts, evaluate)
+    assert cost == 0
+    assert set(dag.edges()) == set(chain)

@@ -1,6 +1,7 @@
 """Unit tests for the JAX numeric kernels."""
 
 import numpy as np
+import jax
 import pytest
 import scipy.stats
 
@@ -72,3 +73,89 @@ def test_greedy_round_skip_capacity():
         jnp.asarray(2), n_steps=3))
     assert (assign == 2).sum() == 2
     assert sorted(assign.tolist())[0] == 0  # someone took the real column
+
+
+def test_greedy_round_matches_serial_peel_under_skip_contention():
+    # Row 1 wins the real column (0.95); row 0 then falls back to skip and
+    # must take the single skip slot ahead of lower-mass row 2, exactly as
+    # the serial highest-cell-first peel would order it.
+    plan = jnp.asarray(np.array([
+        [0.9, 0.8],    # loses col 0 to row 1, deserves the skip slot
+        [0.95, 0.5],
+        [0.0, 0.3],    # wants skip immediately but must NOT get it
+    ]))
+    assign = np.asarray(greedy_round(
+        plan, jnp.array([True] * 3), jnp.array([True, True]),
+        jnp.asarray(1), n_steps=3))
+    assert assign[1] == 0
+    assert assign[0] == 1   # skip column
+    assert assign[2] == -1  # capacity exhausted, no real candidate
+
+
+def test_greedy_round_matches_serial_peel_randomized():
+    # brute-force serial peel oracle on random plans (incl. skip capacity)
+    rng = np.random.default_rng(7)
+    for trial in range(20):
+        n, m1 = rng.integers(2, 9), rng.integers(2, 7)
+        plan = rng.random((n, m1)).round(3)  # coarse grid avoids ties
+        plan += np.arange(n)[:, None] * 1e-6  # deterministic tie-break
+        cap = int(rng.integers(0, 3))
+        col_valid = np.ones(m1, dtype=bool)
+        col_valid[-1] = cap > 0
+
+        # serial oracle
+        mass = np.where(col_valid[None, :], plan, -1e9).copy()
+        want = np.full(n, -1, dtype=np.int32)
+        used = 0
+        for _ in range(n):
+            i, j = np.unravel_index(np.argmax(mass), mass.shape)
+            if mass[i, j] <= -1e8:
+                break
+            want[i] = j
+            mass[i, :] = -1e9
+            if j == m1 - 1:
+                used += 1
+                if used >= cap:
+                    mass[:, j] = -1e9
+            else:
+                mass[:, j] = -1e9
+
+        got = np.asarray(greedy_round(
+            jnp.asarray(plan), jnp.ones(n, bool), jnp.asarray(col_valid),
+            jnp.asarray(cap), n_steps=n))
+        np.testing.assert_array_equal(got, want, err_msg=f"trial {trial}")
+
+
+def test_pallas_sinkhorn_matches_jnp_path():
+    from traceweaver_tpu.ops.pallas_sinkhorn import sinkhorn_log_pallas
+
+    rng = np.random.default_rng(3)
+    for n, m in [(6, 9), (17, 33), (64, 128)]:
+        S = rng.normal(size=(n, m)).astype(np.float32)
+        S[rng.random((n, m)) < 0.2] = -1e9  # feasibility mask
+        r = np.ones(n, np.float32)
+        r[-1] = 3.0
+        c = np.full(m, (n + 2) / m, np.float32)
+        want = np.asarray(sinkhorn_log(
+            jnp.asarray(S), jnp.asarray(r), jnp.asarray(c),
+            epsilon=0.7, n_iters=60))
+        got = np.asarray(sinkhorn_log_pallas(
+            jnp.asarray(S), jnp.asarray(r), jnp.asarray(c),
+            epsilon=0.7, n_iters=60, interpret=True))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+
+def test_pallas_sinkhorn_disabled_rows_and_vmap():
+    from traceweaver_tpu.ops.pallas_sinkhorn import sinkhorn_log_pallas
+
+    rng = np.random.default_rng(5)
+    S = rng.normal(size=(4, 10, 12)).astype(np.float32)
+    r = np.ones((4, 10), np.float32)
+    r[:, 3] = 0.0  # disabled row
+    c = np.full((4, 12), 9.0 / 12.0, np.float32)
+    got = np.asarray(jax.vmap(
+        lambda s, rr, cc: sinkhorn_log_pallas(
+            s, rr, cc, epsilon=1.0, n_iters=80, interpret=True)
+    )(jnp.asarray(S), jnp.asarray(r), jnp.asarray(c)))
+    assert got[:, 3, :].sum() < 1e-6
+    np.testing.assert_allclose(got.sum(2), r, rtol=1e-3, atol=1e-3)

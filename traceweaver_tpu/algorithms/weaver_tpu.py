@@ -51,9 +51,9 @@ import networkx as nx
 from traceweaver_tpu.algorithms import timing
 from traceweaver_tpu.algorithms.timing import MAX_COMPONENTS, EdgeDist
 from traceweaver_tpu.metrics.accuracy import get_out_eps_in_order
+from traceweaver_tpu.ops.pallas_sinkhorn import sinkhorn
 from traceweaver_tpu.ops.rounding import greedy_round
 from traceweaver_tpu.ops.scores import mixture_logpdf, pair_scores
-from traceweaver_tpu.ops.sinkhorn import sinkhorn_log
 from traceweaver_tpu.spans import NA, SKIP, Span
 
 NEG = -1.0e9
@@ -65,6 +65,16 @@ SKIP_FLOOR = -60.0   # skip score floor so candidate-less rows still take skip
 # the dense [W, M] score block ≤ ~8 MB — comfortably VMEM-tileable.
 DEFAULT_MAX_WINDOW = 1024
 DEFAULT_TOPK = 5
+# Per-dispatch element budget (~f32 elements of [B, W, M] score blocks kept
+# live at once). Bounds HBM while letting one dispatch cover a whole solve:
+# round trips through the device tunnel cost ~100 ms each, so fewer, fatter
+# dispatches win over per-size-class ones.
+CHUNK_ELEMS = 1 << 26
+# Merging a smaller window size class into the next larger one trades
+# padding FLOPs for one fewer device round trip; merge while the extra
+# padded area (elements) stays under this budget (~a round trip's worth of
+# VPU work for this pipeline).
+MERGE_ELEMS = 1 << 24
 
 
 # ---------------------------------------------------------------------------
@@ -196,8 +206,8 @@ def solve_windows(
                 [Sfull, jnp.zeros((1, M + 1), dtype=S.dtype)], axis=0
             )
 
-            plan = sinkhorn_log(S_ot, row_marg, col_marg,
-                                epsilon=epsilon, n_iters=n_sinkhorn)
+            plan = sinkhorn(S_ot, row_marg, col_marg,
+                            epsilon=epsilon, n_iters=n_sinkhorn)
             plan = plan[:W, :]
 
             col_valid = jnp.concatenate([o_v[e], (cap_e > 0)[None]])
@@ -239,6 +249,22 @@ def solve_windows(
     )
 
 
+@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps"))
+def solve_windows_packed(*args, epsilon: float = 1.0, n_sinkhorn: int = 40,
+                         topk: int = DEFAULT_TOPK, n_sweeps: int = 5):
+    """:func:`solve_windows` with the four outputs packed into one int32
+    tensor ``[B, E, W, 3+topk]`` (assign, not_best, feas_count, topk...) so a
+    solve costs a single device->host transfer instead of four."""
+    assign, tk, not_best, feas = solve_windows(
+        *args, epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
+        n_sweeps=n_sweeps,
+    )
+    return jnp.concatenate(
+        [assign[..., None], not_best[..., None].astype(jnp.int32),
+         feas[..., None], tk], axis=-1,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Host-side problem packing
 # ---------------------------------------------------------------------------
@@ -276,6 +302,30 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def candidate_ranges(
+    in_spans: List[Span],
+    windows: List[Tuple[int, int]],
+    out_eps: List[str],
+    out_starts_np: Dict[str, np.ndarray],
+) -> np.ndarray:
+    """[B, E, 2] candidate index ranges: per window and endpoint, the slice
+    of that endpoint's time-sorted out-spans starting within the window's
+    [first in start, last in end] bound (the tensor analogue of the
+    reference's per-endpoint binary-search cutoffs, traceweaver_v3.py:182-217).
+    Single source of truth for both packing and the dispatch-size budget.
+    """
+    ranges = np.zeros((len(windows), len(out_eps), 2), dtype=np.int64)
+    for b, (lo, hi) in enumerate(windows):
+        w_t0 = float(in_spans[lo].start_mus)
+        w_t1 = max(float(s.start_mus) + float(s.duration_mus)
+                   for s in in_spans[lo:hi])
+        for e, ep in enumerate(out_eps):
+            starts = out_starts_np[ep]
+            ranges[b, e, 0] = np.searchsorted(starts, w_t0, side="left")
+            ranges[b, e, 1] = np.searchsorted(starts, w_t1, side="right")
+    return ranges
+
+
 @dataclass
 class PackedProblem:
     """Dense window tensors + the index maps to decode device output."""
@@ -299,18 +349,24 @@ def pack_problem(
     max_window: int = DEFAULT_MAX_WINDOW,
     parallel: bool = False,
     windows: Optional[List[Tuple[int, int]]] = None,
+    pad_w: Optional[int] = None,
+    pad_b: Optional[int] = None,
+    pad_m: Optional[int] = None,
 ) -> PackedProblem:
     """Build the dense [B, ...] window tensors for :func:`solve_windows`.
 
     ``windows`` (index pairs into the sorted ``in_spans``) may be supplied to
-    pack a subset — the caller groups same-size-class windows so padding
-    stays bounded; when omitted, perfect cuts over the whole stream are used.
+    pack a subset; when omitted, perfect cuts over the whole stream are used.
+    ``pad_w``/``pad_b``/``pad_m`` force the padded window width / batch size /
+    candidate-column count (all still rounded up to powers of two) so every
+    chunk of a solve shares one compiled variant.
     """
     E = len(out_eps)
     if windows is None:
         windows = perfect_cut_windows(in_spans, max_window)
-    B = len(windows)
-    W = _bucket(max(hi - lo for lo, hi in windows))
+    n_windows = len(windows)
+    B = _bucket(max(n_windows, pad_b or 1), minimum=1)
+    W = _bucket(max(max(hi - lo for lo, hi in windows), pad_w or 1))
 
     out_sorted = {
         ep: sorted(out_span_partitions[ep], key=lambda s: s.start_mus)
@@ -320,16 +376,9 @@ def pack_problem(
         ep: np.array([float(s.start_mus) for s in out_sorted[ep]]) for ep in out_eps
     }
 
-    # per-window candidate ranges per ep
-    ranges = np.zeros((B, E, 2), dtype=np.int64)
-    for b, (lo, hi) in enumerate(windows):
-        w_t0 = float(in_spans[lo].start_mus)
-        w_t1 = max(float(s.start_mus) + float(s.duration_mus) for s in in_spans[lo:hi])
-        for e, ep in enumerate(out_eps):
-            starts = out_starts_np[ep]
-            ranges[b, e, 0] = np.searchsorted(starts, w_t0, side="left")
-            ranges[b, e, 1] = np.searchsorted(starts, w_t1, side="right")
-    M = _bucket(int((ranges[:, :, 1] - ranges[:, :, 0]).max(initial=1)))
+    ranges = candidate_ranges(in_spans, windows, out_eps, out_starts_np)
+    M = _bucket(max(int((ranges[:, :, 1] - ranges[:, :, 0]).max(initial=1)),
+                    pad_m or 1))
 
     in_start = np.zeros((B, W), dtype=np.float32)
     in_end = np.zeros((B, W), dtype=np.float32)
@@ -469,37 +518,95 @@ class WeaverTPU:
 
     def _solve_once(self, in_spans, out_span_partitions, out_eps, dists,
                     in_ep, dag, force_skip_ids, parallel):
-        """Solve all perfect-cut windows, grouped by size class so one jit
-        variant serves each power-of-two width with bounded padding.
+        """Solve all perfect-cut windows in as few device dispatches as
+        possible: size classes are merged upward while the padding cost
+        stays under MERGE_ELEMS, batches are chunked only to bound live HBM
+        (budgeted on the true [B, W, M] block), outputs are packed into a
+        single int32 tensor and fetched asynchronously — each device round
+        trip through the tunnel costs ~100 ms, so dispatch count dominates.
 
         Returns a list of ``(packed, (assign, topk, not_best, feas))``.
         """
         all_windows = perfect_cut_windows(in_spans, self.max_window)
+        E = max(1, len(out_eps))
+        n_sweeps = 1 if E == 1 else self.n_sweeps
+
+        # candidate-column width per size class via the same range helper the
+        # packer uses, so padding costs and the chunk budget reflect the true
+        # [B, W, M] block
+        out_starts_np = {
+            ep: np.array(sorted(float(s.start_mus)
+                                for s in out_span_partitions[ep]))
+            for ep in out_eps
+        }
+
+        def est_m(wins: List[Tuple[int, int]]) -> int:
+            r = candidate_ranges(in_spans, wins, out_eps, out_starts_np)
+            return _bucket(int((r[:, :, 1] - r[:, :, 0]).max(initial=1)))
+
+        # size classes (power-of-two widths), with smaller classes greedily
+        # merged upward while the extra padded area stays under MERGE_ELEMS —
+        # one dispatch for typical skews, separate classes when padding a
+        # swarm of small windows up to a burst's width would cost more
+        # compute than the saved round trip
         groups: Dict[int, List[Tuple[int, int]]] = {}
         for w in all_windows:
             groups.setdefault(_bucket(w[1] - w[0]), []).append(w)
+        classes = sorted(groups)
+        batches_spec: List[Tuple[int, List[Tuple[int, int]]]] = []
+        carry: List[Tuple[int, int]] = []
+        for idx, c in enumerate(classes):
+            wins = carry + groups[c]
+            if idx + 1 < len(classes):
+                nxt = classes[idx + 1]
+                if len(wins) * (nxt - c) * est_m(wins) * E <= MERGE_ELEMS:
+                    carry = wins
+                    continue
+            batches_spec.append((c, wins))
+            carry = []
+
+        pending = []
+        for wclass, wins in batches_spec:
+            m_est = est_m(wins)
+            per_chunk = max(1, CHUNK_ELEMS // (wclass * m_est * E))
+            chunks = [wins[i:i + per_chunk]
+                      for i in range(0, len(wins), per_chunk)]
+            for chunk in chunks:
+                packed = pack_problem(
+                    in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
+                    force_skip_ids=force_skip_ids, parallel=parallel,
+                    windows=chunk, pad_w=wclass,
+                    pad_b=per_chunk if len(chunks) > 1 else None,
+                    pad_m=m_est if len(chunks) > 1 else None,
+                )
+                a = packed.arrays
+                out = solve_windows_packed(
+                    a["in_start"], a["in_end"], a["in_valid"],
+                    a["out_start"], a["out_end"], a["out_valid"],
+                    a["skip_cap"], a["force_skip"],
+                    a["pred_mask"], a["root_mask"], a["is_last"],
+                    a["edge_wt"], a["edge_mu"], a["edge_sd"],
+                    a["in_wt"], a["in_mu"], a["in_sd"],
+                    a["ret_wt"], a["ret_mu"], a["ret_sd"],
+                    epsilon=self.epsilon, n_sinkhorn=self.n_sinkhorn,
+                    n_sweeps=n_sweeps,
+                )
+                pending.append((packed, out))
+
+        for _, out in pending:
+            try:
+                out.copy_to_host_async()
+            except AttributeError:  # plain np.ndarray under some backends
+                pass
 
         results = []
-        for wclass in sorted(groups):
-            packed = pack_problem(
-                in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
-                force_skip_ids=force_skip_ids, parallel=parallel,
-                windows=groups[wclass],
-            )
-            a = packed.arrays
-            assign, topk_cols, not_best, feas = solve_windows(
-                a["in_start"], a["in_end"], a["in_valid"],
-                a["out_start"], a["out_end"], a["out_valid"],
-                a["skip_cap"], a["force_skip"],
-                a["pred_mask"], a["root_mask"], a["is_last"],
-                a["edge_wt"], a["edge_mu"], a["edge_sd"],
-                a["in_wt"], a["in_mu"], a["in_sd"],
-                a["ret_wt"], a["ret_mu"], a["ret_sd"],
-                epsilon=self.epsilon, n_sinkhorn=self.n_sinkhorn,
-                n_sweeps=self.n_sweeps,
-            )
-            results.append((packed, (np.asarray(assign), np.asarray(topk_cols),
-                                     np.asarray(not_best), np.asarray(feas))))
+        for packed, out in pending:
+            o = np.asarray(out)
+            assign = o[..., 0]
+            not_best = o[..., 1].astype(bool)
+            feas = o[..., 2]
+            topk_cols = o[..., 3:]
+            results.append((packed, (assign, topk_cols, not_best, feas)))
         return results
 
     @staticmethod

@@ -11,4 +11,7 @@ from traceweaver_tpu.ingest.partition import (  # noqa: F401
     build_service_problem,
     partition_spans_by_endpoint,
 )
-from traceweaver_tpu.ingest.order import infer_invocation_dag  # noqa: F401
+from traceweaver_tpu.ingest.order import (  # noqa: F401
+    fit_invocation_dag, infer_invocation_dag, solver_misfit,
+    topological_sort_grouped,
+)

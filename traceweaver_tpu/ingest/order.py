@@ -76,3 +76,70 @@ def infer_invocation_dag(
                     G.remove_edge(yep, xep)
 
     return G
+
+
+def fit_invocation_dag(out_span_partitions: Dict[str, List[Span]], evaluate,
+                       max_edges: int = None):
+    """Ground-truth-free constraint search (the reference's
+    ``FindConstraintsUsingFit``, executor.py:152-212): starting from the
+    unconstrained (empty) precedence DAG, greedily add the single edge whose
+    addition most reduces the solver's unassigned-span count, keeping the
+    graph acyclic; stop when no candidate edge improves the fit.
+
+    ``evaluate(dag) -> int`` runs a reconstruction under the candidate DAG
+    and returns its cost (the reference uses the solver's unassigned count;
+    any monotone misfit measure works). Returns ``(dag, best_cost)``.
+    Pair with :func:`solver_misfit` for a DAG-aware plugin solver.
+    """
+    out_eps = list(out_span_partitions)
+    G = nx.DiGraph()
+    G.add_nodes_from(out_eps)
+    best = evaluate(G)
+    limit = max_edges if max_edges is not None else len(out_eps) ** 2
+
+    while G.number_of_edges() < limit:
+        best_edge = None
+        for a in out_eps:
+            for b in out_eps:
+                if a == b or G.has_edge(a, b):
+                    continue
+                G.add_edge(a, b)
+                if nx.is_directed_acyclic_graph(G):
+                    cost = evaluate(G)
+                    if cost < best:
+                        best, best_edge = cost, (a, b)
+                G.remove_edge(a, b)
+        if best_edge is None:
+            break
+        G.add_edge(*best_edge)
+    return G, best
+
+
+def solver_misfit(solver, method: str, process: str, in_span_partitions,
+                  out_span_partitions, true_assignments):
+    """Adapter producing an ``evaluate`` for :func:`fit_invocation_dag` from
+    a DAG-aware plugin solver (one whose ``FindAssignments`` accepts an
+    ``invocation_graph``, i.e. the WeaverTPU/WeaverExact V3-contract
+    signature) that reports unassigned spans (tuple position 5, the
+    reference solver-output convention, traceweaver_v3.py:1229)."""
+    import copy as _copy
+    import inspect
+
+    params = inspect.signature(solver.FindAssignments).parameters
+    if "invocation_graph" not in params:
+        raise TypeError(
+            f"{type(solver).__name__}.FindAssignments takes no "
+            "invocation_graph — constraint search needs a DAG-aware solver"
+        )
+
+    def evaluate(dag) -> int:
+        out = solver.FindAssignments(
+            method, process,
+            _copy.deepcopy(in_span_partitions),
+            _copy.deepcopy(out_span_partitions),
+            False, [], _copy.deepcopy(true_assignments),
+            invocation_graph=dag,
+        )
+        return int(out[5]) if isinstance(out, tuple) and len(out) > 5 else 0
+
+    return evaluate

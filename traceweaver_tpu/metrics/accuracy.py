@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
-from traceweaver_tpu.spans import Span, SpanId
+from traceweaver_tpu.spans import NA, Span, SpanId
 
 
 def get_out_eps_in_order(out_span_partitions: Dict[str, List[Span]]) -> List[str]:
@@ -55,8 +55,11 @@ def get_ground_truth(
 
 def _normalize_pred(pred_assignments: Dict, ep: str, in_span_id: SpanId) -> Tuple[bool, object]:
     """Unwrap single-element list predictions (WAP5 emits lists); a
-    multi-element list counts as incorrect (utils.py:37-41)."""
-    val = pred_assignments[ep][in_span_id]
+    multi-element list counts as incorrect (utils.py:37-41). A missing
+    entry normalizes to NA — solvers that drop unassigned spans from their
+    output (e.g. the reference's batch-MIS V2 path, which its own
+    AccuracyForService would KeyError on) just score those spans wrong."""
+    val = pred_assignments[ep].get(in_span_id, NA)
     if isinstance(val, list):
         if len(val) > 1:
             return False, val
@@ -77,7 +80,7 @@ def accuracy_for_service(
         correct = True
         for ep in true_assignments:
             ok, val = _normalize_pred(pred_assignments, ep, in_span.GetId())
-            correct = correct and ok and val == true_assignments[ep][in_span.GetId()]
+            correct = correct and ok and val == true_assignments[ep].get(in_span.GetId(), NA)
         cnt += int(correct)
     return float(cnt) / len(in_spans)
 
@@ -93,9 +96,10 @@ def topk_accuracy_for_service(
     cnt = 0
     for in_span in in_spans:
         sid = in_span.GetId()
-        for i in range(len(pred_topk_assignments[ep0][sid])):
+        opts0 = pred_topk_assignments[ep0].get(sid) or [NA]
+        for i in range(len(opts0)):
             correct = all(
-                pred_topk_assignments[ep][sid][i] == true_assignments[ep][sid]
+                (pred_topk_assignments[ep].get(sid) or [NA])[i:i + 1] == [true_assignments[ep].get(sid, NA)]
                 for ep in true_assignments
             )
             if correct:
@@ -116,7 +120,7 @@ def accuracy_end_to_end(
         for in_span in in_spans_by_process[process]:
             trace_acc.setdefault(in_span.trace_id, True)
             for ep in true_assignments:
-                if true_assignments[ep][in_span.GetId()] != pred_assignments[ep][in_span.GetId()]:
+                if true_assignments[ep].get(in_span.GetId(), NA) != pred_assignments[ep].get(in_span.GetId(), NA):
                     trace_acc[in_span.trace_id] = False
     correct = sum(trace_acc.values())
     return trace_acc, float(correct) / len(trace_acc)
@@ -136,13 +140,14 @@ def topk_accuracy_end_to_end(
             sid = in_span.GetId()
             if i != 0 and trace_acc.get(in_span.trace_id) is False:
                 continue
-            options = pred_topk[ep0][sid]
+            options = pred_topk[ep0].get(sid) or []
             if len(options) < 1:
                 trace_acc[in_span.trace_id] = False
                 continue
             for j in range(len(options)):
                 trace_acc[in_span.trace_id] = all(
-                    true_assignments[ep][sid] == pred_topk[ep][sid][j]
+                    [true_assignments[ep].get(sid, NA)]
+                    == (pred_topk[ep].get(sid) or [NA])[j:j + 1]
                     for ep in true_assignments
                 )
                 if trace_acc[in_span.trace_id]:

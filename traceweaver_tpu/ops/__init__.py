@@ -3,3 +3,6 @@
 from traceweaver_tpu.ops.sinkhorn import sinkhorn_log  # noqa: F401
 from traceweaver_tpu.ops.scores import mixture_logpdf, pair_scores  # noqa: F401
 from traceweaver_tpu.ops.rounding import greedy_round  # noqa: F401
+from traceweaver_tpu.ops.pallas_sinkhorn import (  # noqa: F401
+    sinkhorn, sinkhorn_log_pallas,
+)

@@ -30,13 +30,17 @@ NA = ("NA", "NA")
 SKIP = ("Skip", "Skip")
 
 
-@dataclass
+@dataclass(eq=False)
 class Span:
     """One RPC span (either the server half or the client half of a call).
 
     Times are integer microseconds since epoch (Jaeger convention); they stay
     int64/float on host and are only rebased+downcast when packed into a
     :class:`SpanArray`.
+
+    ``eq=False`` keeps identity-based equality/hash (the reference's span
+    model is a plain class, spans.py:1-26, and algorithms key sets/dicts by
+    span object) — value equality would also make spans unhashable.
     """
 
     trace_id: str
