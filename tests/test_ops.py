@@ -159,3 +159,58 @@ def test_pallas_sinkhorn_disabled_rows_and_vmap():
     )(jnp.asarray(S), jnp.asarray(r), jnp.asarray(c)))
     assert got[:, 3, :].sum() < 1e-6
     np.testing.assert_allclose(got.sum(2), r, rtol=1e-3, atol=1e-3)
+
+
+def test_batched_gmm_recovers_mixture():
+    from traceweaver_tpu.ops.gmm import fit_gmm_batched
+
+    rng = np.random.default_rng(11)
+    # edge 0: well-separated 2-component mixture; edge 1: single gaussian
+    a = np.concatenate([rng.normal(1000.0, 50.0, 400),
+                        rng.normal(9000.0, 100.0, 200)])
+    b = rng.normal(5000.0, 300.0, 512)
+    x = np.zeros((2, 1024), np.float32)
+    mask = np.zeros((2, 1024), bool)
+    x[0, :len(a)] = a; mask[0, :len(a)] = True
+    x[1, :len(b)] = b; mask[1, :len(b)] = True
+
+    w, mu, sd = (np.asarray(o) for o in fit_gmm_batched(x, mask, max_k=5))
+
+    # edge 0: two dominant components near 1000 and 9000 with ~2:1 weights
+    live = w[0] > 0.05
+    assert live.sum() == 2, (w[0], mu[0])
+    got = sorted(zip(mu[0][live], w[0][live]))
+    assert abs(got[0][0] - 1000) < 100 and abs(got[1][0] - 9000) < 200
+    assert abs(got[0][1] - 2 / 3) < 0.1
+
+    # edge 1: single component near (5000, 300)
+    live = w[1] > 0.05
+    assert live.sum() == 1
+    assert abs(mu[1][live][0] - 5000) < 100
+    assert abs(sd[1][live][0] - 300) < 80
+
+
+def test_fit_edge_gmms_matches_sklearn_loglik():
+    from traceweaver_tpu.algorithms.timing import EdgeDist, fit_edge_gmms
+
+    rng = np.random.default_rng(13)
+    samples = np.concatenate([rng.normal(200.0, 20.0, 300),
+                              rng.normal(800.0, 40.0, 300)])
+    dev = fit_edge_gmms({("a", "b"): samples.tolist()})[("a", "b")]
+    skl = EdgeDist.from_samples_gmm(samples.tolist())
+    # average log-likelihood of the data under both fits should agree
+    ll_dev = float(np.mean(dev.logpdf(samples)))
+    ll_skl = float(np.mean(skl.logpdf(samples)))
+    assert ll_dev > ll_skl - 0.15, (ll_dev, ll_skl)
+
+
+def test_fit_edge_gmms_degenerate_rows():
+    from traceweaver_tpu.algorithms.timing import fit_edge_gmms
+
+    out = fit_edge_gmms({
+        ("a", "b"): [5.0, 5.0, 5.0, 5.0, 5.0],   # constant -> host path
+        ("a", "c"): [1.0, 2.0],                   # too few -> host path
+        ("a", "d"): [],                           # empty -> host path
+    })
+    assert set(out) == {("a", "b"), ("a", "c"), ("a", "d")}
+    assert abs(out[("a", "b")].means[0] - 5.0) < 1e-6

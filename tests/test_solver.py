@@ -129,3 +129,52 @@ def test_perfect_cut_windows_partition_and_disjoint():
     wins = perfect_cut_windows(spans, max_size=2)
     assert all(hi - lo <= 2 for lo, hi in wins)
     assert wins[0][0] == 0 and wins[-1][1] == 12
+
+
+def test_split_window_assignments_stay_one_to_one(hotel_store):
+    """Forcing tiny capped sub-windows splits perfect-cut segments; the
+    cross-window resolution pass must keep each outgoing span assigned to
+    at most one incoming span and not tank accuracy."""
+    e2e, extras = _run(
+        hotel_store,
+        lambda: WeaverTPU(hotel_store.all_spans, hotel_store.all_processes,
+                          max_window=8),
+        "MaxScoreBatchSubsetWithSkips",
+    )
+    for svc, (out, prob, ta) in extras.items():
+        pred = out[0]
+        for ep, amap in pred.items():
+            real = [tuple(v) for v in amap.values()
+                    if tuple(v) not in (("NA", "NA"), ("Skip", "Skip"))]
+            assert len(real) == len(set(real)), f"{svc}/{ep} duplicates"
+    assert e2e >= 0.90, f"split-window e2e {e2e:.3f}"
+
+
+def test_cross_window_duplicate_resolution_semantics():
+    """Time-order winner keeps a contested span; only losers reassign; a
+    loser's fallback cannot displace another row's commitment; SKIP
+    fallbacks respect the global |in|-|out| budget."""
+    ep = "svc:op"
+    o1, o2, o3 = ("t", "o1"), ("t", "o2"), ("t", "o3")
+    A, B, C = ("t", "a"), ("t", "b"), ("t", "c")
+    # decode order puts C first (smaller size class dispatched earlier) but
+    # time order is A, B, C
+    assignments = {ep: {C: o1, A: o1, B: o2}}
+    topk = {ep: {C: [o1, o2, o3], A: [o1], B: [o2]}}
+    WeaverTPU._resolve_cross_window_duplicates(
+        assignments, topk, [A, B, C], {ep: 0})
+    assert assignments[ep][A] == o1      # earliest in time keeps it
+    assert assignments[ep][B] == o2      # untouched — never in conflict
+    assert assignments[ep][C] == o3      # falls to first FREE candidate
+
+    # skip budget: loser may take SKIP only while budget remains
+    assignments = {ep: {A: o1, B: o1}}
+    topk = {ep: {A: [o1], B: [o1, SKIP]}}
+    WeaverTPU._resolve_cross_window_duplicates(
+        assignments, topk, [A, B], {ep: 0})
+    assert assignments[ep][B] == ("NA", "NA")  # budget 0: no skip
+    assignments = {ep: {A: o1, B: o1}}
+    topk = {ep: {A: [o1], B: [o1, SKIP]}}
+    WeaverTPU._resolve_cross_window_duplicates(
+        assignments, topk, [A, B], {ep: 1})
+    assert assignments[ep][B] == SKIP          # budget 1: skip allowed

@@ -302,6 +302,7 @@ def refit_from_assignments(
         sid = tuple(sid)
         return by_id[ep].get(sid) or all_spans.get(sid)
 
+    samples_by_edge: Dict[EdgeKey, List[float]] = {}
     for out_ep in out_span_partitions:
         preds = primary_pred_edges(dag, out_ep)
         # (in_ep -> out_ep): out.start - in.start
@@ -311,7 +312,7 @@ def refit_from_assignments(
                 out = span_of(assignments[out_ep], in_span, out_ep)
                 if out is not None:
                     samples.append(out.start_mus - in_span.start_mus)
-            dists[(in_ep, out_ep)] = EdgeDist.from_samples_gmm(samples)
+            samples_by_edge[(in_ep, out_ep)] = samples
         # (p -> out_ep): out.start - p_out.end
         for p in preds:
             if p == in_ep:
@@ -324,7 +325,7 @@ def refit_from_assignments(
                     samples.append(
                         out.start_mus - (p_out.start_mus + p_out.duration_mus)
                     )
-            dists[(p, out_ep)] = EdgeDist.from_samples_gmm(samples)
+            samples_by_edge[(p, out_ep)] = samples
         # (out_ep -> in_ep): in.end - out.end
         samples = []
         for in_span in in_span_partitions[in_ep]:
@@ -334,7 +335,47 @@ def refit_from_assignments(
                     (in_span.start_mus + in_span.duration_mus)
                     - (out.start_mus + out.duration_mus)
                 )
-        dists[(out_ep, in_ep)] = EdgeDist.from_samples_gmm(samples)
+        samples_by_edge[(out_ep, in_ep)] = samples
+    dists.update(fit_edge_gmms(samples_by_edge))
+    return dists
+
+
+def fit_edge_gmms(samples_by_edge: Dict[EdgeKey, List[float]],
+                  ) -> Dict[EdgeKey, EdgeDist]:
+    """Fit every edge's delay GMM in one batched device dispatch
+    (:func:`traceweaver_tpu.ops.gmm.fit_gmm_batched`); degenerate edges
+    (constant or < 4 samples) take the closed-form host path, and
+    ``TW_JAX_GMM=0`` falls back to the per-edge sklearn fit entirely."""
+    import os
+
+    use_device = os.environ.get("TW_JAX_GMM", "1") not in ("0", "false", "")
+    dists: Dict[EdgeKey, EdgeDist] = {}
+    device_keys: List[EdgeKey] = []
+    device_samples: List[np.ndarray] = []
+    for key, v in samples_by_edge.items():
+        arr = np.asarray(v, dtype=np.float64)
+        if not use_device or len(arr) < 4 or len(np.unique(arr)) == 1:
+            dists[key] = EdgeDist.from_samples_gmm(v)
+        else:
+            device_keys.append(key)
+            device_samples.append(arr)
+    if device_keys:
+        from traceweaver_tpu.ops.gmm import fit_gmm_batched
+
+        n = max(len(a) for a in device_samples)
+        n_pad = 1 << (n - 1).bit_length()
+        e_pad = 1 << (len(device_keys) - 1).bit_length()
+        x = np.zeros((e_pad, n_pad), dtype=np.float32)
+        mask = np.zeros((e_pad, n_pad), dtype=bool)
+        for i, a in enumerate(device_samples):
+            x[i, :len(a)] = a
+            mask[i, :len(a)] = True
+        w, mu, sd = (np.asarray(o) for o in
+                     fit_gmm_batched(x, mask, max_k=MAX_COMPONENTS))
+        for i, key in enumerate(device_keys):
+            dists[key] = EdgeDist(w[i].astype(np.float64),
+                                  mu[i].astype(np.float64),
+                                  sd[i].astype(np.float64))
     return dists
 
 

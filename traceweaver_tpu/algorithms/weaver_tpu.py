@@ -352,6 +352,7 @@ def pack_problem(
     pad_w: Optional[int] = None,
     pad_b: Optional[int] = None,
     pad_m: Optional[int] = None,
+    ranges: Optional[np.ndarray] = None,
 ) -> PackedProblem:
     """Build the dense [B, ...] window tensors for :func:`solve_windows`.
 
@@ -376,7 +377,8 @@ def pack_problem(
         ep: np.array([float(s.start_mus) for s in out_sorted[ep]]) for ep in out_eps
     }
 
-    ranges = candidate_ranges(in_spans, windows, out_eps, out_starts_np)
+    if ranges is None:  # caller may pass precomputed rows (same helper)
+        ranges = candidate_ranges(in_spans, windows, out_eps, out_starts_np)
     M = _bucket(max(int((ranges[:, :, 1] - ranges[:, :, 0]).max(initial=1)),
                     pad_m or 1))
 
@@ -531,18 +533,24 @@ class WeaverTPU:
         E = max(1, len(out_eps))
         n_sweeps = 1 if E == 1 else self.n_sweeps
 
-        # candidate-column width per size class via the same range helper the
-        # packer uses, so padding costs and the chunk budget reflect the true
-        # [B, W, M] block
+        # candidate ranges computed ONCE for all windows (the same rows the
+        # packer consumes), so padding costs and the chunk budget reflect
+        # the true [B, W, M] block without re-running searchsorted per class
         out_starts_np = {
             ep: np.array(sorted(float(s.start_mus)
                                 for s in out_span_partitions[ep]))
             for ep in out_eps
         }
+        ranges_all = candidate_ranges(
+            in_spans, all_windows, out_eps, out_starts_np)
+        width_of = {
+            w: int((ranges_all[i, :, 1] - ranges_all[i, :, 0]).max(initial=1))
+            for i, w in enumerate(all_windows)
+        }
+        row_of = {w: i for i, w in enumerate(all_windows)}
 
         def est_m(wins: List[Tuple[int, int]]) -> int:
-            r = candidate_ranges(in_spans, wins, out_eps, out_starts_np)
-            return _bucket(int((r[:, :, 1] - r[:, :, 0]).max(initial=1)))
+            return _bucket(max(width_of[w] for w in wins))
 
         # size classes (power-of-two widths), with smaller classes greedily
         # merged upward while the extra padded area stays under MERGE_ELEMS —
@@ -578,6 +586,7 @@ class WeaverTPU:
                     windows=chunk, pad_w=wclass,
                     pad_b=per_chunk if len(chunks) > 1 else None,
                     pad_m=m_est if len(chunks) > 1 else None,
+                    ranges=ranges_all[[row_of[w] for w in chunk]],
                 )
                 a = packed.arrays
                 out = solve_windows_packed(
@@ -640,6 +649,52 @@ class WeaverTPU:
                     if out_id in tks:
                         tks.remove(out_id)
                     all_topk[ep][in_id] = [out_id] + tks[: topk_cols.shape[3] - 1]
+
+    @staticmethod
+    def _resolve_cross_window_duplicates(all_assignments, all_topk, in_ids,
+                                         skip_budget):
+        """Restore global one-to-one-ness across capped sub-windows.
+
+        Perfect-cut segments are solved whole, so duplicates can only arise
+        when a segment longer than ``max_window`` was split and two
+        sub-windows both claimed an outgoing span from their (overlapping)
+        candidate ranges. Per contested out-span, the earliest incoming
+        span in time order (``in_ids`` order — the serial-peel convention)
+        keeps it; only the losers are reassigned, to their best-ranked
+        top-K alternative that no row (winner or not) holds, taking SKIP
+        only while the endpoint's global ``|in| - |out|`` budget
+        (traceweaver_v3.py:972) has room, else NA.
+        """
+        for ep, assign_map in all_assignments.items():
+            claims: Dict = {}
+            skips_used = 0
+            for in_id in in_ids:
+                out_id = assign_map.get(in_id)
+                if out_id == SKIP:
+                    skips_used += 1
+                elif out_id is not None and out_id != NA:
+                    claims.setdefault(out_id, []).append(in_id)
+            used = set(claims)
+            for out_id, claimants in claims.items():
+                for in_id in claimants[1:]:  # earliest claimant keeps it
+                    replacement = NA
+                    for cand in all_topk.get(ep, {}).get(in_id, []):
+                        if cand == SKIP:
+                            if skips_used < skip_budget.get(ep, 0):
+                                replacement = SKIP
+                                skips_used += 1
+                                break
+                            continue
+                        if cand != NA and cand not in used:
+                            replacement = cand
+                            break
+                    assign_map[in_id] = replacement
+                    if replacement not in (NA, SKIP):
+                        used.add(replacement)
+                    tk = all_topk.get(ep, {}).get(in_id)
+                    if tk and replacement in tk:
+                        tk.remove(replacement)
+                        tk.insert(0, replacement)
 
     # -- plugin entry point ------------------------------------------------
     def FindAssignments(self, method, process, in_span_partitions,
@@ -711,6 +766,8 @@ class WeaverTPU:
             per_span_candidates = {
                 in_ids[i]: int(span_cands[i]) for i in range(n_in)
             }
+            self._resolve_cross_window_duplicates(
+                all_assignments, all_topk, in_ids, skip_budget)
             if it + 1 < iterations:
                 dists = timing.refit_from_assignments(
                     in_span_partitions, out_span_partitions,

@@ -1,0 +1,116 @@
+"""Batched 1-D Gaussian-mixture fitting on device (the EM M-step).
+
+Replaces the reference's per-edge sklearn ``GaussianMixture`` loop with
+BIC selection over 1..K components (reference traceweaver_v3.py:764-786,
+``ComputeEpPairDistParams5``) with one jitted program: every call-graph
+edge's delay samples are padded into one ``[E, N]`` block, EM for each
+candidate component count runs vmapped over edges, and the best count per
+edge is selected by BIC on device. The host loop becomes a single
+dispatch — the M-step analogue of the solver's "one dispatch per solve"
+rule, and the single-chip version of the ``psum``-reduced refit in
+:mod:`traceweaver_tpu.parallel.mesh`.
+
+Numerics: samples are standardized per edge (fit in z-space, parameters
+transformed back) so f32 on the VPU holds precision for microsecond-scale
+delays; component stds are floored at 1 µs after the back-transform, the
+same floor the host fit applies (timing.py ``from_samples_gmm``).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+LOG_2PI = math.log(2.0 * math.pi)
+NEG = -1.0e9
+
+
+def _em_fixed_k(z, mask, k: int, max_k: int, n_iters: int):
+    """EM for one edge's standardized samples with k components.
+
+    z: [N] f32, mask: [N] bool. Returns (w, mu, sd, loglik) padded to
+    ``max_k`` components (zero-weight padding).
+    """
+    n_valid = jnp.maximum(jnp.sum(mask), 1.0)
+
+    # deterministic quantile init (replaces sklearn's k-means init): place
+    # component means at evenly spaced quantiles of the valid samples
+    qs = (jnp.arange(k, dtype=z.dtype) + 0.5) / k
+    z_sorted = jnp.sort(jnp.where(mask, z, jnp.inf))
+    idx = jnp.clip((qs * n_valid).astype(jnp.int32), 0,
+                   z.shape[0] - 1)
+    mu = z_sorted[idx]                                   # [k]
+    var = jnp.full((k,), 1.0, dtype=z.dtype)
+    w = jnp.full((k,), 1.0 / k, dtype=z.dtype)
+
+    def log_comp(mu, var, w):
+        d = z[:, None] - mu[None, :]                     # [N, k]
+        return (
+            -0.5 * d * d / var[None, :]
+            - 0.5 * jnp.log(var)[None, :]
+            - 0.5 * LOG_2PI
+            + jnp.log(jnp.maximum(w, 1e-30))[None, :]
+        )
+
+    def step(_, state):
+        w, mu, var = state
+        lc = log_comp(mu, var, w)                        # [N, k]
+        resp = jax.nn.softmax(lc, axis=1)
+        resp = jnp.where(mask[:, None], resp, 0.0)
+        nj = jnp.maximum(jnp.sum(resp, axis=0), 1e-6)    # [k]
+        w = nj / n_valid
+        mu = jnp.sum(resp * z[:, None], axis=0) / nj
+        d = z[:, None] - mu[None, :]
+        var = jnp.sum(resp * d * d, axis=0) / nj + 1e-6
+        return w, mu, var
+
+    w, mu, var = jax.lax.fori_loop(0, n_iters, step, (w, mu, var))
+    lc = log_comp(mu, var, w)
+    ll = jnp.sum(jnp.where(mask, jax.nn.logsumexp(lc, axis=1), 0.0))
+
+    pad = max_k - k
+    w = jnp.pad(w, (0, pad))
+    mu = jnp.pad(mu, (0, pad))
+    sd = jnp.pad(jnp.sqrt(var), (0, pad), constant_values=1.0)
+    return w, mu, sd, ll
+
+
+@partial(jax.jit, static_argnames=("max_k", "n_iters"))
+def fit_gmm_batched(samples, mask, max_k: int = 5, n_iters: int = 50):
+    """BIC-selected GMM fit for a batch of sample rows.
+
+    samples: [E, N] f32 (padded), mask: [E, N] bool. Returns (weights,
+    means, stds) each [E, max_k]; rows with < 2 distinct valid samples
+    degenerate gracefully to a single near-delta component.
+    """
+    n_valid = jnp.maximum(jnp.sum(mask, axis=1).astype(samples.dtype), 1.0)
+    mean = jnp.sum(jnp.where(mask, samples, 0.0), axis=1) / n_valid
+    var0 = jnp.sum(jnp.where(mask, (samples - mean[:, None]) ** 2, 0.0),
+                   axis=1) / n_valid
+    scale = jnp.sqrt(jnp.maximum(var0, 1e-12))
+    z = jnp.where(mask, (samples - mean[:, None]) / scale[:, None], 0.0)
+
+    def fit_edge(z_row, mask_row, nv):
+        outs = []
+        for k in range(1, max_k + 1):
+            w, mu, sd, ll = _em_fixed_k(z_row, mask_row, k, max_k, n_iters)
+            p = 3 * k - 1  # weights (k-1) + means (k) + vars (k)
+            bic = -2.0 * ll + p * jnp.log(nv)
+            # k components need at least k samples to be identifiable
+            bic = jnp.where(nv >= k, bic, jnp.inf)
+            outs.append((bic, w, mu, sd))
+        bics = jnp.stack([o[0] for o in outs])
+        best = jnp.argmin(bics)
+        w = jnp.stack([o[1] for o in outs])[best]
+        mu = jnp.stack([o[2] for o in outs])[best]
+        sd = jnp.stack([o[3] for o in outs])[best]
+        return w, mu, sd
+
+    w, mu, sd = jax.vmap(fit_edge)(z, mask, n_valid)
+    # back-transform to sample units; floor stds at 1 µs like the host fit
+    mu = mean[:, None] + scale[:, None] * mu
+    sd = jnp.where(w > 0, jnp.maximum(scale[:, None] * sd, 1.0), 1.0)
+    return w, mu, sd
