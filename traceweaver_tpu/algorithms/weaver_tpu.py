@@ -495,13 +495,17 @@ class WeaverTPU:
     """
 
     def __init__(self, all_spans, all_processes, max_window: int = DEFAULT_MAX_WINDOW,
-                 epsilon: float = 1.0, n_sinkhorn: int = 40, n_sweeps: int = 5):
+                 epsilon: float = 1.0, n_sinkhorn: int = 40, n_sweeps: int = 5,
+                 mesh=None):
         self.all_spans = all_spans
         self.all_processes = all_processes
         self.max_window = max_window
         self.epsilon = epsilon
         self.n_sinkhorn = n_sinkhorn
         self.n_sweeps = n_sweeps
+        # optional jax.sharding.Mesh: window batches shard over its first
+        # axis (XLA SPMD over ICI); None = single device
+        self.mesh = mesh
 
     # -- helpers -----------------------------------------------------------
     @staticmethod

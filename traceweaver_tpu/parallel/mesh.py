@@ -74,6 +74,23 @@ def _pad_batch(arrays: Dict[str, np.ndarray], multiple: int) -> Tuple[Dict[str, 
     return out, b
 
 
+def put_sharded(arrays: Dict[str, np.ndarray], mesh: Mesh) -> Dict[str, jax.Array]:
+    """Place packed window tensors on the mesh: batched (window-axis)
+    tensors sharded over the mesh axis, distribution/DAG params replicated.
+    The caller must have padded the batch to a multiple of the mesh size
+    (``pack_problem(pad_b=mesh.devices.size)`` guarantees it). XLA SPMD
+    then partitions any jitted solve over these inputs with collectives
+    over ICI — no per-device loop on the host."""
+    axis = mesh.axis_names[0]
+    batched = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
+    out = {}
+    for k, v in arrays.items():
+        out[k] = jax.device_put(
+            v, batched if k in BATCHED else replicated)
+    return out
+
+
 def shard_solve_windows(arrays: Dict[str, np.ndarray], mesh: Mesh,
                         **kwargs):
     """Run :func:`solve_windows` with the window axis sharded over ``mesh``.
