@@ -214,3 +214,26 @@ def test_fit_edge_gmms_degenerate_rows():
     })
     assert set(out) == {("a", "b"), ("a", "c"), ("a", "d")}
     assert abs(out[("a", "b")].means[0] - 5.0) < 1e-6
+
+
+def test_sinkhorn_dispatch_cpu_lowering_with_pallas_forced(monkeypatch):
+    """TW_PALLAS=1 with a CPU lowering target must not compile a
+    non-interpret Pallas kernel for CPU: platform selection happens at
+    lowering time (jax.lax.platform_dependent), so the CPU branch takes the
+    jnp path and matches it exactly (regression for the default-backend
+    vs mesh-devices dispatch mismatch)."""
+    from traceweaver_tpu.ops.pallas_sinkhorn import sinkhorn
+    from traceweaver_tpu.ops.sinkhorn import sinkhorn_log
+
+    monkeypatch.setenv("TW_PALLAS", "1")
+    monkeypatch.delenv("TW_PALLAS_INTERPRET", raising=False)
+    rng = np.random.default_rng(7)
+    n, m = 64, 128  # at/above the pallas size threshold
+    S = rng.normal(size=(n, m)).astype(np.float32)
+    r = np.ones(n, np.float32)
+    c = np.full(m, n / m, np.float32)
+    got = np.asarray(sinkhorn(jnp.asarray(S), jnp.asarray(r), jnp.asarray(c),
+                              epsilon=0.9, n_iters=40))
+    want = np.asarray(sinkhorn_log(jnp.asarray(S), jnp.asarray(r),
+                                   jnp.asarray(c), epsilon=0.9, n_iters=40))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)

@@ -231,16 +231,29 @@ def solve_windows(
             return (chosen_end, chosen_start, backward), (
                 assign, tk.astype(jnp.int32), not_best, feas_count)
 
-        state = (
+        def sweep_step(carry, sweep):
+            (chosen_end, chosen_start, _), _ = carry
+            state = (chosen_end, chosen_start, sweep > 0)
+            state, outs = jax.lax.scan(ep_step, state, jnp.arange(E))
+            # outs ride the carry (overwritten each sweep) so only the final
+            # sweep's outputs are ever materialized — stacking [n_sweeps, ...]
+            # then slicing would cost n_sweeps x the output memory
+            return (state, outs), None
+
+        init_state = (
             jnp.zeros((E, W), dtype=in_s.dtype),
             jnp.full((E, W), POS, dtype=in_s.dtype),
             jnp.asarray(False),
         )
-        outs = None
-        for sweep in range(n_sweeps):
-            chosen_end, chosen_start, _ = state
-            state = (chosen_end, chosen_start, jnp.asarray(sweep > 0))
-            state, outs = jax.lax.scan(ep_step, state, jnp.arange(E))
+        init_outs = (
+            jnp.zeros((E, W), dtype=jnp.int32),
+            jnp.zeros((E, W, topk), dtype=jnp.int32),
+            jnp.zeros((E, W), dtype=bool),
+            jnp.zeros((E, W), dtype=jnp.int32),
+        )
+        # one traced sweep body (compile surface independent of n_sweeps)
+        (_, outs), _ = jax.lax.scan(
+            sweep_step, (init_state, init_outs), jnp.arange(n_sweeps))
         return outs
 
     return jax.vmap(solve_one)(
@@ -625,34 +638,62 @@ class WeaverTPU:
     @staticmethod
     def _decode(packed: PackedProblem, assign: np.ndarray,
                 topk_cols: np.ndarray, all_assignments, all_topk):
-        """Device indices -> wire-format assignment dicts (merged in place)."""
+        """Device indices -> wire-format assignment dicts (merged in place).
+
+        Vectorized: column indices for a whole packed batch are translated
+        to span ids by one object-array gather per endpoint (the id tables
+        are [B*M] object arrays), so per-span Python work is only the final
+        dict insertion — not index arithmetic (at exp5 scale the decode is
+        otherwise host-bound).
+        """
         B, E, W = assign.shape
         M = packed.arrays["out_start"].shape[2]
-        for b, (lo, hi) in enumerate(packed.windows):
-            for i in range(hi - lo):
-                in_id = packed.in_ids[lo + i]
-                for e, ep in enumerate(packed.out_eps):
-                    col = int(assign[b, e, i])
-                    if col == M:
-                        out_id = SKIP
-                    elif col < 0:
-                        out_id = NA
-                    else:
-                        out_id = packed.out_ids[e][b * M + col] or NA
-                    all_assignments[ep][in_id] = out_id
-                    tks = []
-                    for k in range(topk_cols.shape[3]):
-                        c = int(topk_cols[b, e, i, k])
-                        if c == M:
-                            tks.append(SKIP)
-                        elif 0 <= c < M and packed.out_ids[e][b * M + c]:
-                            tks.append(packed.out_ids[e][b * M + c])
-                        else:
-                            tks.append(NA)
-                    # candidate 0 is the committed choice
-                    if out_id in tks:
-                        tks.remove(out_id)
-                    all_topk[ep][in_id] = [out_id] + tks[: topk_cols.shape[3] - 1]
+        K = topk_cols.shape[3]
+        # 0-d object holders let tuple sentinels assign under boolean masks
+        skip_v = np.empty((), dtype=object)
+        skip_v[()] = SKIP
+        na_v = np.empty((), dtype=object)
+        na_v[()] = NA
+
+        w_of = np.concatenate(
+            [np.full(hi - lo, b) for b, (lo, hi) in enumerate(packed.windows)]
+        )
+        i_of = np.concatenate(
+            [np.arange(hi - lo) for lo, hi in packed.windows]
+        )
+        span_ids = [
+            packed.in_ids[lo + i]
+            for lo, hi in packed.windows
+            for i in range(hi - lo)
+        ]
+
+        for e, ep in enumerate(packed.out_eps):
+            ids = np.empty(B * M, dtype=object)
+            ids[:] = packed.out_ids[e]
+
+            cols = assign[w_of, e, i_of]                       # [n]
+            chosen = ids[w_of * M + np.clip(cols, 0, M - 1)]
+            chosen[chosen == None] = na_v  # noqa: E711 — elementwise None test
+            chosen[cols < 0] = na_v
+            chosen[cols == M] = skip_v
+
+            tk = topk_cols[w_of, e, i_of, :]                   # [n, K]
+            tk_ids = ids[w_of[:, None] * M + np.clip(tk, 0, M - 1)]
+            tk_ids[tk_ids == None] = na_v  # noqa: E711
+            tk_ids[(tk < 0) | (tk > M)] = na_v
+            tk_ids[tk == M] = skip_v
+
+            amap = all_assignments[ep]
+            tmap = all_topk[ep]
+            chosen_l = chosen.tolist()
+            tk_l = tk_ids.tolist()
+            for j, in_id in enumerate(span_ids):
+                out_id = chosen_l[j]
+                tks = tk_l[j]
+                if out_id in tks:
+                    tks.remove(out_id)
+                amap[in_id] = out_id
+                tmap[in_id] = [out_id] + tks[: K - 1]
 
     @staticmethod
     def _resolve_cross_window_duplicates(all_assignments, all_topk, in_ids,

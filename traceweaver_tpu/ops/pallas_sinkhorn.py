@@ -131,15 +131,33 @@ def use_pallas() -> bool:
 def sinkhorn(scores, row_marginals, col_marginals, epsilon=1.0, n_iters=50):
     """Backend-dispatching Sinkhorn: the fused Pallas kernel on TPU (or when
     forced via TW_PALLAS=1), the pure-jnp path elsewhere. Small blocks stay
-    on the jnp path — lane padding to 128 would dominate them."""
+    on the jnp path — lane padding to 128 would dominate them.
+
+    Platform selection happens at *lowering* time via
+    ``jax.lax.platform_dependent``, not from the default backend: a jitted
+    solve can target CPU devices (e.g. the virtual-mesh fallback in
+    :func:`traceweaver_tpu.parallel.mesh.make_mesh`) while the default
+    backend is a TPU, and a non-interpret Pallas kernel must never lower
+    for CPU."""
     from traceweaver_tpu.ops.sinkhorn import sinkhorn_log
 
     n, m = scores.shape
-    if use_pallas() and n * m >= 64 * 128:
-        interpret = (not _tpu_backend()) or os.environ.get(
-            "TW_PALLAS_INTERPRET") == "1"
+    if not use_pallas() or n * m < 64 * 128:
+        return sinkhorn_log(scores, row_marginals, col_marginals,
+                            epsilon=epsilon, n_iters=n_iters)
+    if os.environ.get("TW_PALLAS_INTERPRET") == "1":
+        # explicit kernel-semantics testing off-TPU
         return sinkhorn_log_pallas(
             scores, row_marginals, col_marginals,
-            epsilon=epsilon, n_iters=n_iters, interpret=interpret)
-    return sinkhorn_log(scores, row_marginals, col_marginals,
-                        epsilon=epsilon, n_iters=n_iters)
+            epsilon=epsilon, n_iters=n_iters, interpret=True)
+
+    def _tpu_path(s, r, c):
+        return sinkhorn_log_pallas(s, r, c, epsilon=epsilon,
+                                   n_iters=n_iters, interpret=False)
+
+    def _other_path(s, r, c):
+        return sinkhorn_log(s, r, c, epsilon=epsilon, n_iters=n_iters)
+
+    return jax.lax.platform_dependent(
+        scores, row_marginals, col_marginals,
+        tpu=_tpu_path, axon=_tpu_path, default=_other_path)
