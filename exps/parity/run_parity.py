@@ -156,10 +156,33 @@ def main():
     ap.add_argument("--out", default=os.path.join(REPO, "exps/parity/results"))
     ap.add_argument("--max-traces", type=int, default=1000)
     ap.add_argument("--skip-slow", action="store_true",
-                    help="skip the DFS-based reference V1/V2 (minutes each)")
+                    help="skip the DFS-based reference V1/V2/V3 (minutes each)")
     ap.add_argument("--no-tpu", action="store_true",
                     help="skip the flagship TPU solver rows")
+    ap.add_argument("--methods", default=None,
+                    help="comma-separated registry method names to run")
+    ap.add_argument("--datasets", default=None,
+                    help="comma-separated dataset labels to run")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge results into an existing parity.json instead "
+                         "of overwriting other methods/datasets")
     args = ap.parse_args()
+    method_filter = set(args.methods.split(",")) if args.methods else None
+    dataset_filter = set(args.datasets.split(",")) if args.datasets else None
+    if (method_filter or dataset_filter) and not args.merge:
+        # a filtered run must never silently clobber the full parity record
+        # (parity.json AND the PARITY.md derived from it)
+        print("[parity] filters active: enabling --merge", file=sys.stderr)
+        args.merge = True
+
+    # Parity is a CPU correctness harness: pin JAX to the CPU backend unless
+    # told otherwise (the sandbox sitecustomize force-selects the remote
+    # "axon" TPU whose init can stall for minutes; env vars alone cannot
+    # override it — the config update can).
+    if os.environ.get("TW_PARITY_BACKEND", "cpu") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     from traceweaver_tpu.ingest import (
         build_service_problem, infer_invocation_dag, load_corpus,
@@ -170,6 +193,8 @@ def main():
     results = {}
 
     for label, path, fix in DATASETS:
+        if dataset_filter and label not in dataset_filter:
+            continue
         if not os.path.isdir(path):
             print(f"[parity] {label}: dataset missing, skipped", file=sys.stderr)
             continue
@@ -189,6 +214,8 @@ def main():
         for method, ref_dotted, ours_dotted, use_dag in PAIRS:
             if args.skip_slow and method in SLOW:
                 continue
+            if method_filter and method not in method_filter:
+                continue
             try:
                 ref_cls = _load_ref_class(ref_dotted)
                 table[f"{method}/reference"] = _run_one(
@@ -202,7 +229,10 @@ def main():
             except Exception as e:  # pragma: no cover
                 table[f"{method}/ours"] = {"error": repr(e)}
 
-        if not args.no_tpu:
+        flagship_wanted = (method_filter is None
+                           or "MaxScoreBatchSubsetWithSkips" in method_filter)
+        if (not args.no_tpu and flagship_wanted
+                and "MaxScoreBatchSubsetWithSkips/ours" not in table):
             from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
 
             table["Flagship(WeaverTPU)/ours"] = _run_one(
@@ -211,7 +241,14 @@ def main():
         results[label] = table
         print(f"[parity] {label} done", file=sys.stderr)
 
-    with open(os.path.join(args.out, "parity.json"), "w") as f:
+    json_path = os.path.join(args.out, "parity.json")
+    if args.merge and os.path.exists(json_path):
+        with open(json_path) as f:
+            merged = json.load(f)
+        for label, table in results.items():
+            merged.setdefault(label, {}).update(table)
+        results = merged
+    with open(json_path, "w") as f:
         json.dump(results, f, indent=2)
 
     # ---- markdown report -------------------------------------------------

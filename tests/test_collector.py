@@ -282,3 +282,82 @@ def test_http_heuristic():
     assert looks_like_http(b"GET /index HTTP/1.1\r\n")
     assert looks_like_http(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
     assert not looks_like_http(b"\x16\x03\x01")  # TLS hello
+
+
+def test_strace_runner_attaches_to_new_pids(tmp_path, monkeypatch):
+    """Runner attaches once per new PID and returns the log map (the live
+    attach itself is stubbed — no strace binary / ptrace in the sandbox)."""
+    from traceweaver_tpu.collector import strace_runner
+
+    pids_by_poll = iter([[101], [101, 202], [101, 202]])
+    attached = []
+
+    class FakeProc:
+        def poll(self):
+            return 0
+
+        def terminate(self):
+            pass
+
+    monkeypatch.setattr(strace_runner, "pgrep",
+                        lambda name: next(pids_by_poll, [101, 202]))
+    monkeypatch.setattr(strace_runner.shutil, "which", lambda _: "/usr/bin/strace")
+
+    def fake_attach(pid, out_path, string_limit=65536):
+        attached.append((pid, out_path))
+        return FakeProc()
+
+    monkeypatch.setattr(strace_runner, "attach_strace", fake_attach)
+    seen = strace_runner.run("search", out_dir=str(tmp_path), tag="7",
+                             duration=0.3, poll_interval=0.01, max_attempts=2)
+    assert sorted(seen) == [101, 202]
+    assert [p for p, _ in attached] == [101, 202]
+    assert all(f"output7-attempt" in path for _, path in attached)
+
+
+def test_strace_runner_keeps_captures_alive_until_duration(tmp_path, monkeypatch):
+    """Hitting max-attempts must stop NEW attachments, not terminate
+    in-flight captures before the requested window elapses."""
+    import time as _time
+
+    from traceweaver_tpu.collector import strace_runner
+
+    terminated_at = []
+    t0 = _time.monotonic()
+
+    class FakeProc:
+        def poll(self):
+            return None
+
+        def terminate(self):
+            terminated_at.append(_time.monotonic() - t0)
+
+    monkeypatch.setattr(strace_runner, "pgrep", lambda name: [11])
+    monkeypatch.setattr(strace_runner.shutil, "which",
+                        lambda _: "/usr/bin/strace")
+    monkeypatch.setattr(strace_runner, "attach_strace",
+                        lambda pid, path, string_limit=65536: FakeProc())
+    strace_runner.run("search", out_dir=str(tmp_path), duration=0.25,
+                      poll_interval=0.01, max_attempts=1)
+    assert terminated_at and terminated_at[0] >= 0.2
+
+
+def test_executor_compressed_tar_extraction(tmp_path):
+    """--compressed: <path>.tar.* is extracted before loading (reference
+    executor.py:854-855)."""
+    import json
+    import tarfile
+
+    from traceweaver_tpu.runtime.executor import maybe_uncompress
+
+    src = tmp_path / "payload"
+    src.mkdir()
+    (src / "t1.json").write_text(json.dumps({"data": []}))
+    archive = tmp_path / "ds.tar.gz"
+    with tarfile.open(archive, "w:gz") as tf:
+        tf.add(src / "t1.json", arcname="t1.json")
+    target = tmp_path / "ds"
+    maybe_uncompress(str(target))
+    assert (target / "t1.json").exists()
+    # idempotent: second call with files present is a no-op
+    maybe_uncompress(str(target))

@@ -1,0 +1,63 @@
+"""Water-filling skip allocation (reference TallySkipSpans/WaterFill,
+traceweaver_v3.py:853-989)."""
+
+import numpy as np
+
+from traceweaver_tpu.algorithms.skips import water_fill, water_fill_skip_caps
+
+
+def test_zero_budget_allocates_nothing():
+    alloc = water_fill(np.array([5.0, 1.0]), np.array([10.0, 10.0]), 0)
+    assert alloc.sum() == 0
+
+
+def test_budget_spent_up_to_capacity():
+    existing = np.array([8.0, 2.0, 5.0])
+    expected = np.array([10.0, 10.0, 10.0])
+    cap = np.maximum(expected - existing, 0)
+    for budget in [1, 3, 7, 15, 100]:
+        alloc = water_fill(existing, expected, budget)
+        assert np.all(alloc >= 0)
+        assert np.all(alloc <= cap + 1e-9)
+        assert alloc.sum() == min(budget, cap.sum())
+
+
+def test_fills_lowest_windows_first():
+    # water level: the emptiest window gets skips before fuller ones
+    existing = np.array([9.0, 1.0, 5.0])
+    expected = np.array([10.0, 10.0, 10.0])
+    alloc = water_fill(existing, expected, 4)
+    assert alloc[1] == 4  # all budget goes to the emptiest window
+    alloc = water_fill(existing, expected, 8)
+    # level ~ (8 + 1 + 5) / 2 = 7: window1 -> 6, window2 -> 2
+    assert alloc[1] > alloc[2] > 0
+    assert alloc[0] == 0
+
+
+def test_equalizes_water_level():
+    existing = np.array([0.0, 0.0, 0.0, 0.0])
+    expected = np.array([10.0, 10.0, 10.0, 10.0])
+    alloc = water_fill(existing, expected, 20)
+    assert alloc.sum() == 20
+    assert np.ptp(alloc + existing) <= 1  # near-equal levels
+
+def test_spill_into_capacity_when_level_capped():
+    # window 1 hits its cap; leftover spills to others
+    existing = np.array([0.0, 9.0])
+    expected = np.array([2.0, 30.0])
+    alloc = water_fill(existing, expected, 10)
+    assert alloc[0] == 2.0        # capped at expected - existing
+    assert alloc[1] == 8.0        # remainder spills here
+    assert alloc.sum() == 10
+
+
+def test_skip_caps_shape_and_budget_gate():
+    windows = [(0, 4), (4, 8), (8, 10)]
+    # E=2; ep0 has slack (budget 10-6=4), ep1 none (budget 10-12<0)
+    ranges = np.zeros((3, 2, 2), dtype=np.int64)
+    ranges[:, 0, 1] = [2, 2, 2]   # 2 candidates each window for ep0
+    ranges[:, 1, 1] = [4, 4, 4]
+    caps = water_fill_skip_caps(windows, ranges, 10, [6, 12])
+    assert caps.shape == (3, 2)
+    assert caps[:, 1].sum() == 0
+    assert caps[:, 0].sum() == 4

@@ -2,6 +2,8 @@
 
 import random
 
+import numpy as np
+
 import pytest
 
 from traceweaver_tpu.algorithms.weaver_exact import WeaverExact
@@ -178,3 +180,71 @@ def test_cross_window_duplicate_resolution_semantics():
     WeaverTPU._resolve_cross_window_duplicates(
         assignments, topk, [A, B], {ep: 1})
     assert assignments[ep][B] == SKIP          # budget 1: skip allowed
+
+
+def test_kde_edgedist_exact_for_few_samples():
+    """n <= K: the mixture IS the Gaussian KDE (component per sample)."""
+    import scipy.stats
+
+    from traceweaver_tpu.algorithms.timing import EdgeDist
+
+    samples = [100.0, 220.0, 370.0, 540.0]
+    d = EdgeDist.from_samples_kde(samples)
+    kde = scipy.stats.gaussian_kde(samples)  # scott bandwidth, like ours
+    xs = np.linspace(0.0, 700.0, 29)
+    np.testing.assert_allclose(
+        np.exp(d.logpdf(xs)), kde.evaluate(xs), rtol=1e-6, atol=1e-12)
+
+
+def test_kde_edgedist_binned_approximates_scipy():
+    import scipy.stats
+
+    from traceweaver_tpu.algorithms.timing import EdgeDist
+
+    rng = np.random.default_rng(3)
+    samples = np.concatenate([rng.normal(1000, 40, 300),
+                              rng.normal(4000, 120, 200)])
+    d = EdgeDist.from_samples_kde(samples)
+    kde = scipy.stats.gaussian_kde(samples)
+    xs = np.linspace(500, 4500, 41)
+    ours = np.exp(d.logpdf(xs))
+    ref = kde.evaluate(xs)
+    # binned to 5 components: coarse but must track the bimodal shape
+    assert np.corrcoef(ours, ref)[0, 1] > 0.97
+
+
+def test_weaver_tpu_kde_score_mode(hotel_store):
+    from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
+    from traceweaver_tpu.ingest import build_service_problem, infer_invocation_dag
+    from traceweaver_tpu.metrics import accuracy_for_service, get_ground_truth
+
+    store = hotel_store
+    svc = "frontend"
+    prob = build_service_problem(store, svc)
+    ta = get_ground_truth(prob.in_span_partitions, prob.out_span_partitions)
+    dag = infer_invocation_dag(prob.in_span_partitions,
+                               prob.out_span_partitions, ta, store)
+    algo = WeaverTPU(store.all_spans, store.all_processes, score_mode="kde")
+    out = algo.FindAssignments(
+        "MaxScoreBatchSubsetWithSkips", svc, prob.in_span_partitions,
+        prob.out_span_partitions, False, [], ta, dag)
+    acc = accuracy_for_service(out[0], ta, prob.in_span_partitions)
+    assert acc > 0.9
+
+
+def test_weaver_tpu_true_dist_ablation(hotel_store):
+    """WithTrueDist oracle ablation (reference executor.py:976-987) — the
+    GT-fed distributions path must run and score at least as well as the
+    default path."""
+    store = hotel_store
+    svc = "frontend"
+    prob = build_service_problem(store, svc)
+    ta = get_ground_truth(prob.in_span_partitions, prob.out_span_partitions)
+    dag = infer_invocation_dag(prob.in_span_partitions,
+                               prob.out_span_partitions, ta, store)
+    algo = WeaverTPU(store.all_spans, store.all_processes)
+    out = algo.FindAssignments(
+        "MaxScoreBatchSubsetWithTrueDist", svc, prob.in_span_partitions,
+        prob.out_span_partitions, False, [], ta, dag, true_dist=True)
+    acc = accuracy_for_service(out[0], ta, prob.in_span_partitions)
+    assert acc > 0.95

@@ -1,0 +1,117 @@
+"""Water-filling allocation of the per-endpoint skip budget across windows.
+
+The reference spreads each endpoint's global skip budget (|in| - |out|,
+traceweaver_v3.py:972) across time windows by water-filling
+(``TallySkipSpans``/``WaterFill``, traceweaver_v3.py:853-989): windows with
+fewer existing outgoing spans get skip slots first, raising every window's
+``existing + skips`` toward a common water level, each window capped at its
+expected span count; any leftover budget is spilled into windows that still
+have capacity. The DFS then draws skip spans from the window a candidate
+falls in (``FetchSkipFromWindow``, :820-842).
+
+Here the same allocation feeds the per-(window, endpoint) ``skip_cap``
+column capacity of the OT solve (:func:`..weaver_tpu.solve_windows`):
+windows are the solver's perfect-cut windows, "existing" is the endpoint's
+candidate count in the window's time range (the same rows the packer uses),
+and "expected" is the window's incoming-span count.
+
+First deliberate deviation: the reference's per-window cap mixes sorted and
+unsorted indices (``expected_spans[i] - sorted_existing_spans[i]``,
+traceweaver_v3.py:900-902) — harmless there because every window's expected
+count is the constant ``batch_size_mis``. Our windows have varying sizes,
+so the cap is computed with consistently aligned indices (the intended
+semantics). The second deviation (exact budget conservation) is documented
+at the level search in :func:`water_fill`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def water_fill(existing: np.ndarray, expected: np.ndarray,
+               budget: float) -> np.ndarray:
+    """Allocate ``budget`` skip slots across windows by water-filling.
+
+    Args:
+      existing: [n] count of real candidate spans per window.
+      expected: [n] window's incoming-span count (allocation cap is
+        ``max(expected - existing, 0)``).
+      budget: global skip budget for this endpoint (``|in| - |out|``).
+
+    Returns [n] float allocation, summing to
+    ``min(budget, sum(max(expected - existing, 0)))`` when budget > 0.
+    """
+    n = len(existing)
+    alloc = np.zeros(n)
+    if budget <= 0 or n == 0:
+        return alloc
+    existing = np.asarray(existing, dtype=np.float64)
+    expected = np.asarray(expected, dtype=np.float64)
+    cap = np.maximum(expected - existing, 0.0)
+
+    # Second deliberate deviation: the reference's level search iterates
+    # windows in *descending* existing-count order and can over-allocate
+    # (exceed the budget) whenever the break condition never fires while
+    # some window sits above the level — harmless there because its skip
+    # slots are upper bounds the DFS may ignore. We solve the intended
+    # problem exactly: the unique water level L with
+    # spend(L) = sum_j min(max(L - existing_j, 0), cap_j) = budget.
+    def spend(level: float) -> float:
+        return float(np.minimum(np.maximum(level - existing, 0.0), cap).sum())
+
+    hi = float((existing + cap).max())
+    if spend(hi) <= budget:
+        return cap.copy()  # budget exceeds total capacity: fill everything
+    lo = float(existing.min())
+    for _ in range(64):
+        mid = 0.5 * (lo + hi)
+        if spend(mid) > budget:
+            hi = mid
+        else:
+            lo = mid
+    frac = np.minimum(np.maximum(lo - existing, 0.0), cap)
+    alloc = np.floor(frac)
+
+    # distribute the integer remainder one slot at a time to the windows
+    # with the lowest current level that still have capacity (the
+    # reference's leftover spill, traceweaver_v3.py:905-914)
+    remaining = int(budget - alloc.sum())
+    if remaining > 0:
+        level = existing + alloc
+        headroom = alloc < cap
+        order = np.argsort(level, kind="stable")
+        for w in order:
+            if remaining <= 0:
+                break
+            if headroom[w]:
+                alloc[w] += 1
+                remaining -= 1
+    return alloc
+
+
+def water_fill_skip_caps(
+    windows: List[Tuple[int, int]],
+    ranges: np.ndarray,          # [B, E, 2] candidate index ranges
+    n_in: int,
+    out_counts: List[int],       # per endpoint, |out|
+) -> np.ndarray:
+    """Per-(window, endpoint) skip capacities from water-filled budgets.
+
+    Returns [B, E] float32. Endpoints with no slack (budget <= 0) get zero
+    rows (the solver still grants window-local slack where a window has
+    fewer candidates than incoming spans — feasibility, not budget).
+    """
+    B = len(windows)
+    E = len(out_counts)
+    expected = np.array([hi - lo for lo, hi in windows], dtype=np.float64)
+    caps = np.zeros((B, E), dtype=np.float32)
+    for e in range(E):
+        budget = n_in - out_counts[e]
+        if budget <= 0:
+            continue
+        existing = (ranges[:, e, 1] - ranges[:, e, 0]).astype(np.float64)
+        caps[:, e] = water_fill(existing, expected, float(budget))
+    return caps

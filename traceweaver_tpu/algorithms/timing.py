@@ -98,6 +98,48 @@ class EdgeDist:
         s[:k] = np.maximum(np.sqrt(best.covariances_.ravel()), 1.0)
         return cls(w, m, s)
 
+    @classmethod
+    def from_samples_kde(cls, samples: Sequence[float],
+                         max_components: int = MAX_COMPONENTS) -> "EdgeDist":
+        """Gaussian-KDE density as a fixed-shape mixture.
+
+        The reference's KDE score mode evaluates a ``scipy.gaussian_kde``
+        over the raw per-edge delays (reference traceweaver_v1.py:117-121);
+        a Gaussian KDE *is* an equal-weight mixture of n components at the
+        samples with the bandwidth as std, so for n <= K this is exact.
+        For n > K the samples are quantile-binned into K components with
+        moment-matched stds (sqrt(h^2 + within-bin variance)) — a binned
+        KDE, fixed-shape for the device. Bandwidth is Scott's rule
+        (h = sigma * n^(-1/5)), scipy's default.
+        """
+        x = np.asarray(samples, dtype=np.float64).ravel()
+        if len(x) == 0:
+            return cls.gaussian(0.0, MIN_STD)
+        n = len(x)
+        sigma = float(np.std(x, ddof=1)) if n > 1 else 0.0
+        if sigma <= 0:
+            return cls.gaussian(float(x[0]), MIN_STD)
+        h = sigma * n ** (-1.0 / 5.0)
+        K = max_components
+        w = np.zeros(MAX_COMPONENTS)
+        m = np.zeros(MAX_COMPONENTS)
+        s = np.full(MAX_COMPONENTS, 1.0)
+        if n <= K:
+            w[:n] = 1.0 / n
+            m[:n] = x
+            s[:n] = max(h, 1.0)
+        else:
+            edges = np.quantile(x, np.linspace(0, 1, K + 1))
+            idx = np.clip(np.searchsorted(edges, x, side="right") - 1, 0, K - 1)
+            for k in range(K):
+                sel = x[idx == k]
+                if len(sel) == 0:
+                    continue
+                w[k] = len(sel) / n
+                m[k] = float(np.mean(sel))
+                s[k] = max(math.sqrt(h * h + float(np.var(sel))), 1.0)
+        return cls(w, m, s)
+
     def logpdf(self, x: np.ndarray) -> np.ndarray:
         """Mixture log-density (numpy; the device version lives in ops)."""
         x = np.asarray(x, dtype=np.float64)[..., None]
@@ -109,6 +151,24 @@ class EdgeDist:
         w = np.where(self.weights > 0, self.weights, 0.0)
         logw = np.where(w > 0, np.log(np.maximum(w, 1e-300)), -np.inf)
         return np.asarray(np.logaddexp.reduce(comp + logw, axis=-1))
+
+
+def fit_value_dists(values_by_edge: Dict[EdgeKey, List[float]],
+                    score_mode: str = "mixture",
+                    mixture_fit: str = "gaussian") -> Dict[EdgeKey, EdgeDist]:
+    """Single dispatch point for turning per-edge delay samples into
+    :class:`EdgeDist`s: ``score_mode == "kde"`` -> binned-KDE mixture
+    (reference traceweaver_v1.py:117-121 KDE branch); otherwise
+    ``mixture_fit`` picks single Gaussians or batched BIC-GMMs."""
+    if score_mode == "kde":
+        return {k: EdgeDist.from_samples_kde(v)
+                for k, v in values_by_edge.items()}
+    if mixture_fit == "gmm":
+        return fit_edge_gmms(values_by_edge)
+    return {
+        k: EdgeDist.gaussian(float(np.mean(v)), float(np.std(v)))
+        for k, v in values_by_edge.items()
+    }
 
 
 def batch_means_params(t1: Sequence[float], t2: Sequence[float],
@@ -205,10 +265,12 @@ def bootstrap_distributions(
     out_eps: List[str],
     store_processes=None,
     store_spans=None,
+    score_mode: str = "mixture",
 ) -> Dict[EdgeKey, EdgeDist]:
     """Unsupervised bootstrap: attribute each span to its nearest plausible
     preceding parent in a merged time-sorted stream (reference
-    traceweaver_v3.py:108-172).
+    traceweaver_v3.py:108-172). ``score_mode == "kde"`` fits each edge's
+    bootstrap samples as a binned-KDE mixture instead of a single Gaussian.
     """
     in_ep = next(iter(in_span_partitions))
     tagged: List[Tuple[Span, str]] = []
@@ -264,10 +326,7 @@ def bootstrap_distributions(
                 )
             values.setdefault((ep, ep), []).append(dur)
 
-    return {
-        key: EdgeDist.gaussian(float(np.mean(v)), float(np.std(v)))
-        for key, v in values.items()
-    }
+    return fit_value_dists(values, score_mode)
 
 
 def refit_from_assignments(
@@ -276,9 +335,12 @@ def refit_from_assignments(
     dag: nx.DiGraph,
     assignments: Dict[str, Dict],
     all_spans: Dict,
+    score_mode: str = "mixture",
 ) -> Dict[EdgeKey, EdgeDist]:
     """EM refit: per-edge delay samples from a completed assignment pass,
-    fit as BIC-selected GMMs (reference traceweaver_v3.py:706-818).
+    fit as BIC-selected GMMs (reference traceweaver_v3.py:706-818), or as
+    binned-KDE mixtures when ``score_mode == "kde"`` (the reference's KDE
+    score branch, traceweaver_v1.py:117-121).
 
     Spans are resolved from ``out_span_partitions`` (not ``all_spans``) so
     that synthetic transforms applied to the partitions — load compression,
@@ -336,7 +398,8 @@ def refit_from_assignments(
                     - (out.start_mus + out.duration_mus)
                 )
         samples_by_edge[(out_ep, in_ep)] = samples
-    dists.update(fit_edge_gmms(samples_by_edge))
+    dists.update(fit_value_dists(samples_by_edge, score_mode,
+                                 mixture_fit="gmm"))
     return dists
 
 
@@ -365,7 +428,9 @@ def fit_edge_gmms(samples_by_edge: Dict[EdgeKey, List[float]],
         n = max(len(a) for a in device_samples)
         n_pad = 1 << (n - 1).bit_length()
         e_pad = 1 << (len(device_keys) - 1).bit_length()
-        x = np.zeros((e_pad, n_pad), dtype=np.float32)
+        # f64 all the way to fit_gmm_batched's host-side standardization —
+        # packing in f32 here would forfeit the precision it preserves
+        x = np.zeros((e_pad, n_pad), dtype=np.float64)
         mask = np.zeros((e_pad, n_pad), dtype=bool)
         for i, a in enumerate(device_samples):
             x[i, :len(a)] = a
@@ -384,6 +449,7 @@ def true_distributions(
     out_span_partitions: Dict[str, List[Span]],
     out_eps: List[str],
     true_assignments: Dict[str, Dict],
+    score_mode: str = "mixture",
 ) -> Dict[EdgeKey, EdgeDist]:
     """Oracle distributions from ground truth (reference
     traceweaver_v3.py:66-106 ``BuildTrueDistributions``) — used by the
@@ -418,7 +484,4 @@ def true_distributions(
                 (in_span.start_mus + in_span.duration_mus)
                 - (prev_span.start_mus + prev_span.duration_mus)
             )
-    return {
-        key: EdgeDist.gaussian(float(np.mean(v)), float(np.std(v)))
-        for key, v in values.items()
-    }
+    return fit_value_dists(values, score_mode)

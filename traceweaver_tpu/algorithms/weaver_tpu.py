@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import networkx as nx
 
 from traceweaver_tpu.algorithms import timing
+from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
 from traceweaver_tpu.algorithms.timing import MAX_COMPONENTS, EdgeDist
 from traceweaver_tpu.metrics.accuracy import get_out_eps_in_order
 from traceweaver_tpu.ops.pallas_sinkhorn import sinkhorn
@@ -59,6 +60,7 @@ from traceweaver_tpu.spans import NA, SKIP, Span
 NEG = -1.0e9
 SKIP_MARGIN = 4.0    # log-space margin a real candidate must beat to avoid skip
 SKIP_FLOOR = -60.0   # skip score floor so candidate-less rows still take skip
+MIN_TOPK_MASS = 1e-3  # top-K fallback candidates need at least this plan mass
 # Perfect-cut segments are solved whole (global one-to-one marginals) up to
 # this cap; only beyond it do we fall back to capped sub-windows, which can
 # double-assign an outgoing span across the artificial boundary. 1024 keeps
@@ -214,8 +216,13 @@ def solve_windows(
             assign = greedy_round(plan, in_v, col_valid,
                                   cap_e.astype(jnp.int32), n_steps=W)
 
-            # per-endpoint top-K candidate columns by plan mass
-            _, tk = jax.lax.top_k(jnp.where(col_valid[None, :], plan, NEG), topk)
+            # per-endpoint top-K candidate columns by plan mass; columns
+            # with negligible mass (timing-infeasible: score NEG -> plan
+            # ~ 0) are dropped to -1 so cross-window duplicate resolution
+            # can never fall back onto an infeasible out-span
+            tk_mass, tk = jax.lax.top_k(
+                jnp.where(col_valid[None, :], plan, NEG), topk)
+            tk = jnp.where(tk_mass > MIN_TOPK_MASS, tk, -1)
 
             # chosen completion: skip passes the predecessor time through
             real = (assign >= 0) & (assign < M)
@@ -366,6 +373,7 @@ def pack_problem(
     pad_b: Optional[int] = None,
     pad_m: Optional[int] = None,
     ranges: Optional[np.ndarray] = None,
+    skip_caps: Optional[np.ndarray] = None,  # [len(windows), E] water-filled
 ) -> PackedProblem:
     """Build the dense [B, ...] window tensors for :func:`solve_windows`.
 
@@ -427,7 +435,11 @@ def pack_problem(
             out_valid[b, e, :m_w] = True
             for j, s in enumerate(cands):
                 out_ids[e][b * M + j] = s.GetId()
-            skip_cap[b, e] = max(0, n_w - m_w)
+            # water-filled budget when provided (reference TallySkipSpans
+            # semantics); the solver still grants window-local slack
+            # max(rows - cols, 0) on device for feasibility
+            skip_cap[b, e] = (float(skip_caps[b, e]) if skip_caps is not None
+                              else max(0, n_w - m_w))
             if force_skip_ids:
                 fs = force_skip_ids.get(ep, set())
                 n_forced = 0
@@ -509,7 +521,7 @@ class WeaverTPU:
 
     def __init__(self, all_spans, all_processes, max_window: int = DEFAULT_MAX_WINDOW,
                  epsilon: float = 1.0, n_sinkhorn: int = 40, n_sweeps: int = 5,
-                 mesh=None):
+                 mesh=None, score_mode: str = "mixture"):
         self.all_spans = all_spans
         self.all_processes = all_processes
         self.max_window = max_window
@@ -519,6 +531,10 @@ class WeaverTPU:
         # optional jax.sharding.Mesh: window batches shard over its first
         # axis (XLA SPMD over ICI); None = single device
         self.mesh = mesh
+        # "mixture" (default: Gaussian / BIC-GMM, reference norm+GMM score
+        # branches) or "kde" (binned-KDE mixtures, reference
+        # traceweaver_v1.py:117-121 KDE branch)
+        self.score_mode = score_mode
 
     # -- helpers -----------------------------------------------------------
     @staticmethod
@@ -560,6 +576,12 @@ class WeaverTPU:
         }
         ranges_all = candidate_ranges(
             in_spans, all_windows, out_eps, out_starts_np)
+        # per-endpoint global skip budget spread across windows by
+        # water-filling (reference TallySkipSpans, traceweaver_v3.py:853-989)
+        skip_caps_all = water_fill_skip_caps(
+            all_windows, ranges_all, len(in_spans),
+            [len(out_span_partitions[ep]) for ep in out_eps],
+        )
         width_of = {
             w: int((ranges_all[i, :, 1] - ranges_all[i, :, 0]).max(initial=1))
             for i, w in enumerate(all_windows)
@@ -584,7 +606,11 @@ class WeaverTPU:
             wins = carry + groups[c]
             if idx + 1 < len(classes):
                 nxt = classes[idx + 1]
-                if len(wins) * (nxt - c) * est_m(wins) * E <= MERGE_ELEMS:
+                # charge each window from its ORIGINAL class — a window
+                # carried across several merges compounds padding that a
+                # per-step (nxt - c) charge would undercount
+                extra = sum(nxt - _bucket(hi - lo) for lo, hi in wins)
+                if extra * est_m(wins) * E <= MERGE_ELEMS:
                     carry = wins
                     continue
             batches_spec.append((c, wins))
@@ -604,6 +630,7 @@ class WeaverTPU:
                     pad_b=per_chunk if len(chunks) > 1 else None,
                     pad_m=m_est if len(chunks) > 1 else None,
                     ranges=ranges_all[[row_of[w] for w in chunk]],
+                    skip_caps=skip_caps_all[[row_of[w] for w in chunk]],
                 )
                 a = packed.arrays
                 out = solve_windows_packed(
@@ -771,11 +798,13 @@ class WeaverTPU:
         # -- initial distributions ------------------------------------
         if true_dist:
             dists = timing.true_distributions(
-                in_span_partitions, out_span_partitions, out_eps, true_assignments
+                in_span_partitions, out_span_partitions, out_eps,
+                true_assignments, score_mode=self.score_mode,
             )
         elif dynamism or invocation_graph is None:
             dists = timing.bootstrap_distributions(
-                in_span_partitions, out_span_partitions, out_eps
+                in_span_partitions, out_span_partitions, out_eps,
+                score_mode=self.score_mode,
             )
         else:
             dists = timing.estimate_edge_params(
@@ -817,6 +846,7 @@ class WeaverTPU:
                 dists = timing.refit_from_assignments(
                     in_span_partitions, out_span_partitions,
                     invocation_graph, all_assignments, self.all_spans,
+                    score_mode=self.score_mode,
                 )
 
         cnt_unassigned = sum(

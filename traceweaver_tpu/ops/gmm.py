@@ -10,10 +10,11 @@ dispatch — the M-step analogue of the solver's "one dispatch per solve"
 rule, and the single-chip version of the ``psum``-reduced refit in
 :mod:`traceweaver_tpu.parallel.mesh`.
 
-Numerics: samples are standardized per edge (fit in z-space, parameters
-transformed back) so f32 on the VPU holds precision for microsecond-scale
-delays; component stds are floored at 1 µs after the back-transform, the
-same floor the host fit applies (timing.py ``from_samples_gmm``).
+Numerics: samples are standardized per edge on HOST in f64 (fit in
+z-space on device, parameters transformed back in f64) so neither the
+mean nor the variance loses resolution for large-microsecond delays;
+component stds are floored at 1 µs after the back-transform, the same
+floor the host fit applies (timing.py ``from_samples_gmm``).
 """
 
 from __future__ import annotations
@@ -78,20 +79,44 @@ def _em_fixed_k(z, mask, k: int, max_k: int, n_iters: int):
     return w, mu, sd, ll
 
 
-@partial(jax.jit, static_argnames=("max_k", "n_iters"))
 def fit_gmm_batched(samples, mask, max_k: int = 5, n_iters: int = 50):
     """BIC-selected GMM fit for a batch of sample rows.
 
-    samples: [E, N] f32 (padded), mask: [E, N] bool. Returns (weights,
-    means, stds) each [E, max_k]; rows with < 2 distinct valid samples
-    degenerate gracefully to a single near-delta component.
+    samples: [E, N] (padded), mask: [E, N] bool. Returns (weights, means,
+    stds) each [E, max_k] as f64 ndarrays; rows with < 2 distinct valid
+    samples degenerate gracefully to a single near-delta component.
+
+    The per-edge standardization runs on HOST in f64: delays above ~2^24 µs
+    lose unit resolution in f32, and large-mean/small-spread edges suffer
+    catastrophic cancellation in the raw-sample variance. The device fit
+    only ever sees pre-standardized z (O(1) values, f32-safe); parameters
+    are transformed back in f64.
     """
-    n_valid = jnp.maximum(jnp.sum(mask, axis=1).astype(samples.dtype), 1.0)
-    mean = jnp.sum(jnp.where(mask, samples, 0.0), axis=1) / n_valid
-    var0 = jnp.sum(jnp.where(mask, (samples - mean[:, None]) ** 2, 0.0),
-                   axis=1) / n_valid
-    scale = jnp.sqrt(jnp.maximum(var0, 1e-12))
-    z = jnp.where(mask, (samples - mean[:, None]) / scale[:, None], 0.0)
+    import numpy as np
+
+    samples = np.asarray(samples, dtype=np.float64)
+    mask_np = np.asarray(mask, dtype=bool)
+    n_valid = np.maximum(mask_np.sum(axis=1).astype(np.float64), 1.0)
+    mean = np.where(mask_np, samples, 0.0).sum(axis=1) / n_valid
+    # two-pass (shifted) variance in f64 — no cancellation
+    d = np.where(mask_np, samples - mean[:, None], 0.0)
+    var0 = (d * d).sum(axis=1) / n_valid
+    scale = np.sqrt(np.maximum(var0, 1e-12))
+    z = np.where(mask_np, d / scale[:, None], 0.0).astype(np.float32)
+
+    w, mu_z, sd_z = _fit_gmm_z(z, mask_np, max_k=max_k, n_iters=n_iters)
+    w = np.asarray(w, dtype=np.float64)
+    mu = mean[:, None] + scale[:, None] * np.asarray(mu_z, dtype=np.float64)
+    sd = np.where(w > 0,
+                  np.maximum(scale[:, None] * np.asarray(sd_z, np.float64),
+                             1.0), 1.0)
+    return w, mu, sd
+
+
+@partial(jax.jit, static_argnames=("max_k", "n_iters"))
+def _fit_gmm_z(z, mask, max_k: int = 5, n_iters: int = 50):
+    """Device fit over pre-standardized samples; returns z-space params."""
+    n_valid = jnp.maximum(jnp.sum(mask, axis=1).astype(z.dtype), 1.0)
 
     def fit_edge(z_row, mask_row, nv):
         outs = []
@@ -109,8 +134,4 @@ def fit_gmm_batched(samples, mask, max_k: int = 5, n_iters: int = 50):
         sd = jnp.stack([o[3] for o in outs])[best]
         return w, mu, sd
 
-    w, mu, sd = jax.vmap(fit_edge)(z, mask, n_valid)
-    # back-transform to sample units; floor stds at 1 µs like the host fit
-    mu = mean[:, None] + scale[:, None] * mu
-    sd = jnp.where(w > 0, jnp.maximum(scale[:, None] * sd, 1.0), 1.0)
-    return w, mu, sd
+    return jax.vmap(fit_edge)(z, mask, n_valid)

@@ -184,10 +184,34 @@ class ExperimentResults:
     store: TraceStore
 
 
+def maybe_uncompress(data_path: str) -> None:
+    """``--compressed`` support: extract ``<data_path>.tar.*`` next to the
+    dataset before loading (reference executor.py:854-855 + the reference's
+    tar helper, helpers/misc.py:11-14; the reference spells the suffix
+    ``.tar.lama``). Idempotent — skipped when the directory already has
+    trace files."""
+    import tarfile
+
+    if os.path.isdir(data_path) and any(
+        name.endswith(".json") for name in os.listdir(data_path)
+    ):
+        return
+    for suffix in (".tar.lama", ".tar.lzma", ".tar.xz", ".tar.gz", ".tar"):
+        archive = data_path + suffix
+        if os.path.exists(archive):
+            with tarfile.open(archive) as tf:
+                tf.extractall(data_path + "/", filter="data")
+            return
+    raise FileNotFoundError(
+        f"--compressed: no archive found at {data_path}.tar.*")
+
+
 def run_experiment(cfg: ExecutorConfig,
                    store: Optional[TraceStore] = None) -> ExperimentResults:
     random.seed(10)
     if store is None:
+        if cfg.compressed:
+            maybe_uncompress(cfg.data_path)
         store = load_corpus(cfg.data_path, cfg.fix, max_traces=cfg.max_traces,
                             clear_cache=cfg.clear_cache)
 
