@@ -118,7 +118,7 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
     from traceweaver_tpu.metrics import accuracy_for_service
 
-    def one_pass():
+    def one_pass(stage_stats=None):
         preds = {}
         for svc, prob, ta, dag in problems:
             algo = WeaverTPU(store.all_spans, store.all_processes)
@@ -128,6 +128,9 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
                 False, [], ta, dag,
             )
             preds[svc] = out[0]
+            if stage_stats is not None:
+                for k, v in algo.stats.items():
+                    stage_stats[k] = stage_stats.get(k, 0.0) + v
             log(f"child: warm/solve {svc} done")
         return preds
 
@@ -136,9 +139,16 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     warmup_time = time.perf_counter() - t0
     log(f"child: warm-up (compile) pass {warmup_time:.1f}s")
 
+    profile_dir = os.environ.get("TW_BENCH_PROFILE_DIR")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+    stage_stats: dict = {}
     t0 = time.perf_counter()
-    preds = one_pass()
+    preds = one_pass(stage_stats)
     solve_time = time.perf_counter() - t0
+    if profile_dir:
+        jax.profiler.stop_trace()
+        log(f"child: profiler trace written to {profile_dir}")
     n_spans = sum(
         len(next(iter(prob.in_span_partitions.values())))
         for _, prob, _, _ in problems
@@ -170,6 +180,16 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
             log(f"child: pallas on-device check failed: {type(e).__name__}: {e}")
             pallas_ok = False
 
+    # Utilization estimates from the solver's analytic op accounting.
+    # Peaks: TPU v5e ~197 TFLOP/s bf16 MXU (the headline "MFU" denominator;
+    # this pipeline is f32/VPU-heavy, so its MFU is structurally small) and
+    # ~819 GB/s HBM — bandwidth utilization is the honest roofline for the
+    # Sinkhorn inner loop under plain XLA.
+    device_s = stage_stats.get("wait_s", 0.0) or solve_time
+    flops = stage_stats.get("flops_est", 0.0)
+    bytes_key = ("bytes_est_pallas" if pallas_ok else "bytes_est_xla")
+    peak_flops = 197e12 if backend in ("tpu", "axon") else 2e11
+    peak_bw = 819e9 if backend in ("tpu", "axon") else 5e10
     report = {
         "backend": backend,
         "n_spans": n_spans,
@@ -178,6 +198,16 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         "spans_per_sec": n_spans / solve_time,
         "accuracy_mean": sum(accs.values()) / len(accs),
         "pallas_on_device_ok": pallas_ok,
+        "stage_seconds": {
+            k: round(stage_stats.get(k, 0.0), 3)
+            for k in ("pack_s", "dispatch_s", "wait_s", "decode_s", "refit_s")
+        },
+        "flops_est": flops,
+        "mfu_est_pct": round(100.0 * flops / max(device_s, 1e-9)
+                             / peak_flops, 4),
+        "hbm_util_est_pct": round(
+            100.0 * stage_stats.get(bytes_key, 0.0)
+            / max(device_s, 1e-9) / peak_bw, 2),
     }
     with open(out_path, "w") as f:
         json.dump(report, f)
@@ -346,6 +376,9 @@ def main() -> None:
         "solve_time_s": round(solver["solve_time_s"], 2),
         "warmup_compile_s": round(solver["warmup_time_s"], 2),
         "pallas_on_device_ok": solver.get("pallas_on_device_ok"),
+        "stage_seconds": solver.get("stage_seconds"),
+        "mfu_est_pct": solver.get("mfu_est_pct"),
+        "hbm_util_est_pct": solver.get("hbm_util_est_pct"),
     }
     print(json.dumps(result))
 

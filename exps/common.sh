@@ -13,6 +13,8 @@ PYTHON="${PYTHON:-python3}"
 run_executor() {
     # args: rel_data compressed cache_rate fix test_name load compress repeat
     #       exec_parallel results_dir clear_cache predictor_indices
+    # TW_SERIAL=1 runs configs synchronously (single-core hosts; the
+    # reference always backgrounds, exps/exp1/run_experiment.sh:74-78)
     "$PYTHON" "$REPO_ROOT/executor.py" \
         --absolute_path "$TW_DATA/$1" \
         --compressed "$2" \
@@ -25,5 +27,8 @@ run_executor() {
         --execute_parallel "$9" \
         --results_directory "${10}" \
         --clear_cache "${11}" \
-        --predictor_indices "${12}" &
+        --predictor_indices "${12}" ${TW_SERIAL:+} &
+    if [ -n "${TW_SERIAL:-}" ]; then
+        wait $!
+    fi
 }

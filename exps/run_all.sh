@@ -1,0 +1,26 @@
+#!/bin/bash
+# Sequential driver for the full experiment reproduction: exp1..exp5 plus
+# figures, one after another. The reference backgrounds many executor
+# processes per experiment (fine on a multicore workstation); this box has
+# a single core, so concurrency only thrashes — TW_SERIAL=1 makes
+# common.sh's run_executor synchronous.
+#
+# Usage: bash exps/run_all.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR="${1:-exps/logs}"
+mkdir -p "$LOGDIR"
+
+for exp in exp1 exp2 exp3 exp4 exp5; do
+    echo "=== $exp start $(date +%H:%M:%S) ==="
+    data="${TW_DATA:-/root/reference/data}"
+    if [ "$exp" = exp5 ]; then
+        # exp5 inputs are regenerated locally (reference ships them only as
+        # a git-LFS pointer); never write into the read-only reference tree
+        data="${TW_DATA_ALIBABA:-$PWD/data}"
+    fi
+    TW_SERIAL=1 TW_DATA="$data" bash "exps/$exp/run_experiment.sh" 0 \
+        >"$LOGDIR/$exp.log" 2>&1
+    echo "=== $exp done rc=$? $(date +%H:%M:%S) ==="
+done
+echo "all experiments done"

@@ -535,6 +535,9 @@ class WeaverTPU:
         # branches) or "kde" (binned-KDE mixtures, reference
         # traceweaver_v1.py:117-121 KDE branch)
         self.score_mode = score_mode
+        # per-solve stage accounting (seconds / analytic op counts),
+        # populated by FindAssignments; read by the benchmark
+        self.stats: Dict[str, float] = {}
 
     # -- helpers -----------------------------------------------------------
     @staticmethod
@@ -616,6 +619,9 @@ class WeaverTPU:
             batches_spec.append((c, wins))
             carry = []
 
+        import time as _time
+
+        stats = self.stats
         pending = []
         for wclass, wins in batches_spec:
             m_est = est_m(wins)
@@ -623,6 +629,7 @@ class WeaverTPU:
             chunks = [wins[i:i + per_chunk]
                       for i in range(0, len(wins), per_chunk)]
             for chunk in chunks:
+                t0 = _time.perf_counter()
                 packed = pack_problem(
                     in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
                     force_skip_ids=force_skip_ids, parallel=parallel,
@@ -632,7 +639,30 @@ class WeaverTPU:
                     ranges=ranges_all[[row_of[w] for w in chunk]],
                     skip_caps=skip_caps_all[[row_of[w] for w in chunk]],
                 )
+                stats["pack_s"] = stats.get("pack_s", 0.0) + (
+                    _time.perf_counter() - t0)
                 a = packed.arrays
+                B_c, W_c = a["in_start"].shape
+                M_c = a["out_start"].shape[2]
+                K_c = a["in_wt"].shape[1]
+                # analytic op accounting for utilization estimates:
+                # score build ~ (E_pred+2) masked mixture evals of K comps
+                # (~8 flops each) per cell; Sinkhorn 2 LSE passes/iter
+                # (~6 flops/cell); rounding ~log2(W) rounds (~8 flops/cell)
+                cells = B_c * E * W_c * M_c * n_sweeps
+                stats["flops_est"] = stats.get("flops_est", 0.0) + cells * (
+                    8.0 * K_c * (E + 2)
+                    + 6.0 * 2 * self.n_sinkhorn
+                    + 8.0 * max(1, W_c.bit_length())
+                )
+                # XLA-path HBM traffic bound: the [W, M] block streams twice
+                # per Sinkhorn iteration (row+col LSE); the Pallas kernel
+                # keeps it VMEM-resident and only pays one read + one write
+                stats["bytes_est_xla"] = stats.get("bytes_est_xla", 0.0) + (
+                    cells * 4.0 * 2 * self.n_sinkhorn)
+                stats["bytes_est_pallas"] = stats.get(
+                    "bytes_est_pallas", 0.0) + cells * 4.0 * 3
+                t0 = _time.perf_counter()
                 out = solve_windows_packed(
                     a["in_start"], a["in_end"], a["in_valid"],
                     a["out_start"], a["out_end"], a["out_valid"],
@@ -644,6 +674,8 @@ class WeaverTPU:
                     epsilon=self.epsilon, n_sinkhorn=self.n_sinkhorn,
                     n_sweeps=n_sweeps,
                 )
+                stats["dispatch_s"] = stats.get("dispatch_s", 0.0) + (
+                    _time.perf_counter() - t0)
                 pending.append((packed, out))
 
         for _, out in pending:
@@ -653,6 +685,7 @@ class WeaverTPU:
                 pass
 
         results = []
+        t0 = _time.perf_counter()
         for packed, out in pending:
             o = np.asarray(out)
             assign = o[..., 0]
@@ -660,6 +693,8 @@ class WeaverTPU:
             feas = o[..., 2]
             topk_cols = o[..., 3:]
             results.append((packed, (assign, topk_cols, not_best, feas)))
+        stats["wait_s"] = stats.get("wait_s", 0.0) + (
+            _time.perf_counter() - t0)
         return results
 
     @staticmethod
@@ -814,6 +849,9 @@ class WeaverTPU:
 
         iterations = 1 if (parallel_mode or dynamism or true_dist) else 2
 
+        import time as _time
+
+        self.stats = {}
         all_assignments = all_topk = None
         not_best_count = 0
         per_span_candidates: Dict = {}
@@ -823,6 +861,7 @@ class WeaverTPU:
                 in_spans, out_span_partitions, out_eps, dists, in_ep,
                 invocation_graph, force_skip_ids, parallel_mode,
             )
+            t0 = _time.perf_counter()
             all_assignments = {ep: {} for ep in out_eps}
             all_topk = {ep: {} for ep in out_eps}
             # confidence: a span is "not best" if OT overrode the row argmax
@@ -842,12 +881,17 @@ class WeaverTPU:
             }
             self._resolve_cross_window_duplicates(
                 all_assignments, all_topk, in_ids, skip_budget)
+            self.stats["decode_s"] = self.stats.get("decode_s", 0.0) + (
+                _time.perf_counter() - t0)
             if it + 1 < iterations:
+                t0 = _time.perf_counter()
                 dists = timing.refit_from_assignments(
                     in_span_partitions, out_span_partitions,
                     invocation_graph, all_assignments, self.all_spans,
                     score_mode=self.score_mode,
                 )
+                self.stats["refit_s"] = self.stats.get("refit_s", 0.0) + (
+                    _time.perf_counter() - t0)
 
         cnt_unassigned = sum(
             1

@@ -64,6 +64,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    # Backend selection. The sandbox's sitecustomize force-selects the
+    # remote "axon" TPU backend whose init can stall for minutes; the env
+    # var alone cannot override it, only a config update can. Experiment
+    # sweeps default to CPU; set TW_BACKEND=axon (or tpu) to run the
+    # solver on the chip.
+    backend = os.environ.get("TW_BACKEND", "cpu")
+    if backend == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     from traceweaver_tpu.runtime.executor import (
         ExecutorConfig,
         load_replica_table,
