@@ -112,6 +112,16 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
+    from traceweaver_tpu.runtime.jax_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    # record whether the on-disk compile cache was already warm: with it,
+    # warmup_time_s measures cache deserialization, not a cold compile —
+    # the report must say which one it was
+    cache_dir = enable_persistent_compilation_cache()
+    cache_warm = bool(cache_dir and os.listdir(cache_dir))
+
     backend = jax.default_backend()
     log(f"child: jax backend = {backend}, devices = {jax.devices()}")
 
@@ -195,6 +205,7 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         "n_spans": n_spans,
         "solve_time_s": solve_time,
         "warmup_time_s": warmup_time,
+        "compile_cache_warm": cache_warm,
         "spans_per_sec": n_spans / solve_time,
         "accuracy_mean": sum(accs.values()) / len(accs),
         "pallas_on_device_ok": pallas_ok,
@@ -375,6 +386,7 @@ def main() -> None:
         "n_spans": solver["n_spans"],
         "solve_time_s": round(solver["solve_time_s"], 2),
         "warmup_compile_s": round(solver["warmup_time_s"], 2),
+        "compile_cache_warm": solver.get("compile_cache_warm"),
         "pallas_on_device_ok": solver.get("pallas_on_device_ok"),
         "stage_seconds": solver.get("stage_seconds"),
         "mfu_est_pct": solver.get("mfu_est_pct"),

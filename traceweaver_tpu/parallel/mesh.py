@@ -27,8 +27,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 from traceweaver_tpu.algorithms.weaver_tpu import solve_windows
 
@@ -133,7 +133,7 @@ def _build_em_step(mesh: Mesh, epsilon: float, n_sinkhorn: int):
         in_specs=(tuple(P(axis) for _ in BATCHED),
                   tuple(P() for _ in REPLICATED)),
         out_specs=(P(axis), P(), P()),
-        check_rep=False,
+        check_vma=False,
     )
     def step(batched, replicated):
         (in_start, in_end, in_valid, out_start, out_end, out_valid,

@@ -75,6 +75,12 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
 
+    from traceweaver_tpu.runtime.jax_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+
     from traceweaver_tpu.runtime.executor import (
         ExecutorConfig,
         load_replica_table,

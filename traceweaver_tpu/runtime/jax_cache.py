@@ -1,0 +1,41 @@
+"""Persistent XLA compilation cache for executor/bench entry points.
+
+Every fresh process pays the full compile for each (shape, hypers) solve
+variant — ~15 s per variant through the sandbox's remote-TPU tunnel
+(BENCH_r02.json ``warmup_compile_s``). Experiment sweeps launch one
+process per config (reference exps/exp*/run_experiment.sh), so without a
+persistent cache exp5's 90 configs would pay that compile 90 times. This
+enables JAX's on-disk cache so each program is compiled once per machine,
+not once per process.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_cache")
+
+
+def enable_persistent_compilation_cache(cache_dir: str | None = None) -> str:
+    """Point JAX at an on-disk compilation cache (idempotent).
+
+    ``TW_JAX_CACHE_DIR`` overrides the location; ``TW_JAX_CACHE=0``
+    disables entirely. Must run before the first compilation (backend init
+    is fine). Returns the cache dir in use ("" when disabled).
+    """
+    if os.environ.get("TW_JAX_CACHE", "1") in ("0", "false", ""):
+        return ""
+    cache_dir = (cache_dir or os.environ.get("TW_JAX_CACHE_DIR")
+                 or DEFAULT_CACHE_DIR)
+    os.makedirs(cache_dir, exist_ok=True)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache every entry, however small/fast — sweep processes re-pay even
+    # the sub-second compiles hundreds of times otherwise
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return cache_dir
