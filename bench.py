@@ -116,11 +116,13 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         enable_persistent_compilation_cache,
     )
 
-    # record whether the on-disk compile cache was already warm: with it,
-    # warmup_time_s measures cache deserialization, not a cold compile —
-    # the report must say which one it was
+    # record whether the on-disk compile cache was warm FOR THIS CONFIG:
+    # with it, warmup_time_s measures cache deserialization, not a cold
+    # compile — the report must say which one it was. "Warm" is judged by
+    # whether the warmup pass wrote new cache entries, not by the dir
+    # being non-empty (a sweep sibling's entries don't warm this config).
     cache_dir = enable_persistent_compilation_cache()
-    cache_warm = bool(cache_dir and os.listdir(cache_dir))
+    cache_entries_before = set(os.listdir(cache_dir)) if cache_dir else set()
 
     backend = jax.default_backend()
     log(f"child: jax backend = {backend}, devices = {jax.devices()}")
@@ -147,7 +149,10 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     t0 = time.perf_counter()
     one_pass()  # compile warm-up (cached afterwards)
     warmup_time = time.perf_counter() - t0
-    log(f"child: warm-up (compile) pass {warmup_time:.1f}s")
+    cache_warm = bool(cache_dir) and (
+        set(os.listdir(cache_dir)) == cache_entries_before)
+    log(f"child: warm-up (compile) pass {warmup_time:.1f}s "
+        f"(cache_warm={cache_warm})")
 
     profile_dir = os.environ.get("TW_BENCH_PROFILE_DIR")
     if profile_dir:

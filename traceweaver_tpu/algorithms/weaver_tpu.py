@@ -621,11 +621,23 @@ class WeaverTPU:
 
         import time as _time
 
+        # multi-device: window batches shard over the mesh's first axis
+        # (XLA SPMD over ICI; see traceweaver_tpu.parallel.mesh) — each
+        # device then owns a contiguous slice of windows, so the chunk
+        # element budget (per-device HBM) scales by the mesh size
+        mesh = self.mesh
+        n_dev = 1
+        if mesh is not None:
+            n_dev = int(mesh.devices.size)
+            assert n_dev & (n_dev - 1) == 0, (
+                "mesh size must be a power of two so padded window batches "
+                "divide evenly across devices")
+
         stats = self.stats
         pending = []
         for wclass, wins in batches_spec:
             m_est = est_m(wins)
-            per_chunk = max(1, CHUNK_ELEMS // (wclass * m_est * E))
+            per_chunk = max(1, CHUNK_ELEMS // (wclass * m_est * E)) * n_dev
             chunks = [wins[i:i + per_chunk]
                       for i in range(0, len(wins), per_chunk)]
             for chunk in chunks:
@@ -634,7 +646,8 @@ class WeaverTPU:
                     in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
                     force_skip_ids=force_skip_ids, parallel=parallel,
                     windows=chunk, pad_w=wclass,
-                    pad_b=per_chunk if len(chunks) > 1 else None,
+                    pad_b=(per_chunk if len(chunks) > 1 else n_dev
+                           if n_dev > 1 else None),
                     pad_m=m_est if len(chunks) > 1 else None,
                     ranges=ranges_all[[row_of[w] for w in chunk]],
                     skip_caps=skip_caps_all[[row_of[w] for w in chunk]],
@@ -642,6 +655,10 @@ class WeaverTPU:
                 stats["pack_s"] = stats.get("pack_s", 0.0) + (
                     _time.perf_counter() - t0)
                 a = packed.arrays
+                if mesh is not None:
+                    from traceweaver_tpu.parallel.mesh import put_sharded
+
+                    a = put_sharded(a, mesh)
                 B_c, W_c = a["in_start"].shape
                 M_c = a["out_start"].shape[2]
                 K_c = a["in_wt"].shape[1]

@@ -132,10 +132,12 @@ def _build_em_step(mesh: Mesh, epsilon: float, n_sinkhorn: int):
         mesh=mesh,
         in_specs=(tuple(P(axis) for _ in BATCHED),
                   tuple(P() for _ in REPLICATED)),
-        out_specs=(P(axis), P(), P()),
+        out_specs=(P(axis), P(), P(), P()),
         check_vma=False,
     )
     def step(batched, replicated):
+        from traceweaver_tpu.ops.gmm import fit_gmm_sharded
+
         (in_start, in_end, in_valid, out_start, out_end, out_valid,
          skip_cap, force_skip) = batched
         (pred_mask, root_mask, is_last,
@@ -151,38 +153,64 @@ def _build_em_step(mesh: Mesh, epsilon: float, n_sinkhorn: int):
             epsilon=epsilon, n_sinkhorn=n_sinkhorn,
         )  # [b, E, W]
 
+        b, E, W = assign.shape
         M = out_start.shape[2]
-        K = in_wt.shape[1]
         safe = jnp.clip(assign, 0, M - 1)
-        # delay of the chosen candidate measured from the incoming start
-        chosen_start = jnp.take_along_axis(out_start, safe, axis=2)  # [b, E, W]
-        delay = chosen_start - in_start[:, None, :]
+        ch_start = jnp.take_along_axis(out_start, safe, axis=2)  # [b, E, W]
+        ch_end = jnp.take_along_axis(out_end, safe, axis=2)
         real = (assign >= 0) & (assign < M) & in_valid[:, None, :]
-        w = real.astype(jnp.float32)
-        n = jax.lax.psum(jnp.sum(w, axis=(0, 2)), axis)           # [E]
-        s1 = jax.lax.psum(jnp.sum(w * delay, axis=(0, 2)), axis)  # [E]
-        s2 = jax.lax.psum(jnp.sum(w * delay * delay, axis=(0, 2)), axis)
 
-        mean = s1 / jnp.maximum(n, 1.0)
-        var = jnp.maximum(s2 / jnp.maximum(n, 1.0) - mean * mean, 1.0)
-        E = mean.shape[0]
-        new_mu = jnp.zeros((E, K), dtype=jnp.float32).at[:, 0].set(mean)
-        new_sd = jnp.ones((E, K), dtype=jnp.float32).at[:, 0].set(jnp.sqrt(var))
-        return assign, new_mu, new_sd
+        # The three edge families the production refit fits
+        # (timing.refit_from_assignments; reference traceweaver_v3.py:706-818):
+        #   (in -> e): chosen e start - incoming start, root endpoints
+        #   (p -> e):  chosen e start - chosen p end, DAG-primary edges
+        #   (e -> in): incoming end - chosen e end, every endpoint
+        d_in = ch_start - in_start[:, None, :]                   # [b, E, W]
+        m_in = real & root_mask[None, :, None]
+        d_edge = ch_start[:, :, None, :] - ch_end[:, None, :, :]  # [b, E, Ep, W]
+        m_edge = (real[:, :, None, :] & real[:, None, :, :]
+                  & pred_mask[None, :, :, None])
+        d_ret = in_end[:, None, :] - ch_end                      # [b, E, W]
+        m_ret = real
+
+        def rows(d, m, ne):
+            # [b, ..., W] -> [ne, b*W] local sample rows (edge-major)
+            d2 = jnp.moveaxis(d, 0, -2).reshape(ne, b * W)
+            m2 = jnp.moveaxis(m, 0, -2).reshape(ne, b * W)
+            return d2, m2
+
+        di, mi = rows(d_in, m_in, E)
+        de, me = rows(d_edge.reshape(b, E * E, W), m_edge.reshape(b, E * E, W),
+                      E * E)
+        dr, mr = rows(d_ret, m_ret, E)
+        samples = jnp.concatenate([di, de, dr], axis=0)          # [Ne, n_local]
+        smask = jnp.concatenate([mi, me, mr], axis=0)
+
+        w, mu, sd = fit_gmm_sharded(samples, smask, axis,
+                                    max_k=in_wt.shape[1])
+        return assign, w, mu, sd
 
     return jax.jit(step)
 
 
 def em_step_sharded(arrays: Dict[str, np.ndarray], mesh: Mesh,
                     epsilon: float = 1.0, n_sinkhorn: int = 40):
-    """One distributed EM step: sharded solve + psum'd M-step.
+    """One distributed EM step: sharded solve + psum'd BIC-GMM M-step.
 
     E-step: every shard solves its windows (hard assignments). M-step: each
-    shard accumulates, per endpoint, the plan-weighted delay sufficient
-    statistics (count, sum, sum of squares of ``out.start − t_origin``),
-    reduced with ``psum`` over the mesh; the update
-    ``mean = Σd/n, var = Σd²/n − mean²`` is computed identically on every
-    device. Returns (assign, new_in_mu, new_in_sd).
+    shard computes, for every edge of all three production families —
+    root ``(in -> e)``, DAG ``(p -> e)``, return ``(e -> in)`` — the local
+    slice of that edge's delay samples, and the BIC-selected GMMs are fit
+    with EM whose moment sums ride ``jax.lax.psum`` over the mesh
+    (:func:`traceweaver_tpu.ops.gmm.fit_gmm_sharded`); every device ends
+    with identical mixtures. This is the same sufficient-statistics
+    computation :func:`traceweaver_tpu.algorithms.timing.refit_from_assignments`
+    performs on host (reference ``ComputeEpPairDistParams5``,
+    traceweaver_v3.py:706-818), distributed.
+
+    Returns ``(assign, dists)`` where ``dists`` maps family name to
+    fixed-shape mixture params: ``"in"``/``"ret"`` -> (w, mu, sd) each
+    [E, K]; ``"edge"`` -> (w, mu, sd) each [E, E, K] indexed [e, p].
 
     The compiled step is cached per (mesh, epsilon, n_sinkhorn) — repeated
     calls in a training loop reuse one XLA program per input shape.
@@ -191,5 +219,18 @@ def em_step_sharded(arrays: Dict[str, np.ndarray], mesh: Mesh,
     step = _build_em_step(mesh, epsilon, n_sinkhorn)
     batched = tuple(jnp.asarray(arrays[k]) for k in BATCHED)
     replicated = tuple(jnp.asarray(arrays[k]) for k in REPLICATED)
-    assign, new_mu, new_sd = step(batched, replicated)
-    return (np.asarray(assign)[:true_b], np.asarray(new_mu), np.asarray(new_sd))
+    assign, w, mu, sd = step(batched, replicated)
+    E = arrays["root_mask"].shape[0]
+    K = arrays["in_wt"].shape[1]
+    w, mu, sd = (np.asarray(a) for a in (w, mu, sd))
+
+    def fam(lo, hi, shape):
+        return (w[lo:hi].reshape(shape), mu[lo:hi].reshape(shape),
+                sd[lo:hi].reshape(shape))
+
+    dists = {
+        "in": fam(0, E, (E, K)),
+        "edge": fam(E, E + E * E, (E, E, K)),
+        "ret": fam(E + E * E, E + E * E + E, (E, K)),
+    }
+    return np.asarray(assign)[:true_b], dists
