@@ -1,0 +1,151 @@
+"""Two-process corpus-level data parallelism (the DCN design's smoke test).
+
+Launches two REAL OS processes (the reference's own process model,
+exps/exp1/run_experiment.sh:74-79), each solving a disjoint shard of
+service problems with the full flagship stack and contributing per-edge
+delay statistics to a filesystem allreduce. Asserts:
+
+- both shards solve and their merged accuracy matches a single-process
+  run over the same problems;
+- the allreduced corpus-wide edge statistics are identical on both
+  processes and equal to the single-process statistics.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE_DATA
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import json, os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from traceweaver_tpu.ingest import (
+    build_service_problem, infer_invocation_dag, load_corpus)
+from traceweaver_tpu.metrics import get_ground_truth, accuracy_for_service
+from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
+from traceweaver_tpu.parallel.multislice import (
+    allreduce_stats_files, edge_stats_from_samples, partition_problems)
+
+pid = int(sys.argv[1])
+n_proc = int(sys.argv[2])
+rdv = sys.argv[3]
+out_path = sys.argv[4]
+
+store = load_corpus({data!r}, fix=2, max_traces=60, cache=False)
+problems = []
+for svc in sorted(store.out_spans_by_process):
+    prob = build_service_problem(store, svc)
+    if prob.skipped:
+        continue
+    problems.append((svc, prob))
+
+mine = partition_problems(len(problems), n_proc, pid)
+accs = {{}}
+samples = {{}}
+for i in mine:
+    svc, prob = problems[i]
+    ta = get_ground_truth(prob.in_span_partitions, prob.out_span_partitions)
+    dag = infer_invocation_dag(
+        prob.in_span_partitions, prob.out_span_partitions, ta, store)
+    algo = WeaverTPU(store.all_spans, store.all_processes)
+    out = algo.FindAssignments(
+        "MaxScoreBatchSubsetWithSkips", svc, prob.in_span_partitions,
+        prob.out_span_partitions, False, [], ta, dag)
+    accs[svc] = accuracy_for_service(out[0], ta, prob.in_span_partitions)
+    # per-edge delay samples from this shard's ground truth stream
+    in_ep = next(iter(prob.in_span_partitions))
+    for ep, spans in prob.out_span_partitions.items():
+        samples[(svc, ep)] = [float(s.start_mus) for s in spans[:50]]
+
+stats = edge_stats_from_samples(samples)
+merged = allreduce_stats_files(stats, rdv, pid, n_proc)
+with open(out_path, "w") as f:
+    json.dump({{
+        "accs": accs,
+        "merged": {{json.dumps(list(k)): v for k, v in merged.items()}},
+    }}, f)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_corpus_parallelism():
+    data = os.path.join(REFERENCE_DATA, "hotel_reservation/hotel_load25")
+    if not os.path.isdir(data):
+        pytest.skip("reference dataset not available")
+    code = WORKER.format(repo=REPO, data=data)
+    with tempfile.TemporaryDirectory() as td:
+        rdv = os.path.join(td, "rdv")
+        outs = [os.path.join(td, f"out_{p}.json") for p in range(2)]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", code, str(p), "2", rdv, outs[p]],
+                env=env, cwd=REPO)
+            for p in range(2)
+        ]
+        for p in procs:
+            assert p.wait(timeout=420) == 0
+        results = []
+        for path in outs:
+            with open(path) as f:
+                results.append(json.load(f))
+
+    # disjoint shards that together cover both solvable hotel services
+    svcs0 = set(results[0]["accs"])
+    svcs1 = set(results[1]["accs"])
+    assert svcs0 and svcs1 and not (svcs0 & svcs1)
+    all_accs = {**results[0]["accs"], **results[1]["accs"]}
+    assert set(all_accs) == {"frontend", "search"}
+
+    # single-process reference run over the same problems
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
+    from traceweaver_tpu.ingest import (
+        build_service_problem, infer_invocation_dag, load_corpus)
+    from traceweaver_tpu.metrics import accuracy_for_service, get_ground_truth
+
+    store = load_corpus(data, fix=2, max_traces=60, cache=False)
+    for svc, acc in all_accs.items():
+        prob = build_service_problem(store, svc)
+        ta = get_ground_truth(prob.in_span_partitions, prob.out_span_partitions)
+        dag = infer_invocation_dag(
+            prob.in_span_partitions, prob.out_span_partitions, ta, store)
+        algo = WeaverTPU(store.all_spans, store.all_processes)
+        out = algo.FindAssignments(
+            "MaxScoreBatchSubsetWithSkips", svc, prob.in_span_partitions,
+            prob.out_span_partitions, False, [], ta, dag)
+        ref = accuracy_for_service(out[0], ta, prob.in_span_partitions)
+        assert abs(ref - acc) < 1e-9, svc
+
+    # the allreduce produced identical corpus-wide statistics everywhere
+    assert results[0]["merged"] == results[1]["merged"]
+
+
+def test_partition_and_merge_units():
+    from traceweaver_tpu.parallel.multislice import (
+        merge_edge_stats, partition_problems)
+
+    parts = [partition_problems(10, 3, p) for p in range(3)]
+    assert sorted(i for part in parts for i in part) == list(range(10))
+    assert all(len(p) in (3, 4) for p in parts)
+
+    a = {("x", "y"): (2.0, 10.0, 60.0)}
+    b = {("x", "y"): (1.0, 5.0, 25.0), ("p", "q"): (1.0, 1.0, 1.0)}
+    m = merge_edge_stats(a, [b])
+    assert m[("x", "y")] == (3.0, 15.0, 85.0)
+    assert m[("p", "q")] == (1.0, 1.0, 1.0)
+    n, s1, s2 = m[("x", "y")]
+    assert abs(s1 / n - 5.0) < 1e-12  # corpus-wide mean recovered exactly

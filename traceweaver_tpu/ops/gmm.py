@@ -195,25 +195,76 @@ def fit_gmm_sharded(samples, mask, axis: str, max_k: int = 5,
     return w, mu_out, sd_out
 
 
+def _fit_edge_z(z_row, mask_row, nv, max_k: int, n_iters: int):
+    """BIC-selected GMM for one edge's standardized samples (z-space)."""
+    outs = []
+    for k in range(1, max_k + 1):
+        w, mu, sd, ll = _em_fixed_k(z_row, mask_row, k, max_k, n_iters)
+        p = 3 * k - 1  # weights (k-1) + means (k) + vars (k)
+        bic = -2.0 * ll + p * jnp.log(nv)
+        # k components need at least k samples to be identifiable
+        bic = jnp.where(nv >= k, bic, jnp.inf)
+        outs.append((bic, w, mu, sd))
+    bics = jnp.stack([o[0] for o in outs])
+    best = jnp.argmin(bics)
+    w = jnp.stack([o[1] for o in outs])[best]
+    mu = jnp.stack([o[2] for o in outs])[best]
+    sd = jnp.stack([o[3] for o in outs])[best]
+    return w, mu, sd
+
+
 @partial(jax.jit, static_argnames=("max_k", "n_iters"))
 def _fit_gmm_z(z, mask, max_k: int = 5, n_iters: int = 50):
     """Device fit over pre-standardized samples; returns z-space params."""
     n_valid = jnp.maximum(jnp.sum(mask, axis=1).astype(z.dtype), 1.0)
+    return jax.vmap(
+        partial(_fit_edge_z, max_k=max_k, n_iters=n_iters))(z, mask, n_valid)
 
-    def fit_edge(z_row, mask_row, nv):
-        outs = []
-        for k in range(1, max_k + 1):
-            w, mu, sd, ll = _em_fixed_k(z_row, mask_row, k, max_k, n_iters)
-            p = 3 * k - 1  # weights (k-1) + means (k) + vars (k)
-            bic = -2.0 * ll + p * jnp.log(nv)
-            # k components need at least k samples to be identifiable
-            bic = jnp.where(nv >= k, bic, jnp.inf)
-            outs.append((bic, w, mu, sd))
-        bics = jnp.stack([o[0] for o in outs])
-        best = jnp.argmin(bics)
-        w = jnp.stack([o[1] for o in outs])[best]
-        mu = jnp.stack([o[2] for o in outs])[best]
-        sd = jnp.stack([o[3] for o in outs])[best]
-        return w, mu, sd
 
-    return jax.vmap(fit_edge)(z, mask, n_valid)
+def fit_gmm_in_graph(samples, mask, prior_w, prior_mu, prior_sd,
+                     max_k: int = 5, n_iters: int = 50):
+    """Fully in-graph BIC-GMM refit — traceable inside a larger jitted
+    program (the fused EM solve), unlike :func:`fit_gmm_batched` whose
+    standardization runs on host.
+
+    samples/mask: [Ne, n]; prior_*: [Ne, max_k] params to KEEP for rows
+    with no samples (inactive edges). Rows with 1-3 samples take the
+    closed-form single Gaussian the host path uses for degenerate edges
+    (timing.py ``from_samples_gmm``); rows with >= 4 samples get the
+    BIC-selected EM fit. Standardization is two-pass f32 in-graph (mean
+    subtracted before squaring — no catastrophic cancellation for
+    large-microsecond delays).
+    """
+    m = mask.astype(samples.dtype)
+    n = jnp.sum(m, axis=1)                                   # [Ne]
+    n1 = jnp.maximum(n, 1.0)
+    mean = jnp.sum(samples * m, axis=1) / n1
+    d = (samples - mean[:, None]) * m
+    var0 = jnp.sum(d * d, axis=1) / n1
+    scale = jnp.sqrt(jnp.maximum(var0, 1e-12))
+    z = jnp.where(mask, d / scale[:, None], 0.0)
+
+    w_z, mu_z, sd_z = jax.vmap(
+        partial(_fit_edge_z, max_k=max_k, n_iters=n_iters))(
+            z, mask, jnp.maximum(n, 1.0))
+    w = w_z
+    mu = mean[:, None] + scale[:, None] * mu_z
+    sd = jnp.where(w > 0, jnp.maximum(scale[:, None] * sd_z, 1.0), 1.0)
+
+    # degenerate rows (< 4 samples or zero spread): closed-form single
+    # Gaussian (mean, std) with the host fit's 1e-3 floor
+    k0 = jnp.zeros_like(prior_w).at[:, 0].set(1.0)
+    mu0 = jnp.zeros_like(prior_mu).at[:, 0].set(mean)
+    sd0 = jnp.ones_like(prior_sd).at[:, 0].set(
+        jnp.maximum(jnp.sqrt(jnp.maximum(var0, 0.0)), 1e-3))
+    few = ((n < 4) | (var0 <= 1e-12))[:, None]
+    w = jnp.where(few, k0, w)
+    mu = jnp.where(few, mu0, mu)
+    sd = jnp.where(few, sd0, sd)
+
+    # no samples at all: keep the prior (pack-time) params
+    empty = (n < 1)[:, None]
+    w = jnp.where(empty, prior_w, w)
+    mu = jnp.where(empty, prior_mu, mu)
+    sd = jnp.where(empty, prior_sd, sd)
+    return w, mu, sd

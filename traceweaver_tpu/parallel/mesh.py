@@ -153,38 +153,14 @@ def _build_em_step(mesh: Mesh, epsilon: float, n_sinkhorn: int):
             epsilon=epsilon, n_sinkhorn=n_sinkhorn,
         )  # [b, E, W]
 
-        b, E, W = assign.shape
-        M = out_start.shape[2]
-        safe = jnp.clip(assign, 0, M - 1)
-        ch_start = jnp.take_along_axis(out_start, safe, axis=2)  # [b, E, W]
-        ch_end = jnp.take_along_axis(out_end, safe, axis=2)
-        real = (assign >= 0) & (assign < M) & in_valid[:, None, :]
+        # local slice of the three production refit families — the family
+        # definitions live in ONE place (weaver_tpu.em_family_samples),
+        # shared with the fused single-device EM
+        from traceweaver_tpu.algorithms.weaver_tpu import em_family_samples
 
-        # The three edge families the production refit fits
-        # (timing.refit_from_assignments; reference traceweaver_v3.py:706-818):
-        #   (in -> e): chosen e start - incoming start, root endpoints
-        #   (p -> e):  chosen e start - chosen p end, DAG-primary edges
-        #   (e -> in): incoming end - chosen e end, every endpoint
-        d_in = ch_start - in_start[:, None, :]                   # [b, E, W]
-        m_in = real & root_mask[None, :, None]
-        d_edge = ch_start[:, :, None, :] - ch_end[:, None, :, :]  # [b, E, Ep, W]
-        m_edge = (real[:, :, None, :] & real[:, None, :, :]
-                  & pred_mask[None, :, :, None])
-        d_ret = in_end[:, None, :] - ch_end                      # [b, E, W]
-        m_ret = real
-
-        def rows(d, m, ne):
-            # [b, ..., W] -> [ne, b*W] local sample rows (edge-major)
-            d2 = jnp.moveaxis(d, 0, -2).reshape(ne, b * W)
-            m2 = jnp.moveaxis(m, 0, -2).reshape(ne, b * W)
-            return d2, m2
-
-        di, mi = rows(d_in, m_in, E)
-        de, me = rows(d_edge.reshape(b, E * E, W), m_edge.reshape(b, E * E, W),
-                      E * E)
-        dr, mr = rows(d_ret, m_ret, E)
-        samples = jnp.concatenate([di, de, dr], axis=0)          # [Ne, n_local]
-        smask = jnp.concatenate([mi, me, mr], axis=0)
+        samples, smask = em_family_samples(
+            assign, in_start, in_end, in_valid, out_start, out_end,
+            pred_mask, root_mask)                            # [Ne, n_local]
 
         w, mu, sd = fit_gmm_sharded(samples, smask, axis,
                                     max_k=in_wt.shape[1])

@@ -1,0 +1,143 @@
+"""Multi-host / multi-slice (DCN) scale-out: corpus-level data parallelism.
+
+Design (SURVEY.md §2.8/§5 "Distributed communication backend"). The
+reference is a single-node artifact; its only scale-out is backgrounded
+shell jobs, one executor process per dataset (reference
+exps/exp1/run_experiment.sh:74-79). The TPU-native scale-out has three
+tiers, matched to the hardware's communication hierarchy:
+
+1. **Within a chip** — vmap over windows/endpoints (weaver_tpu).
+2. **Within a slice (ICI)** — the window axis sharded over the slice's
+   devices (`parallel.mesh`): windows are independent subproblems, so the
+   solve partitions with no cross-device traffic at all, and only the EM
+   M-step reduces [Ne, K]-shaped sufficient statistics with `psum` —
+   high-bandwidth ICI handles the (tiny) allreduce inline.
+3. **Across slices / hosts (DCN)** — THIS module. The unit of work is a
+   whole assignment problem (one call graph, or one service's span
+   partitions): problems are range-partitioned across processes, each
+   process solves its shard with the full single-slice stack, and the
+   only cross-slice communication is (a) an optional allreduce of
+   per-edge-family delay statistics when one set of distributions should
+   be fit corpus-wide (the Alibaba regime: the same call-graph signature
+   appears in many shards), and (b) result gather at the end. Both are
+   O(edges × components) and O(results) — orders of magnitude below DCN
+   bandwidth — so the design is DCN-friendly by construction: no solve
+   state ever crosses a slice boundary.
+
+The two communication paths degrade gracefully:
+
+- With a JAX distributed runtime (`jax.distributed.initialize`, real
+  multi-host TPU or multi-process CPU), the statistics allreduce rides
+  `jax.lax.psum` over a global mesh — XLA routes it over DCN between
+  slices and ICI within them.
+- Without one (plain OS processes, the reference's own process model),
+  :func:`allreduce_stats_files` provides a filesystem barrier+reduce so
+  the exp harness works on any box. Correctness is identical; only
+  transport differs. tests/test_multislice.py proves the two-process
+  case end-to-end this way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+EdgeKey = Tuple[str, str]
+
+
+def partition_problems(n_problems: int, n_processes: int,
+                       process_id: int) -> List[int]:
+    """Contiguous range partition of problem indices for one process.
+
+    Call graphs are grouped by signature (alibaba/grouping.py), so
+    neighbouring indices have similar sizes; contiguous ranges keep shard
+    cost roughly balanced without a scheduler.
+    """
+    assert 0 <= process_id < n_processes
+    base, extra = divmod(n_problems, n_processes)
+    lo = process_id * base + min(process_id, extra)
+    hi = lo + base + (1 if process_id < extra else 0)
+    return list(range(lo, hi))
+
+
+def merge_edge_stats(
+    local: Dict[EdgeKey, Tuple[float, float, float]],
+    others: Sequence[Dict[EdgeKey, Tuple[float, float, float]]],
+) -> Dict[EdgeKey, Tuple[float, float, float]]:
+    """Reduce per-edge (n, Σd, Σd²) sufficient statistics across shards.
+
+    These are exactly the quantities the sharded EM M-step psums within a
+    slice (`ops.gmm.fit_gmm_sharded`); across slices they are additive,
+    so corpus-wide Gaussian parameters are recovered exactly:
+    ``mean = Σd/n``, ``var = Σd²/n − mean²``.
+    """
+    out: Dict[EdgeKey, list] = {
+        k: list(v) for k, v in local.items()
+    }
+    for d in others:
+        for k, (n, s1, s2) in d.items():
+            if k in out:
+                out[k][0] += n
+                out[k][1] += s1
+                out[k][2] += s2
+            else:
+                out[k] = [n, s1, s2]
+    return {k: (v[0], v[1], v[2]) for k, v in out.items()}
+
+
+def edge_stats_from_samples(
+    samples_by_edge: Dict[EdgeKey, Sequence[float]],
+) -> Dict[EdgeKey, Tuple[float, float, float]]:
+    """Local (n, Σd, Σd²) per edge from raw delay samples (f64 on host —
+    same no-cancellation rule as ops/gmm.py's standardization)."""
+    out = {}
+    for k, v in samples_by_edge.items():
+        a = np.asarray(v, dtype=np.float64)
+        out[k] = (float(len(a)), float(a.sum()), float((a * a).sum()))
+    return out
+
+
+def allreduce_stats_files(
+    stats: Dict[EdgeKey, Tuple[float, float, float]],
+    rendezvous_dir: str,
+    process_id: int,
+    n_processes: int,
+    timeout_s: float = 120.0,
+    poll_s: float = 0.05,
+) -> Dict[EdgeKey, Tuple[float, float, float]]:
+    """Filesystem allreduce: every process writes its local stats, waits
+    for all peers, and computes the identical merged result.
+
+    The DCN-transport stand-in for plain-process deployments (the
+    reference's own process model); with a JAX distributed runtime the
+    same reduction is one ``psum`` of the stacked [Ne, 3] tensor.
+    """
+    os.makedirs(rendezvous_dir, exist_ok=True)
+    payload = {json.dumps(list(k)): v for k, v in stats.items()}
+    tmp = os.path.join(rendezvous_dir, f".stats_{process_id}.tmp")
+    final = os.path.join(rendezvous_dir, f"stats_{process_id}.json")
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, final)  # atomic publish
+
+    deadline = time.time() + timeout_s
+    paths = [os.path.join(rendezvous_dir, f"stats_{p}.json")
+             for p in range(n_processes)]
+    while not all(os.path.exists(p) for p in paths):
+        if time.time() > deadline:
+            missing = [p for p in paths if not os.path.exists(p)]
+            raise TimeoutError(f"allreduce barrier: missing {missing}")
+        time.sleep(poll_s)
+
+    shards = []
+    for p in paths:
+        with open(p) as f:
+            raw = json.load(f)
+        shards.append({tuple(json.loads(k)): tuple(v)
+                       for k, v in raw.items()})
+    merged = merge_edge_stats(shards[0], shards[1:])
+    return merged
