@@ -237,3 +237,45 @@ def test_sinkhorn_dispatch_cpu_lowering_with_pallas_forced(monkeypatch):
     want = np.asarray(sinkhorn_log(jnp.asarray(S), jnp.asarray(r),
                                    jnp.asarray(c), epsilon=0.9, n_iters=40))
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_fit_gmm_in_graph_families():
+    """In-graph refit: >=4-sample rows get an EM fit close to the data,
+    1-3-sample rows take the closed-form Gaussian, empty rows keep the
+    prior params untouched."""
+    import numpy as np
+
+    from traceweaver_tpu.ops.gmm import fit_gmm_in_graph
+
+    rng = np.random.default_rng(0)
+    n = 64
+    samples = np.zeros((3, n), np.float32)
+    mask = np.zeros((3, n), bool)
+    # row 0: rich bimodal data
+    samples[0] = np.concatenate([
+        rng.normal(100.0, 5.0, n // 2), rng.normal(500.0, 10.0, n // 2)
+    ]).astype(np.float32)
+    mask[0] = True
+    # row 1: two samples -> closed-form single gaussian
+    samples[1, :2] = [40.0, 60.0]
+    mask[1, :2] = True
+    # row 2: empty -> prior kept
+    K = 5
+    prior_w = np.zeros((3, K), np.float32)
+    prior_w[:, 0] = 1.0
+    prior_mu = np.full((3, K), 777.0, np.float32)
+    prior_sd = np.full((3, K), 3.0, np.float32)
+
+    w, mu, sd = (np.asarray(a) for a in fit_gmm_in_graph(
+        samples, mask, prior_w, prior_mu, prior_sd, max_k=K))
+
+    mix_mean = (w[0] * mu[0]).sum() / w[0].sum()
+    assert abs(mix_mean - samples[0].mean()) < 10.0
+    assert w[0].sum() > 0.99
+    # row 1 closed form: mean 50, std 10
+    assert abs(mu[1, 0] - 50.0) < 1e-3 and abs(sd[1, 0] - 10.0) < 1e-3
+    assert w[1, 0] == 1.0
+    # row 2 untouched prior
+    np.testing.assert_allclose(mu[2], prior_mu[2])
+    np.testing.assert_allclose(sd[2], prior_sd[2])
+    np.testing.assert_allclose(w[2], prior_w[2])

@@ -248,3 +248,40 @@ def test_weaver_tpu_true_dist_ablation(hotel_store):
         prob.out_span_partitions, False, [], ta, dag, true_dist=True)
     acc = accuracy_for_service(out[0], ta, prob.in_span_partitions)
     assert acc > 0.95
+
+
+def test_fused_em_matches_host_refit(hotel_store):
+    """The single-dispatch fused EM (on-device BIC-GMM refit between the
+    two passes, solve_em_packed) must reproduce the two-dispatch path with
+    the host refit (timing.refit_from_assignments) assignment-for-
+    assignment."""
+    from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
+    from traceweaver_tpu.ingest import (
+        build_service_problem, infer_invocation_dag)
+    from traceweaver_tpu.metrics import get_ground_truth
+
+    store = hotel_store
+    for svc in ("frontend", "search"):
+        prob = build_service_problem(store, svc)
+        if prob.skipped:
+            continue
+        ta = get_ground_truth(prob.in_span_partitions,
+                              prob.out_span_partitions)
+        dag = infer_invocation_dag(
+            prob.in_span_partitions, prob.out_span_partitions, ta, store)
+        args = ("MaxScoreBatchSubsetWithSkips", svc, prob.in_span_partitions,
+                prob.out_span_partitions, False, [], ta, dag)
+
+        fused = WeaverTPU(store.all_spans, store.all_processes)
+        out_f = fused.FindAssignments(*args)
+        assert fused.stats.get("fused_em_applied"), "fused path not taken"
+        assert "refit_s" not in fused.stats  # the host refit never ran
+
+        host = WeaverTPU(store.all_spans, store.all_processes)
+        orig = host._solve_once
+        host._solve_once = (
+            lambda *a, **kw: orig(*a, **{**kw, "fused": False}))
+        out_h = host.FindAssignments(*args)
+        assert "refit_s" in host.stats
+
+        assert out_f[0] == out_h[0], svc  # assignments identical

@@ -108,6 +108,7 @@ def allreduce_stats_files(
     n_processes: int,
     timeout_s: float = 120.0,
     poll_s: float = 0.05,
+    round_id: int = 0,
 ) -> Dict[EdgeKey, Tuple[float, float, float]]:
     """Filesystem allreduce: every process writes its local stats, waits
     for all peers, and computes the identical merged result.
@@ -115,17 +116,24 @@ def allreduce_stats_files(
     The DCN-transport stand-in for plain-process deployments (the
     reference's own process model); with a JAX distributed runtime the
     same reduction is one ``psum`` of the stacked [Ne, 3] tensor.
+
+    ``round_id`` namespaces the barrier files: repeated reductions over
+    the same rendezvous dir (one per EM iteration, or a restarted run)
+    MUST pass distinct round ids, otherwise a peer's stale file from an
+    earlier round would satisfy the barrier and merge wrong statistics.
     """
     os.makedirs(rendezvous_dir, exist_ok=True)
     payload = {json.dumps(list(k)): v for k, v in stats.items()}
-    tmp = os.path.join(rendezvous_dir, f".stats_{process_id}.tmp")
-    final = os.path.join(rendezvous_dir, f"stats_{process_id}.json")
+    tmp = os.path.join(rendezvous_dir,
+                       f".stats_r{round_id}_{process_id}.tmp")
+    final = os.path.join(rendezvous_dir,
+                         f"stats_r{round_id}_{process_id}.json")
     with open(tmp, "w") as f:
         json.dump(payload, f)
     os.replace(tmp, final)  # atomic publish
 
     deadline = time.time() + timeout_s
-    paths = [os.path.join(rendezvous_dir, f"stats_{p}.json")
+    paths = [os.path.join(rendezvous_dir, f"stats_r{round_id}_{p}.json")
              for p in range(n_processes)]
     while not all(os.path.exists(p) for p in paths):
         if time.time() > deadline:
