@@ -161,6 +161,9 @@ def main():
                     help="skip the flagship TPU solver rows")
     ap.add_argument("--methods", default=None,
                     help="comma-separated registry method names to run")
+    ap.add_argument("--skip-reference", action="store_true",
+                    help="run only this framework's side (bank 'ours' rows "
+                         "when a reference solver exceeds its time budget)")
     ap.add_argument("--datasets", default=None,
                     help="comma-separated dataset labels to run")
     ap.add_argument("--merge", action="store_true",
@@ -169,9 +172,9 @@ def main():
     args = ap.parse_args()
     method_filter = set(args.methods.split(",")) if args.methods else None
     dataset_filter = set(args.datasets.split(",")) if args.datasets else None
-    if (method_filter or dataset_filter) and not args.merge:
-        # a filtered run must never silently clobber the full parity record
-        # (parity.json AND the PARITY.md derived from it)
+    if (method_filter or dataset_filter or args.skip_reference) and not args.merge:
+        # a filtered or ours-only run must never silently clobber the full
+        # parity record (parity.json AND the PARITY.md derived from it)
         print("[parity] filters active: enabling --merge", file=sys.stderr)
         args.merge = True
 
@@ -216,12 +219,13 @@ def main():
                 continue
             if method_filter and method not in method_filter:
                 continue
-            try:
-                ref_cls = _load_ref_class(ref_dotted)
-                table[f"{method}/reference"] = _run_one(
-                    ref_cls, method, store, problems, use_dag)
-            except Exception as e:  # pragma: no cover - report, keep going
-                table[f"{method}/reference"] = {"error": repr(e)}
+            if not args.skip_reference:
+                try:
+                    ref_cls = _load_ref_class(ref_dotted)
+                    table[f"{method}/reference"] = _run_one(
+                        ref_cls, method, store, problems, use_dag)
+                except Exception as e:  # pragma: no cover - report, keep going
+                    table[f"{method}/reference"] = {"error": repr(e)}
             try:
                 our_cls = _load_our_class(ours_dotted)
                 table[f"{method}/ours"] = _run_one(
@@ -288,6 +292,12 @@ def main():
                 else:
                     cells.append("—")
             lines.append(f"| {name} | " + " | ".join(cells) + " |")
+        if ("MaxScoreBatchSubsetWithSkips/ours" in table
+                and "MaxScoreBatchSubsetWithSkips/reference" not in table):
+            lines += ["",
+                      "*Reference V3 row absent: it has not completed on"
+                      " this dataset in the current record (see README"
+                      " results notes for why).*"]
         lines.append("")
     with open(os.path.join(REPO, "PARITY.md"), "w") as f:
         f.write("\n".join(lines))

@@ -1,0 +1,131 @@
+"""Render the executed exp results as a markdown table (README §Results).
+
+Reads the accuracy pickles the experiment sweeps produce (same 5-family
+naming as the reference, reference executor.py:1235-1244) and prints a
+compact per-app end-to-end accuracy table plus the exp5 compress ladder.
+
+Usage: python utils/results_table.py [exps_root]
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+import sys
+from collections import defaultdict
+
+METHOD_ORDER = [
+    "WAP5", "FCFS", "vPath", "vPathOld", "ArrivalOrder", "MaxScore",
+    "MaxScoreBatch", "MaxScoreBatchParallel",
+    "MaxScoreBatchParallelWithoutIterations",
+    "MaxScoreBatchSubsetWithSkips",
+]
+
+
+def load_results(pattern):
+    out = defaultdict(dict)  # (test, load_or_factor) -> {method: acc}
+    for f in sorted(glob.glob(pattern)):
+        name = os.path.basename(f).replace(".pickle", "")
+        # accuracy_{test...}_{load}_{compress}_{repeat}_{cache}
+        parts = name.split("_")
+        cache = parts[-1]
+        compress = parts[-3]
+        load = parts[-4]
+        test = "_".join(parts[1:-4])
+        with open(f, "rb") as fh:
+            d = pickle.load(fh)
+        key = (test, load, compress, cache)
+        for m, acc in d.items():
+            out[key][m] = acc
+    return out
+
+
+def fmt_table(rows, header):
+    lines = ["| " + " | ".join(header) + " |",
+             "|" + "---|" * len(header)]
+    for r in rows:
+        lines.append("| " + " | ".join(str(c) for c in r) + " |")
+    return "\n".join(lines)
+
+
+def main(root="exps"):
+    # exp1: accuracy vs load per app
+    res = load_results(os.path.join(root, "exp1/results/accuracy_*.pickle"))
+    if res:
+        methods = [m for m in METHOD_ORDER
+                   if any(m in v for v in res.values())]
+        print("### exp1 — end-to-end accuracy vs load (fig4a)\n")
+        for app in ("hotel", "node", "media"):
+            keys = sorted((k for k in res if k[0].startswith(app)),
+                          key=lambda k: int(k[1]))
+            if not keys:
+                continue
+            rows = [[k[1]] + [f"{res[k].get(m, float('nan')):.1f}"
+                              for m in methods] for k in keys]
+            print(f"**{app}**\n")
+            print(fmt_table(rows, ["load"] + methods))
+            print()
+
+    # exp2: accuracy vs cache rate
+    res = load_results(os.path.join(root, "exp2/results/accuracy_*.pickle"))
+    if res:
+        methods = [m for m in METHOD_ORDER
+                   if any(m in v for v in res.values())]
+        keys = sorted(res, key=lambda k: float(k[3]))
+        rows = [[k[3]] + [f"{res[k].get(m, float('nan')):.1f}"
+                          for m in methods] for k in keys]
+        print("### exp2 — accuracy vs cache-hit rate, hotel@150 (fig4c)\n")
+        print(fmt_table(rows, ["cache"] + methods))
+        print()
+
+    # exp3: interleaving
+    res = load_results(os.path.join(root, "exp3/results/accuracy_*.pickle"))
+    if res:
+        methods = [m for m in METHOD_ORDER
+                   if any(m in v for v in res.values())]
+        keys = sorted(res)
+        rows = [[k[0]] + [f"{res[k].get(m, float('nan')):.1f}"
+                          for m in methods] for k in keys]
+        print("### exp3 — accuracy vs interleaving intensity (fig4d)\n")
+        print(fmt_table(rows, ["dataset"] + methods))
+        print()
+
+    # exp4: ablation
+    res = load_results(os.path.join(root, "exp4/results/accuracy_*.pickle"))
+    if res:
+        methods = sorted({m for v in res.values() for m in v})
+        print("### exp4 — flagship ablation (fig5)\n")
+        for app in ("hotel", "media"):
+            keys = sorted((k for k in res if k[0].startswith(app)),
+                          key=lambda k: int(k[1]))
+            if not keys:
+                continue
+            rows = [[k[1]] + [f"{res[k].get(m, float('nan')):.1f}"
+                              for m in methods] for k in keys]
+            print(f"**{app}**\n")
+            print(fmt_table(rows, ["load"] + methods))
+            print()
+
+    # exp5: compress ladder (mean over call graphs)
+    res = load_results(os.path.join(root, "exp5/results/accuracy_*.pickle"))
+    if res:
+        methods = [m for m in METHOD_ORDER
+                   if any(m in v for v in res.values())]
+        by_factor = defaultdict(lambda: defaultdict(list))
+        for k, v in res.items():
+            for m, acc in v.items():
+                by_factor[int(k[2])][m].append(acc)
+        print("### exp5 — Alibaba scale: mean e2e accuracy over 15 call "
+              "graphs vs compress factor (fig6a)\n")
+        rows = []
+        for f in sorted(by_factor):
+            rows.append([f] + [
+                f"{sum(by_factor[f][m]) / len(by_factor[f][m]):.1f}"
+                if by_factor[f].get(m) else "—" for m in methods])
+        print(fmt_table(rows, ["compress"] + methods))
+        print()
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])
