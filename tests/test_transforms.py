@@ -60,3 +60,35 @@ def test_cache_hits_mark_skips_and_delete_spans():
     for s in out_parts["B"]:
         expected = 400 - 200 if s.trace_id in cached_tids else 400
         assert s.start_mus - 1000 * int(s.trace_id[1:]) == expected
+
+
+def test_compress_spans_multi_call_traces():
+    """Per-trace rigid rebase: traces where a service fires twice (or an
+    endpoint is missing) compress without the reference's 1:1 alignment
+    requirement, preserving intra-trace offsets exactly."""
+    from traceweaver_tpu.spans import Span
+    from traceweaver_tpu.synth.transforms import compress_spans
+
+    def mk(tid, sid, start, dur, kind):
+        return Span(tid, sid, start, dur, "op", [], "p", kind, {})
+
+    in_parts = {"ep_in": [
+        mk("t1", "a", 1_000_000, 500, "server"),
+        mk("t1", "b", 1_000_800, 500, "server"),   # second call, same trace
+        mk("t2", "c", 9_000_000, 500, "server"),
+    ]}
+    out_parts = {"ep_out": [
+        mk("t1", "d", 1_000_100, 50, "client"),    # only one outgoing for t1
+        mk("t2", "e", 9_000_200, 50, "client"),
+    ]}
+    compress_spans(in_parts, out_parts, 1, 100.0)
+
+    by_sid = {s.sid: s for part in (*in_parts.values(), *out_parts.values())
+              for s in part}
+    # t1 anchored at 1_000_000 -> 10_000; offsets preserved
+    assert by_sid["a"].start_mus == 10_000
+    assert by_sid["b"].start_mus == 10_800
+    assert by_sid["d"].start_mus == 10_100
+    # t2 anchored independently
+    assert by_sid["c"].start_mus == 90_000
+    assert by_sid["e"].start_mus == 90_200

@@ -28,22 +28,40 @@ from traceweaver_tpu.alibaba.schema import CallRecord
 
 
 def _random_topology(rng: random.Random, n_services: int):
-    """A call tree as a list of (rpc_id, caller_idx, callee_idx)."""
+    """A call tree as a list of (rpc_id, caller_idx, callee_idx).
+
+    Upholds the invariant the reference's signature-grouped Alibaba data
+    holds (and its transforms/plugin contract assume, reference
+    transforms.py:26-29): every service is the callee of AT MOST ONE call
+    per trace, so each per-service partition carries exactly one span per
+    trace. Self-calls (exercising the ``-loop`` remap of the ingester,
+    reference executor.py:386-399) are emitted only as childless leaves —
+    the remapped ``svc-loop`` callee then has no outgoing spans and is
+    skipped by the per-service partitioner rather than creating a
+    multi-incoming grading ambiguity.
+    """
     depth = rng.randint(2, 4)
     calls = []
     root_svc = 0
+    available = [s for s in range(n_services) if s != root_svc]
+    rng.shuffle(available)
 
     def expand(rpc_id: str, svc: int, level: int) -> None:
         if level >= depth:
             return
         fanout = rng.randint(1, 3) if level < depth - 1 else rng.randint(0, 2)
+        self_called = False
         for i in range(fanout):
-            # occasional self-call (caller == callee) to exercise -loop logic
-            if rng.random() < 0.08:
-                child_svc = svc
-            else:
-                child_svc = rng.randrange(n_services)
             child_id = f"{rpc_id}.{i + 1}"
+            # occasional self-call (caller == callee) to exercise -loop
+            # logic; always a leaf, at most one per service (see docstring)
+            if rng.random() < 0.08 and not self_called:
+                calls.append((child_id, svc, svc))
+                self_called = True
+                continue
+            if not available:
+                return
+            child_svc = available.pop()
             calls.append((child_id, svc, child_svc))
             expand(child_id, child_svc, level + 1)
 

@@ -49,25 +49,47 @@ def compress_spans(
     compress_factor: float,
 ) -> Tuple[Dict[str, List[Span]], Dict[str, List[Span]]]:
     """Divide arrival times by ``compress_factor``, preserving per-request
-    internal offsets. In-place; returns the partitions re-sorted by time."""
+    internal offsets. In-place; returns the partitions re-sorted by time.
+
+    Each trace is rebased rigidly: its earliest incoming span's start is
+    divided by the factor and every span of the trace shifts by the same
+    delta. For the reference's aligned case — exactly one span per trace
+    in every partition (its ``repeat_change_spans`` asserts this,
+    reference transforms.py:26-29) — this reproduces the reference result
+    number-for-number; unlike the reference it is also defined for call
+    graphs where a service or endpoint fires several times per trace
+    (Alibaba CGs with repeated invocations or ``-loop`` self-call
+    remaps), which the index-paired reference transform cannot express.
+    """
     if repeat_factor == 1 and compress_factor == 1:
         return in_span_partitions, out_span_partitions
 
+    # trace-id pre-sort keeps the final stable time sort's tie order
+    # deterministic (and reference-identical: ms-resolution data often has
+    # equal (start, end) pairs after compression)
     _sort_by_trace_id(in_span_partitions)
     _sort_by_trace_id(out_span_partitions)
 
     assert len(in_span_partitions) == 1
     ep_in, in_spans = next(iter(in_span_partitions.items()))
 
-    for i, in_span in enumerate(in_spans):
-        new_start = in_span.start_mus / compress_factor
-        for ep_out, out_spans in out_span_partitions.items():
-            out_span = out_spans[i]
-            if out_span.trace_id != in_span.trace_id:
-                raise AssertionError("spans are not aligned by trace id")
-            offset = int(out_span.start_mus) - int(in_span.start_mus)
-            out_span.start_mus = new_start + offset
-        in_span.start_mus = new_start
+    # anchor: the earliest incoming span of each trace
+    anchor: Dict = {}
+    for s in in_spans:
+        t = float(s.start_mus)
+        if s.trace_id not in anchor or t < anchor[s.trace_id]:
+            anchor[s.trace_id] = t
+    delta = {
+        tid: t0 / compress_factor - t0 for tid, t0 in anchor.items()
+    }
+
+    for part in [in_spans, *out_span_partitions.values()]:
+        for s in part:
+            if s.trace_id not in delta:
+                raise AssertionError(
+                    f"outgoing span {s.GetId()} belongs to trace "
+                    f"{s.trace_id} with no incoming span")
+            s.start_mus = s.start_mus + delta[s.trace_id]
 
     _sort_by_time(in_span_partitions)
     _sort_by_time(out_span_partitions)
