@@ -83,7 +83,8 @@ MERGE_ELEMS = 1 << 24
 # Device solve (jit + vmap over windows)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps"))
+@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
+                                   "sinkhorn_tol"))
 def solve_windows(
     in_start,    # [B, W] f32 (window-rebased µs)
     in_end,      # [B, W]
@@ -103,6 +104,7 @@ def solve_windows(
     n_sinkhorn: int = 40,
     topk: int = DEFAULT_TOPK,
     n_sweeps: int = 5,
+    sinkhorn_tol: float = 0.0,
 ):
     """Solve every window by Gauss-Seidel coordinate descent over endpoints.
 
@@ -209,7 +211,8 @@ def solve_windows(
             )
 
             plan = sinkhorn(S_ot, row_marg, col_marg,
-                            epsilon=epsilon, n_iters=n_sinkhorn)
+                            epsilon=epsilon, n_iters=n_sinkhorn,
+                            tol=sinkhorn_tol)
             plan = plan[:W, :]
 
             col_valid = jnp.concatenate([o_v[e], (cap_e > 0)[None]])
@@ -238,14 +241,25 @@ def solve_windows(
             return (chosen_end, chosen_start, backward), (
                 assign, tk.astype(jnp.int32), not_best, feas_count)
 
-        def sweep_step(carry, sweep):
-            (chosen_end, chosen_start, _), _ = carry
+        def sweep_body(carry):
+            (chosen_end, chosen_start, _), outs, sweep, _ = carry
+            prev_assign = outs[0]
             state = (chosen_end, chosen_start, sweep > 0)
             state, outs = jax.lax.scan(ep_step, state, jnp.arange(E))
+            # a backward sweep (sweep >= 1) that reproduces the previous
+            # sweep's assignments is a Gauss-Seidel fixed point: chosen
+            # start/end times are functions of the assignments, so every
+            # later sweep recomputes identical outputs — exiting early
+            # changes nothing (exactness, not approximation)
+            changed = jnp.any(outs[0] != prev_assign) | (sweep == 0)
             # outs ride the carry (overwritten each sweep) so only the final
             # sweep's outputs are ever materialized — stacking [n_sweeps, ...]
             # then slicing would cost n_sweeps x the output memory
-            return (state, outs), None
+            return state, outs, sweep + 1, changed
+
+        def sweep_cond(carry):
+            _, _, sweep, changed = carry
+            return (sweep < n_sweeps) & changed
 
         init_state = (
             jnp.zeros((E, W), dtype=in_s.dtype),
@@ -259,8 +273,10 @@ def solve_windows(
             jnp.zeros((E, W), dtype=jnp.int32),
         )
         # one traced sweep body (compile surface independent of n_sweeps)
-        (_, outs), _ = jax.lax.scan(
-            sweep_step, (init_state, init_outs), jnp.arange(n_sweeps))
+        _, outs, _, _ = jax.lax.while_loop(
+            sweep_cond, sweep_body,
+            (init_state, init_outs, jnp.asarray(0, jnp.int32),
+             jnp.asarray(True)))
         return outs
 
     return jax.vmap(solve_one)(
@@ -269,15 +285,17 @@ def solve_windows(
     )
 
 
-@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps"))
+@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
+                                   "sinkhorn_tol"))
 def solve_windows_packed(*args, epsilon: float = 1.0, n_sinkhorn: int = 40,
-                         topk: int = DEFAULT_TOPK, n_sweeps: int = 5):
+                         topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
+                         sinkhorn_tol: float = 0.0):
     """:func:`solve_windows` with the four outputs packed into one int32
     tensor ``[B, E, W, 3+topk]`` (assign, not_best, feas_count, topk...) so a
     solve costs a single device->host transfer instead of four."""
     assign, tk, not_best, feas = solve_windows(
         *args, epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
-        n_sweeps=n_sweeps,
+        n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
     )
     return jnp.concatenate(
         [assign[..., None], not_best[..., None].astype(jnp.int32),
@@ -328,7 +346,8 @@ def em_family_samples(assign, in_start, in_end, in_valid,
             jnp.concatenate([mi, me, mr], axis=0))
 
 
-@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps"))
+@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
+                                   "sinkhorn_tol"))
 def solve_em_packed(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
     skip_cap, force_skip, pred_mask, root_mask, is_last,
@@ -336,6 +355,7 @@ def solve_em_packed(
     ret_wt, ret_mu, ret_sd,
     epsilon: float = 1.0, n_sinkhorn: int = 40,
     topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
+    sinkhorn_tol: float = 0.0,
 ):
     """Both EM iterations in ONE device dispatch.
 
@@ -365,6 +385,7 @@ def solve_em_packed(
         edge_wt, edge_mu, edge_sd, in_wt, in_mu, in_sd,
         ret_wt, ret_mu, ret_sd,
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk, n_sweeps=n_sweeps,
+        sinkhorn_tol=sinkhorn_tol,
     )
 
     # --- M-step samples: the three production edge families --------------
@@ -388,6 +409,7 @@ def solve_em_packed(
         w[:E], mu[:E], sd[:E],
         w[E + E * E:], mu[E + E * E:], sd[E + E * E:],
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk, n_sweeps=n_sweeps,
+        sinkhorn_tol=sinkhorn_tol,
     )
 
 
@@ -627,13 +649,18 @@ class WeaverTPU:
 
     def __init__(self, all_spans, all_processes, max_window: int = DEFAULT_MAX_WINDOW,
                  epsilon: float = 1.0, n_sinkhorn: int = 40, n_sweeps: int = 5,
-                 mesh=None, score_mode: str = "mixture"):
+                 mesh=None, score_mode: str = "mixture",
+                 sinkhorn_tol: float = 1e-3):
         self.all_spans = all_spans
         self.all_processes = all_processes
         self.max_window = max_window
         self.epsilon = epsilon
         self.n_sinkhorn = n_sinkhorn
         self.n_sweeps = n_sweeps
+        # early-exit tolerance for the Sinkhorn potentials (n_sinkhorn stays
+        # the hard cap); the Gauss-Seidel sweep loop exits exactly on
+        # assignment stability regardless of this value
+        self.sinkhorn_tol = sinkhorn_tol
         # optional jax.sharding.Mesh: window batches shard over its first
         # axis (XLA SPMD over ICI); None = single device
         self.mesh = mesh
@@ -782,7 +809,10 @@ class WeaverTPU:
             # analytic op accounting for utilization estimates:
             # score build ~ (E_pred+2) masked mixture evals of K comps
             # (~8 flops each) per cell; Sinkhorn 2 LSE passes/iter
-            # (~6 flops/cell); rounding ~log2(W) rounds (~8 flops/cell)
+            # (~6 flops/cell); rounding ~log2(W) rounds (~8 flops/cell).
+            # NOTE: an UPPER BOUND since the sweep loop and the Sinkhorn
+            # iteration both exit early on convergence — derived MFU/HBM
+            # figures are therefore upper bounds too
             n_passes = 2 if use_fused else 1
             cells = B_c * E * W_c * M_c * n_sweeps * n_passes
             stats["flops_est"] = stats.get("flops_est", 0.0) + cells * (
@@ -808,7 +838,7 @@ class WeaverTPU:
                 a["in_wt"], a["in_mu"], a["in_sd"],
                 a["ret_wt"], a["ret_mu"], a["ret_sd"],
                 epsilon=self.epsilon, n_sinkhorn=self.n_sinkhorn,
-                n_sweeps=n_sweeps,
+                n_sweeps=n_sweeps, sinkhorn_tol=self.sinkhorn_tol,
             )
             stats["dispatch_s"] = stats.get("dispatch_s", 0.0) + (
                 _time.perf_counter() - t0)

@@ -31,7 +31,8 @@ import jax.numpy as jnp
 NEG = -1.0e9
 
 
-def _kernel(s_ref, r_ref, c_ref, out_ref, *, n_iters: int, inv_eps: float):
+def _kernel(s_ref, r_ref, c_ref, out_ref, *, n_iters: int, inv_eps: float,
+            tol_phi: float):
     logK = s_ref[:] * inv_eps      # [N, M], VMEM-resident throughout
     log_r = r_ref[:]               # [N, 1] log row marginals (NEG = disabled)
     log_c = c_ref[:]               # [1, M]
@@ -44,8 +45,7 @@ def _kernel(s_ref, r_ref, c_ref, out_ref, *, n_iters: int, inv_eps: float):
         m = jnp.max(x, axis=0, keepdims=True)
         return m + jnp.log(jnp.sum(jnp.exp(x - m), axis=0, keepdims=True))
 
-    def body(_, fg):
-        f, g = fg
+    def update(f, g):
         f = log_r - lse_rows(logK + g)
         f = jnp.where(log_r > NEG / 2, f, NEG)
         g = log_c - lse_cols(logK + f)
@@ -54,7 +54,25 @@ def _kernel(s_ref, r_ref, c_ref, out_ref, *, n_iters: int, inv_eps: float):
 
     f = jnp.zeros_like(log_r)
     g = jnp.zeros_like(log_c)
-    f, g = jax.lax.fori_loop(0, n_iters, body, (f, g))
+    if tol_phi == 0.0:
+        # fixed count — the pre-tolerance codegen (plain counted loop)
+        f, g = jax.lax.fori_loop(
+            0, n_iters, lambda _, fg: update(*fg), (f, g))
+    else:
+        def body(state):
+            f, g, it, _ = state
+            f_new, g_new = update(f, g)
+            live = log_r > NEG / 2
+            delta = jnp.max(jnp.where(live, jnp.abs(f_new - f), 0.0))
+            return f_new, g_new, it + 1, delta
+
+        def cond(state):
+            _, _, it, delta = state
+            return (it < n_iters) & (delta > tol_phi)
+
+        init = (f, g, jnp.asarray(0, jnp.int32),
+                jnp.asarray(jnp.inf, jnp.float32))
+        f, g, _, _ = jax.lax.while_loop(cond, body, init)
     out_ref[:] = jnp.exp(jnp.clip(logK + f + g, -80.0, 80.0))
 
 
@@ -63,7 +81,7 @@ def _round_up(n: int, k: int) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("epsilon", "n_iters", "interpret"))
+    jax.jit, static_argnames=("epsilon", "n_iters", "interpret", "tol"))
 def sinkhorn_log_pallas(
     scores: jnp.ndarray,         # [N, M] log-likelihoods (NEG = masked)
     row_marginals: jnp.ndarray,  # [N] target row masses (0 disables a row)
@@ -71,11 +89,14 @@ def sinkhorn_log_pallas(
     epsilon: float = 1.0,
     n_iters: int = 50,
     interpret: bool = False,
+    tol: float = 0.0,
 ) -> jnp.ndarray:
     """Drop-in for :func:`traceweaver_tpu.ops.sinkhorn.sinkhorn_log`.
 
     Pads to TPU tile multiples (8 sublanes × 128 lanes for f32); padded
     rows/columns carry marginal 0 and score NEG, so they take no mass.
+    ``tol`` has the same early-exit semantics as ``sinkhorn_log`` (it is
+    rescaled to the kernel's ``φ = f/ε`` potentials internally).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -97,7 +118,8 @@ def sinkhorn_log_pallas(
         c, log_c.astype(jnp.float32)[None, :], (0, 0))
 
     kernel = functools.partial(
-        _kernel, n_iters=n_iters, inv_eps=1.0 / epsilon)
+        _kernel, n_iters=n_iters, inv_eps=1.0 / epsilon,
+        tol_phi=tol / epsilon)
     plan = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((np_, mp), jnp.float32),
@@ -128,7 +150,8 @@ def use_pallas() -> bool:
     return _tpu_backend()
 
 
-def sinkhorn(scores, row_marginals, col_marginals, epsilon=1.0, n_iters=50):
+def sinkhorn(scores, row_marginals, col_marginals, epsilon=1.0, n_iters=50,
+             tol=0.0):
     """Backend-dispatching Sinkhorn: the fused Pallas kernel on TPU (or when
     forced via TW_PALLAS=1), the pure-jnp path elsewhere. Small blocks stay
     on the jnp path — lane padding to 128 would dominate them.
@@ -144,19 +167,19 @@ def sinkhorn(scores, row_marginals, col_marginals, epsilon=1.0, n_iters=50):
     n, m = scores.shape
     if not use_pallas() or n * m < 64 * 128:
         return sinkhorn_log(scores, row_marginals, col_marginals,
-                            epsilon=epsilon, n_iters=n_iters)
+                            epsilon=epsilon, n_iters=n_iters, tol=tol)
     if os.environ.get("TW_PALLAS_INTERPRET") == "1":
         # explicit kernel-semantics testing off-TPU
         return sinkhorn_log_pallas(
             scores, row_marginals, col_marginals,
-            epsilon=epsilon, n_iters=n_iters, interpret=True)
+            epsilon=epsilon, n_iters=n_iters, interpret=True, tol=tol)
 
     def _tpu_path(s, r, c):
         return sinkhorn_log_pallas(s, r, c, epsilon=epsilon,
-                                   n_iters=n_iters, interpret=False)
+                                   n_iters=n_iters, interpret=False, tol=tol)
 
     def _other_path(s, r, c):
-        return sinkhorn_log(s, r, c, epsilon=epsilon, n_iters=n_iters)
+        return sinkhorn_log(s, r, c, epsilon=epsilon, n_iters=n_iters, tol=tol)
 
     return jax.lax.platform_dependent(
         scores, row_marginals, col_marginals,

@@ -21,27 +21,34 @@ import jax.numpy as jnp
 NEG = -1.0e9  # effective -inf for masked scores
 
 
-@partial(jax.jit, static_argnames=("n_iters",))
+@partial(jax.jit, static_argnames=("n_iters", "tol"))
 def sinkhorn_log(
     scores: jnp.ndarray,       # [N, M] log-likelihood (higher = better)
     row_marginals: jnp.ndarray,  # [N] target row masses (0 disables a row)
     col_marginals: jnp.ndarray,  # [M] target column masses (0 disables)
     epsilon: float = 1.0,
     n_iters: int = 50,
+    tol: float = 0.0,
 ) -> jnp.ndarray:
     """Entropic OT plan maximizing <P, scores> + eps*H(P) under marginals.
 
     Returns the transport plan P [N, M] with row sums ≈ row_marginals and
     column sums ≈ col_marginals (marginals must have equal totals; padded
     rows/columns carry marginal 0 and are excluded via -inf potentials).
+
+    ``tol`` > 0 stops the iteration early once the row potentials move by
+    less than ``tol`` (in units of the epsilon-scaled log potentials, so a
+    plan entry changes by a factor < e^(2*tol/epsilon)); typical window
+    score matrices converge in well under half the iteration budget, and
+    the loop is the solver's dominant sequential cost. ``tol=0`` runs the
+    full fixed count (bitwise-identical to the pre-tolerance behaviour).
     """
     log_r = jnp.where(row_marginals > 0, jnp.log(jnp.maximum(row_marginals, 1e-30)), NEG)
     log_c = jnp.where(col_marginals > 0, jnp.log(jnp.maximum(col_marginals, 1e-30)), NEG)
 
     logK = scores / epsilon  # [N, M]
 
-    def body(_, fg):
-        f, g = fg
+    def update(f, g):
         # f_i = eps*(log r_i - LSE_j(logK_ij + g_j/eps))
         f = epsilon * (log_r - jax.nn.logsumexp(logK + g[None, :] / epsilon, axis=1))
         f = jnp.where(row_marginals > 0, f, NEG)
@@ -51,7 +58,27 @@ def sinkhorn_log(
 
     f0 = jnp.zeros_like(row_marginals, dtype=scores.dtype)
     g0 = jnp.zeros_like(col_marginals, dtype=scores.dtype)
-    f, g = jax.lax.fori_loop(0, n_iters, body, (f0, g0))
+    if tol == 0.0:
+        # fixed count: keeps the pre-tolerance codegen (fori_loop is
+        # reverse-mode differentiable; while_loop is not)
+        f, g = jax.lax.fori_loop(
+            0, n_iters, lambda _, fg: update(*fg), (f0, g0))
+    else:
+        def body(state):
+            f, g, it, _ = state
+            f_new, g_new = update(f, g)
+            # delta over live rows (disabled rows sit at NEG on both sides)
+            live = row_marginals > 0
+            delta = jnp.max(jnp.where(live, jnp.abs(f_new - f), 0.0))
+            return f_new, g_new, it + 1, delta
+
+        def cond(state):
+            _, _, it, delta = state
+            return (it < n_iters) & (delta > tol)
+
+        init = (f0, g0, jnp.asarray(0, jnp.int32),
+                jnp.asarray(jnp.inf, scores.dtype))
+        f, g, _, _ = jax.lax.while_loop(cond, body, init)
 
     log_plan = logK + (f[:, None] + g[None, :]) / epsilon
     return jnp.exp(jnp.clip(log_plan, -80.0, 80.0))
