@@ -210,6 +210,7 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     import threading
     from concurrent.futures import ThreadPoolExecutor
 
+    from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
     from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
     from traceweaver_tpu.metrics import accuracy_for_service
 
@@ -217,6 +218,7 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
             for store, problems in bundles
             for label, svc, prob, ta, dag in problems]
     stats_lock = threading.Lock()
+    use_fleet = os.environ.get("TW_BENCH_FLEET", "1") not in ("0", "false")
 
     def solve_one(item, stage_stats=None):
         label, svc, prob, ta, dag, store = item
@@ -233,8 +235,21 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         return label, out[0]
 
     def one_pass(stage_stats=None):
-        # services solved concurrently: device dispatches overlap through
-        # the tunnel (the reference's ThreadPool-over-services model)
+        if use_fleet:
+            # ALL services (both apps) ride one fused device program —
+            # pass0 + per-service BIC-GMM refit + pass1, one round trip
+            # (fleet.py; proven assignment-identical to the per-service
+            # path by tests/test_fleet.py)
+            items = [FleetItem(svc, prob.in_span_partitions,
+                               prob.out_span_partitions, ta, dag,
+                               store=store)
+                     for _, svc, prob, ta, dag, store in flat]
+            outs = solve_fleet(
+                items, stats=stage_stats if stage_stats is not None else {})
+            return {label: out[0]
+                    for (label, *_), out in zip(flat, outs)}
+        # fallback: per-service solves, dispatches overlapped by threads
+        # (the reference's ThreadPool-over-services model)
         with ThreadPoolExecutor(max_workers=max(1, len(flat))) as pool:
             preds = dict(pool.map(
                 lambda it: solve_one(it, stage_stats), flat))
@@ -286,6 +301,7 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     # size the exact path managed to finish. -----------------------------
     subset_accs = {}
     t0 = time.perf_counter()
+    sub_items, sub_meta = [], []
     for n in dict.fromkeys((SUBSET_SPANS, SUBSET_RETRY)):
         for label, svc, prob, ta, dag, store in flat:
             sub_in, sub_ta = subset_problem(prob, n)
@@ -294,15 +310,26 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
             # from the baseline's recorded n_spans; identical subsets
             # (service shorter than both sizes) solve once
             n_actual = len(next(iter(sub_in.values())))
-            if f"{label}@{n_actual}" in subset_accs:
+            key = f"{label}@{n_actual}"
+            if key in subset_accs or any(k == key for k, _, _ in sub_meta):
                 continue
-            algo = WeaverTPU(store.all_spans, store.all_processes)
+            sub_items.append(FleetItem(svc, sub_in,
+                                       prob.out_span_partitions, sub_ta,
+                                       dag, store=store))
+            sub_meta.append((key, sub_in, sub_ta))
+    if use_fleet:
+        # every subset ride-shares one dispatch too
+        outs = solve_fleet(sub_items)
+        for (key, sub_in, sub_ta), out in zip(sub_meta, outs):
+            subset_accs[key] = accuracy_for_service(out[0], sub_ta, sub_in)
+    else:
+        for item, (key, sub_in, sub_ta) in zip(sub_items, sub_meta):
+            algo = WeaverTPU(item.store.all_spans, item.store.all_processes)
             out = algo.FindAssignments(
-                "MaxScoreBatchSubsetWithSkips", svc, sub_in,
-                prob.out_span_partitions, False, [], sub_ta, dag,
+                "MaxScoreBatchSubsetWithSkips", item.svc, sub_in,
+                item.out_span_partitions, False, [], sub_ta, item.dag,
             )
-            subset_accs[f"{label}@{n_actual}"] = accuracy_for_service(
-                out[0], sub_ta, sub_in)
+            subset_accs[key] = accuracy_for_service(out[0], sub_ta, sub_in)
     log(f"child: subset pass {time.perf_counter() - t0:.1f}s")
 
     # --- Pallas kernel on-device proof (non-interpret) -------------------

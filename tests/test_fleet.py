@@ -1,0 +1,101 @@
+"""Fleet (cross-service fused dispatch) vs per-service solve equivalence.
+
+The fleet path pads every service's windows to one [B, E, W, M] shape
+class and solves them in a single device program (fleet.py). Padding and
+param-table indexing must be invisible: masked rows/columns/endpoints
+cannot move any real assignment, so the fleet must reproduce the
+per-service flagship exactly on recorded data — including the on-device
+two-pass EM, whose per-service family refit must match the single-service
+fused refit sample-for-sample.
+"""
+
+import numpy as np
+import pytest
+
+from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
+from traceweaver_tpu.ingest import (
+    build_service_problem,
+    infer_invocation_dag,
+    load_corpus,
+)
+from traceweaver_tpu.metrics import accuracy_for_service, get_ground_truth
+
+HOTEL = "/root/reference/data/hotel_reservation/hotel_load25"
+
+
+def _problems(path, fix, n_traces=300):
+    store = load_corpus(path, fix=fix, max_traces=n_traces, cache=False)
+    out = []
+    for svc in store.out_spans_by_process:
+        prob = build_service_problem(store, svc)
+        if prob.skipped:
+            continue
+        ta = get_ground_truth(prob.in_span_partitions,
+                              prob.out_span_partitions)
+        dag = infer_invocation_dag(prob.in_span_partitions,
+                                   prob.out_span_partitions, ta, store)
+        out.append((store, svc, prob, ta, dag))
+    return out
+
+
+@pytest.fixture(scope="module")
+def hotel_problems():
+    return _problems(HOTEL, fix=2)
+
+
+def test_fleet_single_dispatch_matches_per_service(hotel_problems):
+    items, singles = [], []
+    for store, svc, prob, ta, dag in hotel_problems:
+        algo = WeaverTPU(store.all_spans, store.all_processes)
+        singles.append(algo.FindAssignments(
+            "MaxScoreBatchSubsetWithSkips", svc, prob.in_span_partitions,
+            prob.out_span_partitions, False, [], ta, dag))
+        items.append(FleetItem(svc, prob.in_span_partitions,
+                               prob.out_span_partitions, ta, dag,
+                               store=store))
+    assert len(items) >= 2  # frontend + search, different endpoint counts
+
+    stats = {}
+    fleet = solve_fleet(items, stats=stats)
+
+    assert stats.get("fleet_dispatches") == 1
+    assert stats.get("fleet_services") == len(items)
+    for (store, svc, prob, ta, dag), f, s in zip(hotel_problems, fleet,
+                                                 singles):
+        # identical hard assignments endpoint-for-endpoint
+        assert f[0] == s[0], f"fleet assignments diverge on {svc}"
+        # and identical bookkeeping counts
+        assert f[3] == s[3]
+        acc_f = accuracy_for_service(f[0], ta, prob.in_span_partitions)
+        acc_s = accuracy_for_service(s[0], ta, prob.in_span_partitions)
+        assert acc_f == acc_s
+
+
+def test_fleet_routes_ineligible_items_to_fallback(hotel_problems):
+    store, svc, prob, ta, dag = hotel_problems[0]
+    # no DAG -> bootstrap/1-iteration path -> fleet must fall back and
+    # still return a FindAssignments-shaped result
+    items = [FleetItem(svc, prob.in_span_partitions,
+                       prob.out_span_partitions, ta, dag=None, store=store)]
+    stats = {}
+    out = solve_fleet(items, stats=stats)
+    assert stats.get("fleet_dispatches") is None
+    assert len(out) == 1 and len(out[0]) == 6
+    acc = accuracy_for_service(out[0][0], ta, prob.in_span_partitions)
+    assert acc > 0.9
+
+
+def test_fleet_budget_fallback_is_equivalent(hotel_problems, monkeypatch):
+    import traceweaver_tpu.algorithms.fleet as fleet_mod
+
+    items = [FleetItem(svc, prob.in_span_partitions,
+                       prob.out_span_partitions, ta, dag, store=store)
+             for store, svc, prob, ta, dag in hotel_problems]
+    fused = solve_fleet(items)
+    monkeypatch.setattr(fleet_mod, "FLEET_BUDGET_ELEMS", 1)
+    stats = {}
+    fell_back = solve_fleet(items, stats=stats)
+    assert stats.get("fleet_fallback_budget") == 1.0
+    for f, s in zip(fused, fell_back):
+        assert f[0] == s[0]

@@ -1,0 +1,292 @@
+"""Fleet solve: every service's windows in one device dispatch.
+
+The reference exploits multi-service workloads only through a host thread
+pool — one ``FindAssignments`` call per service, concurrency from Python
+threads (reference executor.py:1015-1026). On TPU that model leaves the
+chip idle: each per-service solve is its own device program, and through
+the sandbox's remote-device tunnel every dispatch costs ~100 ms of round
+trip, so an 8-service workload pays ~8 round trips of pure latency.
+
+This module is the TPU-native alternative (SURVEY.md §2.8 "services
+become a batch dimension"): the window batches of *all* services are
+padded to a common ``[B, E, W, M]`` shape class, each window tagged with
+``param_idx`` — the row of its service's DAG-structure/distribution
+tables — and the whole fleet rides ONE jitted program
+(:func:`traceweaver_tpu.algorithms.weaver_tpu.solve_em_fleet`), including
+both EM passes and the batched BIC-GMM refit between them. Padding is
+pure VPU work; the dispatch count (the actual bottleneck — measured MFU
+is <1%, so the VPU has headroom to burn) drops from O(services) to O(1).
+
+Services whose method needs the host in the loop (KDE score mode,
+single-iteration parallel mode, the true-skips/true-dist oracles) fall
+back to the per-service :class:`WeaverTPU` path; the fleet handles the
+production flagship configuration.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from traceweaver_tpu.algorithms import timing
+from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
+from traceweaver_tpu.algorithms.weaver_tpu import (
+    DEFAULT_MAX_WINDOW,
+    WeaverTPU,
+    _bucket,
+    candidate_ranges,
+    pack_problem,
+    perfect_cut_windows,
+    solve_em_fleet,
+)
+from traceweaver_tpu.spans import NA
+
+# fleet single-dispatch budget: live f32 elements of the [B, E, W, M]
+# score block (the dominant allocation). Past this the padded single
+# program would stress HBM; fall back to per-service dispatches instead.
+FLEET_BUDGET_ELEMS = int(os.environ.get("TW_FLEET_BUDGET", 1 << 28))
+
+
+class FleetItem:
+    """One service's solve request (the FindAssignments argument set)."""
+
+    def __init__(self, svc, in_span_partitions, out_span_partitions,
+                 true_assignments, dag=None,
+                 method="MaxScoreBatchSubsetWithSkips", store=None):
+        self.svc = svc
+        self.in_span_partitions = in_span_partitions
+        self.out_span_partitions = out_span_partitions
+        self.true_assignments = true_assignments
+        self.dag = dag
+        self.method = method
+        # optional TraceStore for the per-service fallback path (its host
+        # EM refit reads the global span table); unused by the fused path
+        self.store = store
+
+
+def _prepare(item: FleetItem, solver: WeaverTPU):
+    """Host preamble of FindAssignments for one item (sort, topo order,
+    skip budget, bootstrap distributions). Returns None when the item
+    needs a code path the fleet does not cover."""
+    in_ep, in_spans = next(iter(item.in_span_partitions.items()))
+    in_spans = sorted(in_spans, key=lambda s: (s.start_mus, s.end_mus))
+    out_eps = solver._topo_out_eps(item.out_span_partitions, item.dag)
+    n_in = len(in_spans)
+    skip_budget = {
+        ep: n_in - len(item.out_span_partitions[ep]) for ep in out_eps
+    }
+    dynamism = any(b > 0 for b in skip_budget.values())
+    # fleet covers the two-iteration fused-EM flagship configuration only
+    if dynamism or item.dag is None or solver.score_mode != "mixture":
+        return None
+    if item.method != "MaxScoreBatchSubsetWithSkips":
+        return None
+    dists = timing.estimate_edge_params(
+        item.in_span_partitions, item.out_span_partitions, item.dag,
+        0, n_in,
+    )
+    return dict(in_ep=in_ep, in_spans=in_spans, out_eps=out_eps,
+                skip_budget=skip_budget, dists=dists, n_in=n_in)
+
+
+def solve_fleet(
+    items: List[FleetItem],
+    all_spans=None,
+    all_processes=None,
+    max_window: int = DEFAULT_MAX_WINDOW,
+    epsilon: float = 1.0,
+    n_sinkhorn: int = 40,
+    n_sweeps: int = 5,
+    sinkhorn_tol: float = 1e-3,
+    stats: Optional[Dict[str, float]] = None,
+) -> List[Tuple]:
+    """Solve every item, fusing eligible ones into one device dispatch.
+
+    Returns one FindAssignments-style 6-tuple per item, in order:
+    ``(all_assignments, all_topk, not_best_count, n_spans,
+    per_span_candidates, cnt_unassigned)``.
+    """
+    solver = WeaverTPU(all_spans, all_processes, max_window=max_window,
+                       epsilon=epsilon, n_sinkhorn=n_sinkhorn,
+                       n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol)
+    results: List[Optional[Tuple]] = [None] * len(items)
+
+    prepared = []
+    for i, item in enumerate(items):
+        prep = _prepare(item, solver)
+        if prep is None:
+            # host-in-the-loop configuration: per-service path
+            algo = WeaverTPU(
+                item.store.all_spans if item.store else all_spans,
+                item.store.all_processes if item.store else all_processes,
+                max_window=max_window, epsilon=epsilon,
+                n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
+                sinkhorn_tol=sinkhorn_tol)
+            results[i] = algo.FindAssignments(
+                item.method, item.svc, item.in_span_partitions,
+                item.out_span_partitions, False, [], item.true_assignments,
+                item.dag,
+            )
+        else:
+            prepared.append((i, item, prep))
+    if not prepared:
+        return results  # type: ignore[return-value]
+
+    t0 = time.perf_counter()
+    # --- fleet shape class -----------------------------------------------
+    W_pad = M_pad = E_pad = 1
+    plans = []
+    for i, item, prep in prepared:
+        in_spans, out_eps = prep["in_spans"], prep["out_eps"]
+        windows = perfect_cut_windows(in_spans, max_window)
+        out_starts_np = {
+            ep: np.array(sorted(float(s.start_mus)
+                                for s in item.out_span_partitions[ep]))
+            for ep in out_eps
+        }
+        ranges = candidate_ranges(in_spans, windows, out_eps, out_starts_np)
+        skip_caps = water_fill_skip_caps(
+            windows, ranges, len(in_spans),
+            [len(item.out_span_partitions[ep]) for ep in out_eps])
+        plans.append((i, item, prep, windows, ranges, skip_caps))
+        W_pad = max(W_pad, _bucket(max(hi - lo for lo, hi in windows)))
+        M_pad = max(M_pad, _bucket(
+            int((ranges[:, :, 1] - ranges[:, :, 0]).max(initial=1))))
+        E_pad = max(E_pad, len(out_eps))
+
+    n_windows_total = sum(len(w) for _, _, _, w, _, _ in plans)
+    if n_windows_total * E_pad * W_pad * M_pad > FLEET_BUDGET_ELEMS:
+        # padded fleet block would stress HBM: per-service dispatches
+        for i, item, prep, *_ in plans:
+            algo = WeaverTPU(
+                item.store.all_spans if item.store else all_spans,
+                item.store.all_processes if item.store else all_processes,
+                max_window=max_window, epsilon=epsilon,
+                n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
+                sinkhorn_tol=sinkhorn_tol)
+            results[i] = algo.FindAssignments(
+                item.method, item.svc, item.in_span_partitions,
+                item.out_span_partitions, False, [], item.true_assignments,
+                item.dag,
+            )
+        if stats is not None:
+            stats["fleet_fallback_budget"] = 1.0
+        return results  # type: ignore[return-value]
+
+    # --- pack every service at the fleet shape ---------------------------
+    arrays_cat: Dict[str, List[np.ndarray]] = {}
+    param_rows = {k: [] for k in (
+        "pred_mask", "root_mask", "is_last",
+        "edge_wt", "edge_mu", "edge_sd",
+        "in_wt", "in_mu", "in_sd", "ret_wt", "ret_mu", "ret_sd")}
+    per_item_pack = []
+    param_idx = []
+    for p, (i, item, prep, windows, ranges, skip_caps) in enumerate(plans):
+        packed = pack_problem(
+            prep["in_spans"], item.out_span_partitions, prep["out_eps"],
+            prep["dists"], prep["in_ep"], item.dag,
+            parallel=False, windows=windows,
+            pad_w=W_pad, pad_m=M_pad, pad_e=E_pad,
+            ranges=ranges, skip_caps=skip_caps,
+        )
+        a = packed.arrays
+        n_w = len(windows)
+        for key in ("in_start", "in_end", "in_valid", "out_start",
+                    "out_end", "out_valid", "skip_cap", "force_skip"):
+            # drop pack_problem's power-of-two B padding: the fleet batch
+            # is exact, and decode indexes out_ids by original row b which
+            # is preserved under row slicing
+            arrays_cat.setdefault(key, []).append(a[key][:n_w])
+        # keep the id tables consistent with the sliced row count
+        # (_decode sizes its gather table from the assign rows it is given)
+        packed.out_ids = [col[:n_w * M_pad] for col in packed.out_ids]
+        for key in param_rows:
+            param_rows[key].append(a[key])
+        param_idx.extend([p] * n_w)
+        per_item_pack.append((i, item, prep, packed, n_w))
+
+    batch = {k: np.concatenate(v, axis=0) for k, v in arrays_cat.items()}
+    params = {k: np.stack(v, axis=0) for k, v in param_rows.items()}
+    pidx = np.asarray(param_idx, dtype=np.int32)
+    if stats is not None:
+        stats["pack_s"] = stats.get("pack_s", 0.0) + time.perf_counter() - t0
+        stats["fleet_dispatches"] = stats.get("fleet_dispatches", 0.0) + 1
+        stats["fleet_services"] = float(len(per_item_pack))
+        # analytic op accounting (UPPER BOUND — sweep and Sinkhorn loops
+        # exit early on convergence), same model as WeaverTPU._solve_once
+        K = params["in_wt"].shape[2]
+        cells = (n_windows_total * E_pad * W_pad * M_pad
+                 * n_sweeps * 2)  # 2 fused EM passes
+        stats["flops_est"] = stats.get("flops_est", 0.0) + cells * (
+            8.0 * K * (E_pad + 2)
+            + 6.0 * 2 * n_sinkhorn
+            + 8.0 * max(1, W_pad.bit_length())
+        )
+        stats["bytes_est_xla"] = stats.get("bytes_est_xla", 0.0) + (
+            cells * 4.0 * 2 * n_sinkhorn)
+        stats["bytes_est_pallas"] = stats.get(
+            "bytes_est_pallas", 0.0) + cells * 4.0 * 3
+        stats["fused_em_applied"] = 1.0
+
+    # --- one device program: pass0 + per-service BIC-GMM refit + pass1 ---
+    t0 = time.perf_counter()
+    out = solve_em_fleet(
+        batch["in_start"], batch["in_end"], batch["in_valid"],
+        batch["out_start"], batch["out_end"], batch["out_valid"],
+        batch["skip_cap"], batch["force_skip"], pidx,
+        params["pred_mask"], params["root_mask"], params["is_last"],
+        params["edge_wt"], params["edge_mu"], params["edge_sd"],
+        params["in_wt"], params["in_mu"], params["in_sd"],
+        params["ret_wt"], params["ret_mu"], params["ret_sd"],
+        epsilon=epsilon, n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
+        sinkhorn_tol=sinkhorn_tol,
+    )
+    if stats is not None:
+        stats["dispatch_s"] = (stats.get("dispatch_s", 0.0)
+                               + time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    o = np.asarray(out)
+    if stats is not None:
+        stats["wait_s"] = stats.get("wait_s", 0.0) + time.perf_counter() - t0
+
+    # --- split + decode per service --------------------------------------
+    t0 = time.perf_counter()
+    row = 0
+    for i, item, prep, packed, n_w in per_item_pack:
+        rows = o[row:row + n_w]
+        row += n_w
+        assign = rows[..., 0]
+        not_best = rows[..., 1].astype(bool)
+        feas = rows[..., 2]
+        topk_cols = rows[..., 3:]
+        out_eps = prep["out_eps"]
+        in_ids = [s.GetId() for s in prep["in_spans"]]
+        n_in = prep["n_in"]
+
+        all_assignments = {ep: {} for ep in out_eps}
+        all_topk = {ep: {} for ep in out_eps}
+        solver._decode(packed, assign, topk_cols, all_assignments, all_topk)
+        span_not_best = np.zeros(n_in, dtype=bool)
+        span_cands = np.ones(n_in, dtype=np.int64)
+        for b, (lo, hi) in enumerate(packed.windows):
+            for j in range(hi - lo):
+                span_not_best[lo + j] = bool(not_best[b, :, j].any())
+                span_cands[lo + j] = int(np.maximum(feas[b, :, j], 1).prod())
+        solver._resolve_cross_window_duplicates(
+            all_assignments, all_topk, in_ids, prep["skip_budget"])
+        cnt_unassigned = sum(
+            1 for in_id in in_ids
+            if any(all_assignments[ep][in_id] == NA for ep in out_eps)
+        )
+        results[i] = (
+            all_assignments, all_topk, int(span_not_best.sum()), n_in,
+            {in_ids[j]: int(span_cands[j]) for j in range(n_in)},
+            cnt_unassigned,
+        )
+    if stats is not None:
+        stats["decode_s"] = (stats.get("decode_s", 0.0)
+                             + time.perf_counter() - t0)
+    return results  # type: ignore[return-value]

@@ -83,9 +83,7 @@ MERGE_ELEMS = 1 << 24
 # Device solve (jit + vmap over windows)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
-                                   "sinkhorn_tol"))
-def solve_windows(
+def _solve_windows_impl(
     in_start,    # [B, W] f32 (window-rebased µs)
     in_end,      # [B, W]
     in_valid,    # [B, W] bool
@@ -94,39 +92,39 @@ def solve_windows(
     out_valid,   # [B, E, M] bool
     skip_cap,    # [B, E] f32 — skip-column capacity per endpoint
     force_skip,  # [B, E, W] bool — true-skips ablation; normally all False
-    pred_mask,   # [E, E] bool — pred_mask[e, p]: p is a primary DAG pred of e
-    root_mask,   # [E] bool — e additionally scored from the incoming start
-    is_last,     # [E] bool — add the return-edge (e -> in) term
-    edge_wt, edge_mu, edge_sd,  # [E, E, K] mixture params for (p -> e)
-    in_wt, in_mu, in_sd,        # [E, K] params for (in -> e)
-    ret_wt, ret_mu, ret_sd,     # [E, K] params for (e -> in)
-    epsilon: float = 1.0,
-    n_sinkhorn: int = 40,
-    topk: int = DEFAULT_TOPK,
-    n_sweeps: int = 5,
-    sinkhorn_tol: float = 0.0,
+    param_idx,   # [B] int32 — row into the stacked per-problem param tables
+    pred_masks,  # [P, E, E] bool — pred[e, p]: p is a primary DAG pred of e
+    root_masks,  # [P, E] bool — e additionally scored from the incoming start
+    is_lasts,    # [P, E] bool — add the return-edge (e -> in) term
+    edge_wts, edge_mus, edge_sds,  # [P, E, E, K] mixture params for (p -> e)
+    in_wts, in_mus, in_sds,        # [P, E, K] params for (in -> e)
+    ret_wts, ret_mus, ret_sds,     # [P, E, K] params for (e -> in)
+    epsilon: float,
+    n_sinkhorn: int,
+    topk: int,
+    n_sweeps: int,
+    sinkhorn_tol: float,
 ):
-    """Solve every window by Gauss-Seidel coordinate descent over endpoints.
+    """Shared body of :func:`solve_windows` / :func:`solve_windows_fleet`.
 
-    Sweep 0 conditions each endpoint only on its DAG predecessors (forward
-    pass in topological order). Later sweeps re-solve each endpoint with
-    both directions fixed — predecessor completion times below, successor
-    start times above — recovering the joint coupling the reference gets
-    from enumerating whole assignments (traceweaver_v1.py:259-361) without
-    combinatorial search.
-
-    Returns:
-      assign     [B, E, W] int32 — column index per incoming span
-                 (M = skip, -1 = unassigned)
-      topk_cols  [B, E, W, topk] int32 — per-endpoint candidate ranking
-      not_best   [B, E, W] bool — OT choice differs from row argmax
-      feas_count [B, E, W] int32 — feasible candidates per row
+    Every window carries ``param_idx`` — the row of the DAG-structure and
+    distribution tables it scores against — so windows of *different
+    services* batch into one device program (SURVEY §2.8: services become
+    a batch dimension). The single-service entry points pass P=1 and a
+    zero index vector.
     """
     B, E, M = out_start.shape
     W = in_start.shape[1]
     POS = -NEG
 
-    def solve_one(in_s, in_e, in_v, o_s, o_e, o_v, cap, fskip):
+    def solve_one(in_s, in_e, in_v, o_s, o_e, o_v, cap, fskip, pi):
+        # this window's problem tables (one gather per table; P is tiny)
+        pred_mask = pred_masks[pi]      # [E, E]
+        root_mask = root_masks[pi]      # [E]
+        is_last = is_lasts[pi]          # [E]
+        edge_wt, edge_mu, edge_sd = edge_wts[pi], edge_mus[pi], edge_sds[pi]
+        in_wt, in_mu, in_sd = in_wts[pi], in_mus[pi], in_sds[pi]
+        ret_wt, ret_mu, ret_sd = ret_wts[pi], ret_mus[pi], ret_sds[pi]
 
         def ep_step(state, e):
             chosen_end, chosen_start, backward = state
@@ -281,7 +279,54 @@ def solve_windows(
 
     return jax.vmap(solve_one)(
         in_start, in_end, in_valid, out_start, out_end, out_valid,
+        skip_cap, force_skip, param_idx,
+    )
+
+
+@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
+                                   "sinkhorn_tol"))
+def solve_windows(
+    in_start, in_end, in_valid, out_start, out_end, out_valid,
+    skip_cap, force_skip,
+    pred_mask,   # [E, E] bool
+    root_mask,   # [E] bool
+    is_last,     # [E] bool
+    edge_wt, edge_mu, edge_sd,  # [E, E, K]
+    in_wt, in_mu, in_sd,        # [E, K]
+    ret_wt, ret_mu, ret_sd,     # [E, K]
+    epsilon: float = 1.0,
+    n_sinkhorn: int = 40,
+    topk: int = DEFAULT_TOPK,
+    n_sweeps: int = 5,
+    sinkhorn_tol: float = 0.0,
+):
+    """Solve every window by Gauss-Seidel coordinate descent over endpoints.
+
+    Sweep 0 conditions each endpoint only on its DAG predecessors (forward
+    pass in topological order). Later sweeps re-solve each endpoint with
+    both directions fixed — predecessor completion times below, successor
+    start times above — recovering the joint coupling the reference gets
+    from enumerating whole assignments (traceweaver_v1.py:259-361) without
+    combinatorial search.
+
+    Returns:
+      assign     [B, E, W] int32 — column index per incoming span
+                 (M = skip, -1 = unassigned)
+      topk_cols  [B, E, W, topk] int32 — per-endpoint candidate ranking
+      not_best   [B, E, W] bool — OT choice differs from row argmax
+      feas_count [B, E, W] int32 — feasible candidates per row
+    """
+    B = in_start.shape[0]
+    return _solve_windows_impl(
+        in_start, in_end, in_valid, out_start, out_end, out_valid,
         skip_cap, force_skip,
+        jnp.zeros((B,), dtype=jnp.int32),
+        pred_mask[None], root_mask[None], is_last[None],
+        edge_wt[None], edge_mu[None], edge_sd[None],
+        in_wt[None], in_mu[None], in_sd[None],
+        ret_wt[None], ret_mu[None], ret_sd[None],
+        epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
+        n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
     )
 
 
@@ -326,11 +371,19 @@ def em_family_samples(assign, in_start, in_end, in_valid,
     ch_end = jnp.take_along_axis(out_end, safe, axis=2)
     real = (assign >= 0) & (assign < M) & in_valid[:, None, :]
 
+    # structure masks may be shared ([E]/[E, E]) or per-window
+    # ([B, E]/[B, E, E] — the fleet path, where windows belong to
+    # different services)
+    rm = (root_mask if root_mask.ndim == 2
+          else jnp.broadcast_to(root_mask[None], (B, E)))
+    pm = (pred_mask if pred_mask.ndim == 3
+          else jnp.broadcast_to(pred_mask[None], (B, E, E)))
+
     d_in = ch_start - in_start[:, None, :]                    # [B, E, W]
-    m_in = real & root_mask[None, :, None]
+    m_in = real & rm[:, :, None]
     d_edge = ch_start[:, :, None, :] - ch_end[:, None, :, :]  # [B, E, Ep, W]
     m_edge = (real[:, :, None, :] & real[:, None, :, :]
-              & pred_mask[None, :, :, None])
+              & pm[:, :, :, None])
     d_ret = in_end[:, None, :] - ch_end                       # [B, E, W]
     m_ret = real
 
@@ -410,6 +463,117 @@ def solve_em_packed(
         w[E + E * E:], mu[E + E * E:], sd[E + E * E:],
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk, n_sweeps=n_sweeps,
         sinkhorn_tol=sinkhorn_tol,
+    )
+
+
+@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
+                                   "sinkhorn_tol"))
+def solve_windows_fleet(
+    in_start, in_end, in_valid, out_start, out_end, out_valid,
+    skip_cap, force_skip, param_idx,
+    pred_masks, root_masks, is_lasts,
+    edge_wts, edge_mus, edge_sds, in_wts, in_mus, in_sds,
+    ret_wts, ret_mus, ret_sds,
+    epsilon: float = 1.0, n_sinkhorn: int = 40,
+    topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
+    sinkhorn_tol: float = 0.0,
+):
+    """Multi-service :func:`solve_windows` with the packed int32 output.
+
+    ``param_idx[b]`` selects the window's problem tables from the stacked
+    ``[P, ...]`` arrays; windows of every service in a fleet ride one
+    device dispatch (endpoint axes padded to the fleet max — padded
+    endpoints have no valid columns, assign nothing, and pass predecessor
+    times through, so they cannot disturb real endpoints)."""
+    assign, tk, not_best, feas = _solve_windows_impl(
+        in_start, in_end, in_valid, out_start, out_end, out_valid,
+        skip_cap, force_skip, param_idx,
+        pred_masks, root_masks, is_lasts,
+        edge_wts, edge_mus, edge_sds, in_wts, in_mus, in_sds,
+        ret_wts, ret_mus, ret_sds,
+        epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
+        n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
+    )
+    return jnp.concatenate(
+        [assign[..., None], not_best[..., None].astype(jnp.int32),
+         feas[..., None], tk], axis=-1,
+    )
+
+
+@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
+                                   "sinkhorn_tol"))
+def solve_em_fleet(
+    in_start, in_end, in_valid, out_start, out_end, out_valid,
+    skip_cap, force_skip, param_idx,
+    pred_masks, root_masks, is_lasts,
+    edge_wts, edge_mus, edge_sds, in_wts, in_mus, in_sds,
+    ret_wts, ret_mus, ret_sds,
+    epsilon: float = 1.0, n_sinkhorn: int = 40,
+    topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
+    sinkhorn_tol: float = 0.0,
+):
+    """Both EM iterations for a whole service fleet in ONE dispatch.
+
+    The fleet analogue of :func:`solve_em_packed`: pass 0 over every
+    service's windows, per-service three-family delay extraction (windows
+    contribute only to their own service's rows via ``param_idx``), one
+    batched BIC-GMM refit over the ``P*Ne`` family rows, then pass 1 —
+    the whole bench workload's EM never leaves the device and costs a
+    single round trip through the tunnel."""
+    B, E, M = out_start.shape
+    W = in_start.shape[1]
+    P, _, K = in_wts.shape
+    Ne = E + E * E + E
+
+    assign0, _, _, _ = _solve_windows_impl(
+        in_start, in_end, in_valid, out_start, out_end, out_valid,
+        skip_cap, force_skip, param_idx,
+        pred_masks, root_masks, is_lasts,
+        edge_wts, edge_mus, edge_sds, in_wts, in_mus, in_sds,
+        ret_wts, ret_mus, ret_sds,
+        epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
+        n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
+    )
+
+    # family samples over the padded endpoint axis; per-window structure
+    # masks so a window only feeds its own service's family rows
+    samples, smask = em_family_samples(
+        assign0, in_start, in_end, in_valid, out_start, out_end,
+        pred_masks[param_idx], root_masks[param_idx])       # [Ne, B*W]
+
+    svc_of_pos = jnp.repeat(param_idx, W)                   # [B*W]
+    fleet_mask = (smask[None, :, :]
+                  & (svc_of_pos[None, None, :]
+                     == jnp.arange(P)[:, None, None])).reshape(P * Ne, B * W)
+    fleet_samples = jnp.broadcast_to(samples[None], (P, Ne, B * W)) \
+        .reshape(P * Ne, B * W)
+
+    from traceweaver_tpu.ops.gmm import fit_gmm_in_graph
+
+    prior_w = jnp.concatenate(
+        [in_wts, edge_wts.reshape(P, E * E, K), ret_wts], axis=1
+    ).reshape(P * Ne, K)
+    prior_mu = jnp.concatenate(
+        [in_mus, edge_mus.reshape(P, E * E, K), ret_mus], axis=1
+    ).reshape(P * Ne, K)
+    prior_sd = jnp.concatenate(
+        [in_sds, edge_sds.reshape(P, E * E, K), ret_sds], axis=1
+    ).reshape(P * Ne, K)
+    w, mu, sd = fit_gmm_in_graph(fleet_samples, fleet_mask,
+                                 prior_w, prior_mu, prior_sd, max_k=K)
+
+    w, mu, sd = (a.reshape(P, Ne, K) for a in (w, mu, sd))
+    return solve_windows_fleet(
+        in_start, in_end, in_valid, out_start, out_end, out_valid,
+        skip_cap, force_skip, param_idx,
+        pred_masks, root_masks, is_lasts,
+        w[:, E:E + E * E].reshape(P, E, E, K),
+        mu[:, E:E + E * E].reshape(P, E, E, K),
+        sd[:, E:E + E * E].reshape(P, E, E, K),
+        w[:, :E], mu[:, :E], sd[:, :E],
+        w[:, E + E * E:], mu[:, E + E * E:], sd[:, E + E * E:],
+        epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
+        n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
     )
 
 
@@ -500,6 +664,7 @@ def pack_problem(
     pad_w: Optional[int] = None,
     pad_b: Optional[int] = None,
     pad_m: Optional[int] = None,
+    pad_e: Optional[int] = None,
     ranges: Optional[np.ndarray] = None,
     skip_caps: Optional[np.ndarray] = None,  # [len(windows), E] water-filled
 ) -> PackedProblem:
@@ -509,9 +674,13 @@ def pack_problem(
     pack a subset; when omitted, perfect cuts over the whole stream are used.
     ``pad_w``/``pad_b``/``pad_m`` force the padded window width / batch size /
     candidate-column count (all still rounded up to powers of two) so every
-    chunk of a solve shares one compiled variant.
+    chunk of a solve shares one compiled variant. ``pad_e`` pads the endpoint
+    axis (fleet packing: services share one dispatch at the fleet-max E;
+    padded endpoints carry no valid columns, a false root/pred/last mask and
+    unit-σ zero-weight params, so the solve ignores them).
     """
     E = len(out_eps)
+    E_pad = max(E, pad_e or E)
     if windows is None:
         windows = perfect_cut_windows(in_spans, max_window)
     n_windows = len(windows)
@@ -534,11 +703,11 @@ def pack_problem(
     in_start = np.zeros((B, W), dtype=np.float32)
     in_end = np.zeros((B, W), dtype=np.float32)
     in_valid = np.zeros((B, W), dtype=bool)
-    out_start = np.zeros((B, E, M), dtype=np.float32)
-    out_end = np.zeros((B, E, M), dtype=np.float32)
-    out_valid = np.zeros((B, E, M), dtype=bool)
-    skip_cap = np.zeros((B, E), dtype=np.float32)
-    force_skip = np.zeros((B, E, W), dtype=bool)
+    out_start = np.zeros((B, E_pad, M), dtype=np.float32)
+    out_end = np.zeros((B, E_pad, M), dtype=np.float32)
+    out_valid = np.zeros((B, E_pad, M), dtype=bool)
+    skip_cap = np.zeros((B, E_pad), dtype=np.float32)
+    force_skip = np.zeros((B, E_pad, W), dtype=bool)
 
     out_ids: List[List] = [[None] * (B * M) for _ in range(E)]
     in_ids = [s.GetId() for s in in_spans]
@@ -580,11 +749,11 @@ def pack_problem(
                 skip_cap[b, e] = max(skip_cap[b, e], n_forced)
 
     # --- DAG structure masks ---------------------------------------------
-    pred_mask = np.zeros((E, E), dtype=bool)
-    root_mask = np.zeros((E,), dtype=bool)
-    is_last = np.zeros((E,), dtype=bool)
+    pred_mask = np.zeros((E_pad, E_pad), dtype=bool)
+    root_mask = np.zeros((E_pad,), dtype=bool)
+    is_last = np.zeros((E_pad,), dtype=bool)
     if parallel or dag is None:
-        root_mask[:] = True
+        root_mask[:E] = True
     else:
         for e, ep in enumerate(out_eps):
             preds = timing.primary_pred_edges(dag, ep)
@@ -602,15 +771,15 @@ def pack_problem(
     def params_of(key) -> EdgeDist:
         return dists.get(key, wide)
 
-    edge_wt = np.zeros((E, E, K), dtype=np.float32)
-    edge_mu = np.zeros((E, E, K), dtype=np.float32)
-    edge_sd = np.ones((E, E, K), dtype=np.float32)
-    in_wt = np.zeros((E, K), dtype=np.float32)
-    in_mu = np.zeros((E, K), dtype=np.float32)
-    in_sd = np.ones((E, K), dtype=np.float32)
-    ret_wt = np.zeros((E, K), dtype=np.float32)
-    ret_mu = np.zeros((E, K), dtype=np.float32)
-    ret_sd = np.ones((E, K), dtype=np.float32)
+    edge_wt = np.zeros((E_pad, E_pad, K), dtype=np.float32)
+    edge_mu = np.zeros((E_pad, E_pad, K), dtype=np.float32)
+    edge_sd = np.ones((E_pad, E_pad, K), dtype=np.float32)
+    in_wt = np.zeros((E_pad, K), dtype=np.float32)
+    in_mu = np.zeros((E_pad, K), dtype=np.float32)
+    in_sd = np.ones((E_pad, K), dtype=np.float32)
+    ret_wt = np.zeros((E_pad, K), dtype=np.float32)
+    ret_mu = np.zeros((E_pad, K), dtype=np.float32)
+    ret_sd = np.ones((E_pad, K), dtype=np.float32)
     for e, ep in enumerate(out_eps):
         d = params_of((in_ep, ep))
         in_wt[e], in_mu[e], in_sd[e] = d.weights, d.means, d.stds
