@@ -5,45 +5,56 @@ recorded traces each), arrivals compressed 10x (reference
 ``repeat_change_spans`` semantics, transforms.py:10-40) — the
 high-interleave regime the reference's Alibaba scale sweep (exp5)
 stresses, where DFS candidate enumeration blows up combinatorially.
-Eight services total (hotel frontend/search + media's six), solved
-concurrently by a thread pool (the reference's own per-service
-concurrency model, executor.py:1015-1026) so device round trips overlap.
+Eight services total (hotel frontend/search + media's six), all fused
+into ONE device dispatch (fleet.py — supersedes the reference's
+per-service ThreadPool, executor.py:1015-1026).
 
 Two accuracy/throughput comparisons, both on identical inputs:
 
-- full corpus: WeaverTPU (fused two-pass EM, one device dispatch per
-  service) over every span; the combinatorial baseline is too slow here,
-  so its capped upper bound only anchors the headline ratio's floor.
-- same-input subset: the first TW_BENCH_SUBSET (default 40) incoming
+- full corpus: WeaverTPU (fused two-pass EM) over every span; the
+  combinatorial baseline cannot run this.
+- same-input subset: the first TW_BENCH_SUBSET (default 25) incoming
   spans per service are solved by BOTH WeaverTPU and the exact DFS+MWIS
-  path (WeaverExact "MaxScoreBatch", Gurobi stand-in) with no cap beyond
-  a safety alarm; the report carries ``accuracy_delta_same_inputs`` and a
-  *measured* exact-path spans/sec — the apples-to-apples numbers the
-  round-2 artifact lacked.
+  path (WeaverExact "MaxScoreBatch", Gurobi stand-in). The report
+  carries ``accuracy_delta_same_inputs`` and a *measured* exact-path
+  spans/sec. Exact solves are expensive (25-span subsets cost 4-90 s
+  EACH, measured), so the baseline child fresh-solves as many services
+  as its remaining budget allows — cheapest first, guided by
+  ``exps/parity/exact_subset_recorded.json`` (a committed recording of
+  a full uncapped run) — and carries the recording for the rest, each
+  service flagged ``measured`` true/false.
 
 The timed pass runs under ``jax.profiler`` and the trace is parsed
-in-process (``jax.profiler.ProfileData``): the report's
-``device_busy_s`` / ``mfu_measured_pct`` come from the device plane's
-executed-op timeline, not wall-clock inference, and a top-op summary is
-written next to the JSON (committed as PROFILE_r{N}.json).
+in-process (``jax.profiler.ProfileData``): ``device_busy_s_measured`` /
+``mfu_measured_pct`` come from the device plane's executed-op timeline,
+not wall-clock inference (committed as PROFILE_r{N}.json).
 
 Prints ONE JSON line with the TPU spans/sec and the vs-baseline ratio.
 
-Orchestration: the sandbox's remote TPU backend ("axon") tunnels device
-init and every XLA compile through a relay and can stall for minutes —
-round 1's monolithic bench died inside one jit compile. So this parent
-process never initializes a JAX backend itself. It:
+Orchestration — the round-3 failure (BENCH_r03: rc=124, no parsed line)
+dictates the design. The sandbox's remote TPU backend ("axon") tunnels
+device init and every XLA compile through a relay; device init alone has
+been observed to block >10 minutes, and a foreign on-disk compile cache
+made every deserialization fail before that (now impossible: the cache is
+namespaced per backend+host, runtime/jax_cache.py). So the parent:
 
-1. warms the corpus cache and pickles the packed service problems once;
-2. launches the solver child on the TPU backend with a hard timeout,
-   falling back to an identical CPU-backend child if the TPU child cannot
-   produce a result in budget (the JSON then carries ``backend: "cpu"``);
-3. launches the exact-path baseline as a CPU subprocess (no JAX), after
-   the solver so neither side is timed under host contention;
-4. merges the child reports and prints the final JSON line.
-
-Worst-case wall-clock is bounded (~load + TPU timeout + CPU child +
-baseline cap), so the driver always gets a parseable line.
+1. never initializes a JAX backend itself; it builds + pickles the packed
+   problems, then enforces ONE global deadline (TW_BENCH_DEADLINE,
+   default 780 s) across every phase;
+2. launches the solver child on the TPU backend with whatever budget the
+   deadline leaves after reserving for the fallback + baseline legs. The
+   child writes its report ATOMICALLY after each phase (timed pass ->
+   subsets -> pallas/profile enrichment) and drops a ``timing.done``
+   marker the moment the measured passes finish — a timeout kill after
+   that point loses only enrichment, never the measurement;
+3. on marker-or-exit starts the exact-path baseline (CPU subprocess, no
+   JAX); only the solver's uncontended measured passes ever overlap it;
+4. if the TPU child produced nothing, runs a REDUCED CPU-backend child
+   (hotel app only — media's nginx alone needs ~410 s on CPU, measured
+   in PARITY.md) so the fallback provably finishes in its slice;
+5. merges the child reports and prints the final JSON line — on the
+   deadline, whatever has been written is merged as-is, so the driver
+   always gets a parseable line inside the envelope.
 """
 
 from __future__ import annotations
@@ -63,21 +74,26 @@ DATASETS = (
     ("media", "/root/reference/data/media_microservices/media_load150", 1),
 )
 COMPRESS = 10.0
-SUBSET_SPANS = int(os.environ.get("TW_BENCH_SUBSET", "40"))
-# fallback subset size when the exact path cannot finish SUBSET_SPANS
-# within the alarm (x10-compressed hotel frontend needs this)
-SUBSET_RETRY = int(os.environ.get("TW_BENCH_SUBSET_RETRY", "25"))
-# legacy capped sweep (floor anchor for the full-corpus ratio)
-CPU_SUBSET_SPANS = 30
-CPU_CAP_SECONDS = int(os.environ.get("TW_BENCH_BASELINE_CAP", "120"))
-# per-service safety alarm for the "uncapped" same-input exact solves;
-# a service that trips it is retried at SUBSET_RETRY, then reported
-# unfinished rather than credited
-EXACT_ALARM_SECONDS = int(os.environ.get("TW_BENCH_EXACT_ALARM", "90"))
-TPU_TIMEOUT = int(os.environ.get("TW_BENCH_TPU_TIMEOUT", "540"))
-CPU_TIMEOUT = int(os.environ.get("TW_BENCH_CPU_TIMEOUT", "480"))
+SUBSET_SPANS = int(os.environ.get("TW_BENCH_SUBSET", "25"))
+# per-service safety alarm for the same-input exact solves. NOT every
+# service fits it (the committed recording has media rating/text at
+# ~130 s each on a 1-core host): services whose recorded cost exceeds
+# the alarm carry the recording instead of burning the alarm for nothing
+EXACT_ALARM_SECONDS = int(os.environ.get("TW_BENCH_EXACT_ALARM", "95"))
+# the whole bench must fit this envelope (the round-3 artifact died by
+# exceeding the driver's budget; this is the single knob that bounds us)
+DEADLINE = int(os.environ.get("TW_BENCH_DEADLINE", "780"))
+# reserves the parent holds back when budgeting earlier phases
+CPU_FALLBACK_RESERVE = int(os.environ.get("TW_BENCH_CPU_RESERVE", "170"))
+BASELINE_RESERVE = int(os.environ.get("TW_BENCH_BASELINE_RESERVE", "130"))
+MERGE_SLACK = 20
+TPU_TIMEOUT_CAP = int(os.environ.get("TW_BENCH_TPU_TIMEOUT", "480"))
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+RECORDED_PATH = os.path.join(
+    HERE, "exps", "parity", "exact_subset_recorded.json")
+
+T_START = time.time()
 
 
 def log(msg: str) -> None:
@@ -85,14 +101,22 @@ def log(msg: str) -> None:
           flush=True)
 
 
-T_START = time.time()
+def remaining(deadline_ts: float) -> float:
+    return deadline_ts - time.time()
+
+
+def write_json_atomic(path: str, obj) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
 
 
 # ---------------------------------------------------------------------------
 # Shared problem construction (pure NumPy/Python — safe in the parent)
 # ---------------------------------------------------------------------------
 
-def build_problems():
+def build_problems(apps=None):
     from traceweaver_tpu.ingest import (
         build_service_problem,
         infer_invocation_dag,
@@ -101,9 +125,17 @@ def build_problems():
     from traceweaver_tpu.metrics import get_ground_truth
     from traceweaver_tpu.synth import compress_spans
 
+    # smoke-test knobs (unset in driver runs): restrict apps / corpus size
+    env_apps = os.environ.get("TW_BENCH_APPS")
+    if apps is None and env_apps:
+        apps = set(env_apps.split(","))
+    max_traces = int(os.environ.get("TW_BENCH_MAX_TRACES", "1000"))
+
     bundles = []
     for app, path, fix in DATASETS:
-        store = load_corpus(path, fix=fix, max_traces=1000, cache=True)
+        if apps is not None and app not in apps:
+            continue
+        store = load_corpus(path, fix=fix, max_traces=max_traces, cache=True)
         problems = []
         for svc in store.out_spans_by_process:
             prob = build_service_problem(store, svc)
@@ -196,64 +228,36 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         enable_persistent_compilation_cache,
     )
 
-    # record whether the on-disk compile cache was warm FOR THIS CONFIG:
-    # with it, warmup_time_s measures cache deserialization, not a cold
-    # compile — the report must say which one it was. "Warm" is judged by
-    # whether the warmup pass wrote new cache entries, not by the dir
-    # being non-empty (a sweep sibling's entries don't warm this config).
+    # the cache dir is namespaced per backend+host (jax_cache.py), so a
+    # warm cache is genuinely THIS machine's: warmup then measures cache
+    # deserialization, not a cold compile — the report says which.
     cache_dir = enable_persistent_compilation_cache()
     cache_entries_before = set(os.listdir(cache_dir)) if cache_dir else set()
 
+    t0 = time.perf_counter()
     backend = jax.default_backend()
-    log(f"child: jax backend = {backend}, devices = {jax.devices()}")
-
-    import threading
-    from concurrent.futures import ThreadPoolExecutor
+    init_s = time.perf_counter() - t0
+    log(f"child: jax backend = {backend} (init {init_s:.1f}s), "
+        f"devices = {jax.devices()}")
 
     from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
-    from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
     from traceweaver_tpu.metrics import accuracy_for_service
 
     flat = [(label, svc, prob, ta, dag, store)
             for store, problems in bundles
             for label, svc, prob, ta, dag in problems]
-    stats_lock = threading.Lock()
-    use_fleet = os.environ.get("TW_BENCH_FLEET", "1") not in ("0", "false")
-
-    def solve_one(item, stage_stats=None):
-        label, svc, prob, ta, dag, store = item
-        algo = WeaverTPU(store.all_spans, store.all_processes)
-        out = algo.FindAssignments(
-            "MaxScoreBatchSubsetWithSkips", svc,
-            prob.in_span_partitions, prob.out_span_partitions,
-            False, [], ta, dag,
-        )
-        if stage_stats is not None:
-            with stats_lock:  # solver threads race on the shared dict
-                for k, v in algo.stats.items():
-                    stage_stats[k] = stage_stats.get(k, 0.0) + v
-        return label, out[0]
 
     def one_pass(stage_stats=None):
-        if use_fleet:
-            # ALL services (both apps) ride one fused device program —
-            # pass0 + per-service BIC-GMM refit + pass1, one round trip
-            # (fleet.py; proven assignment-identical to the per-service
-            # path by tests/test_fleet.py)
-            items = [FleetItem(svc, prob.in_span_partitions,
-                               prob.out_span_partitions, ta, dag,
-                               store=store)
-                     for _, svc, prob, ta, dag, store in flat]
-            outs = solve_fleet(
-                items, stats=stage_stats if stage_stats is not None else {})
-            return {label: out[0]
-                    for (label, *_), out in zip(flat, outs)}
-        # fallback: per-service solves, dispatches overlapped by threads
-        # (the reference's ThreadPool-over-services model)
-        with ThreadPoolExecutor(max_workers=max(1, len(flat))) as pool:
-            preds = dict(pool.map(
-                lambda it: solve_one(it, stage_stats), flat))
-        return preds
+        # ALL services (both apps) ride one fused device program —
+        # pass0 + per-service BIC-GMM refit + pass1, one round trip
+        # (fleet.py; proven assignment-identical to the per-service
+        # path by tests/test_fleet.py)
+        items = [FleetItem(svc, prob.in_span_partitions,
+                           prob.out_span_partitions, ta, dag, store=store)
+                 for _, svc, prob, ta, dag, store in flat]
+        outs = solve_fleet(
+            items, stats=stage_stats if stage_stats is not None else {})
+        return {label: out[0] for (label, *_), out in zip(flat, outs)}
 
     t0 = time.perf_counter()
     one_pass()  # compile warm-up (cached afterwards)
@@ -273,6 +277,85 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     preds = one_pass(stage_stats)
     solve_time = time.perf_counter() - t0
     jax.profiler.stop_trace()
+
+    n_spans = sum(
+        len(next(iter(prob.in_span_partitions.values())))
+        for _, _, prob, _, _, _ in flat
+    )
+    log(f"child: timed pass {solve_time:.1f}s "
+        f"({n_spans / solve_time:.0f} spans/s)")
+
+    accs = {
+        label: accuracy_for_service(preds[label], ta, prob.in_span_partitions)
+        for label, _, prob, ta, _, _ in flat
+    }
+
+    # Utilization denominators. Peaks: TPU v5e ~197 TFLOP/s bf16 MXU (the
+    # headline "MFU" denominator; this pipeline is f32/VPU-heavy, so its
+    # MFU is structurally small) and ~819 GB/s HBM.
+    device_s_wall = min(stage_stats.get("wait_s", 0.0) or solve_time,
+                        solve_time)
+    flops = stage_stats.get("flops_est", 0.0)
+    peak_flops = 197e12 if backend in ("tpu", "axon") else 2e11
+    peak_bw = 819e9 if backend in ("tpu", "axon") else 5e10
+
+    report = {
+        "backend": backend,
+        "backend_init_s": round(init_s, 2),
+        "n_spans": n_spans,
+        "n_services": len(flat),
+        "solve_time_s": solve_time,
+        "warmup_time_s": warmup_time,
+        "compile_cache_warm": cache_warm,
+        "spans_per_sec": n_spans / solve_time,
+        "accuracy_mean": sum(accs.values()) / len(accs),
+        "accuracy_per_service": {k: round(v, 4) for k, v in accs.items()},
+        "stage_seconds": {
+            k: round(stage_stats.get(k, 0.0), 3)
+            for k in ("pack_s", "dispatch_s", "wait_s", "decode_s", "refit_s")
+        },
+        "fused_em_dispatches": int(stage_stats.get("fused_em_applied", 0)),
+        "flops_est": flops,
+        "mfu_est_pct": round(100.0 * flops / max(device_s_wall, 1e-9)
+                             / peak_flops, 4),
+    }
+    # measurement is on disk from this point on — a timeout kill can only
+    # lose enrichment below, never the headline
+    write_json_atomic(out_path, report)
+    log("child: report written (timed pass)")
+
+    # --- same-input subset leg (identical spans + ground truth as the
+    # exact-path baseline child; one fused dispatch for all subsets) ------
+    t0 = time.perf_counter()
+    sub_items, sub_meta = [], []
+    for label, svc, prob, ta, dag, store in flat:
+        sub_in, sub_ta = subset_problem(prob, SUBSET_SPANS)
+        # key by the ACTUAL span count (a service may hold fewer spans
+        # than requested) — the pairing key the parent reconstructs from
+        # the baseline's recorded n_spans
+        n_actual = len(next(iter(sub_in.values())))
+        sub_items.append(FleetItem(svc, sub_in, prob.out_span_partitions,
+                                   sub_ta, dag, store=store))
+        sub_meta.append((f"{label}@{n_actual}", sub_in, sub_ta))
+    outs = solve_fleet(sub_items)
+    subset_accs = {
+        key: accuracy_for_service(out[0], sub_ta, sub_in)
+        for (key, sub_in, sub_ta), out in zip(sub_meta, outs)
+    }
+    report["subset_spans_per_service"] = SUBSET_SPANS
+    report["subset_accuracy_per_service"] = {
+        k: round(v, 4) for k, v in subset_accs.items()}
+    report["subset_solve_s"] = round(time.perf_counter() - t0, 2)
+    write_json_atomic(out_path, report)
+    log(f"child: subset pass {report['subset_solve_s']}s — report updated")
+
+    # --- enrichment ------------------------------------------------------
+    # NOTE: the parent holds the baseline child until the marker below, so
+    # enrichment (profile parse, pallas compile check) must finish first —
+    # the baseline's fresh exact-path timings would otherwise run under
+    # host contention with this CPU work and inflate the headline ratio
+    # (the measurement-protecting atomic report writes above already make
+    # a mid-enrichment kill lose nothing but enrichment itself)
     profile = None
     try:
         profile = _parse_profile(profile_dir)
@@ -282,55 +365,19 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     if auto_profile_dir:
         import shutil
 
-        shutil.rmtree(profile_dir, ignore_errors=True)  # summary kept in report
+        shutil.rmtree(profile_dir, ignore_errors=True)
 
-    n_spans = sum(
-        len(next(iter(prob.in_span_partitions.values())))
-        for _, _, prob, _, _, _ in flat
-    )
-    log(f"child: timed pass {solve_time:.1f}s ({n_spans / solve_time:.0f} spans/s)")
-
-    accs = {
-        label: accuracy_for_service(preds[label], ta, prob.in_span_partitions)
-        for label, _, prob, ta, _, _ in flat
-    }
-
-    # --- same-input subset leg (exact path runs these in the baseline
-    # child; identical spans, identical ground truth). Solved for both
-    # subset sizes so the parent can pair each service with whichever
-    # size the exact path managed to finish. -----------------------------
-    subset_accs = {}
-    t0 = time.perf_counter()
-    sub_items, sub_meta = [], []
-    for n in dict.fromkeys((SUBSET_SPANS, SUBSET_RETRY)):
-        for label, svc, prob, ta, dag, store in flat:
-            sub_in, sub_ta = subset_problem(prob, n)
-            # key by the ACTUAL span count (a service may hold fewer spans
-            # than requested) — the pairing key the parent reconstructs
-            # from the baseline's recorded n_spans; identical subsets
-            # (service shorter than both sizes) solve once
-            n_actual = len(next(iter(sub_in.values())))
-            key = f"{label}@{n_actual}"
-            if key in subset_accs or any(k == key for k, _, _ in sub_meta):
-                continue
-            sub_items.append(FleetItem(svc, sub_in,
-                                       prob.out_span_partitions, sub_ta,
-                                       dag, store=store))
-            sub_meta.append((key, sub_in, sub_ta))
-    if use_fleet:
-        # every subset ride-shares one dispatch too
-        outs = solve_fleet(sub_items)
-        for (key, sub_in, sub_ta), out in zip(sub_meta, outs):
-            subset_accs[key] = accuracy_for_service(out[0], sub_ta, sub_in)
-    else:
-        for item, (key, sub_in, sub_ta) in zip(sub_items, sub_meta):
-            algo = WeaverTPU(item.store.all_spans, item.store.all_processes)
-            out = algo.FindAssignments(
-                "MaxScoreBatchSubsetWithSkips", item.svc, sub_in,
-                item.out_span_partitions, False, [], sub_ta, item.dag,
-            )
-            subset_accs[key] = accuracy_for_service(out[0], sub_ta, sub_in)
-    log(f"child: subset pass {time.perf_counter() - t0:.1f}s")
+    busy_measured = (profile or {}).get("device_busy_s") or 0.0
+    report["device_busy_s_measured"] = (busy_measured if busy_measured > 0
+                                        else None)
+    report["profile_top_ops"] = (profile or {}).get("top_ops")
+    # "measured" metrics come ONLY from a trace with nonzero device busy
+    # time; otherwise they stay null rather than silently falling back to
+    # wall-clock under a measured label
+    report["mfu_measured_pct"] = (
+        round(100.0 * flops / busy_measured / peak_flops, 4)
+        if busy_measured > 0 else None)
+    device_s = busy_measured if busy_measured > 0 else device_s_wall
 
     # --- Pallas kernel on-device proof (non-interpret) -------------------
     pallas_ok = None
@@ -351,70 +398,54 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
             pallas_ok = bool(np.allclose(got, want, rtol=2e-3, atol=2e-4))
             log(f"child: pallas on-device check ok={pallas_ok}")
         except Exception as e:  # lowering not supported on this plugin
-            log(f"child: pallas on-device check failed: {type(e).__name__}: {e}")
+            log(f"child: pallas on-device check failed: "
+                f"{type(e).__name__}: {e}")
             pallas_ok = False
-
-    # Utilization. Peaks: TPU v5e ~197 TFLOP/s bf16 MXU (the headline
-    # "MFU" denominator; this pipeline is f32/VPU-heavy, so its MFU is
-    # structurally small) and ~819 GB/s HBM. With a parsed profile the
-    # denominator is MEASURED device busy time from the trace; the
-    # wall-clock estimate is kept for comparison.
-    # summed per-thread wait_s overlaps in wall-clock under the thread
-    # pool (each thread's wait includes the device serving its siblings),
-    # so the wall-clock estimate denominator is capped at the timed pass
-    device_s_wall = min(stage_stats.get("wait_s", 0.0) or solve_time,
-                        solve_time)
-    # "measured" metrics come ONLY from a trace with nonzero device busy
-    # time; otherwise they are reported null rather than silently falling
-    # back to wall-clock under a measured label
-    busy_measured = (profile or {}).get("device_busy_s") or 0.0
-    device_s = busy_measured if busy_measured > 0 else device_s_wall
-    flops = stage_stats.get("flops_est", 0.0)
+    report["pallas_on_device_ok"] = pallas_ok
     bytes_key = ("bytes_est_pallas" if pallas_ok else "bytes_est_xla")
-    peak_flops = 197e12 if backend in ("tpu", "axon") else 2e11
-    peak_bw = 819e9 if backend in ("tpu", "axon") else 5e10
-    report = {
-        "backend": backend,
-        "n_spans": n_spans,
-        "n_services": len(flat),
-        "solve_time_s": solve_time,
-        "warmup_time_s": warmup_time,
-        "compile_cache_warm": cache_warm,
-        "spans_per_sec": n_spans / solve_time,
-        "accuracy_mean": sum(accs.values()) / len(accs),
-        "accuracy_per_service": {k: round(v, 4) for k, v in accs.items()},
-        "subset_spans_per_service": SUBSET_SPANS,
-        "subset_accuracy_per_service": {
-            k: round(v, 4) for k, v in subset_accs.items()},
-        "pallas_on_device_ok": pallas_ok,
-        "stage_seconds": {
-            k: round(stage_stats.get(k, 0.0), 3)
-            for k in ("pack_s", "dispatch_s", "wait_s", "decode_s", "refit_s")
-        },
-        "fused_em_dispatches": int(stage_stats.get("fused_em_applied", 0)),
-        "flops_est": flops,
-        "device_busy_s_measured": (busy_measured if busy_measured > 0
-                                   else None),
-        "profile_top_ops": (profile or {}).get("top_ops"),
-        "mfu_measured_pct": (
-            round(100.0 * flops / busy_measured / peak_flops, 4)
-            if busy_measured > 0 else None),
-        "mfu_est_pct": round(100.0 * flops / max(device_s_wall, 1e-9)
-                             / peak_flops, 4),
-        "hbm_util_est_pct": round(
-            100.0 * stage_stats.get(bytes_key, 0.0)
-            / max(device_s, 1e-9) / peak_bw, 2),
-    }
-    with open(out_path, "w") as f:
-        json.dump(report, f)
-    log("child: report written")
+    report["hbm_util_est_pct"] = round(
+        100.0 * stage_stats.get(bytes_key, 0.0)
+        / max(device_s, 1e-9) / peak_bw, 2)
+
+    write_json_atomic(out_path, report)
+    # all solver work (measured passes AND host-CPU enrichment) is done:
+    # the baseline child may now run uncontended
+    write_json_atomic(out_path + ".timing.done", {"ok": True})
+    profile_json = os.environ.get("TW_BENCH_PROFILE_JSON")
+    if profile_json:
+        write_json_atomic(profile_json, {
+            "backend": backend,
+            "device_busy_s_measured": report["device_busy_s_measured"],
+            "mfu_measured_pct": report["mfu_measured_pct"],
+            "mfu_est_pct": report["mfu_est_pct"],
+            "hbm_util_est_pct": report["hbm_util_est_pct"],
+            "solve_time_s": round(solve_time, 3),
+            "stage_seconds": report["stage_seconds"],
+            "top_ops": report["profile_top_ops"],
+        })
+    log("child: report written (enriched)")
 
 
 # ---------------------------------------------------------------------------
 # Combinatorial baseline child (no JAX backend at all)
 # ---------------------------------------------------------------------------
 
+def load_recorded():
+    if os.path.exists(RECORDED_PATH):
+        with open(RECORDED_PATH) as f:
+            return json.load(f)
+    return None
+
+
 def run_baseline_child(bundle_path: str, out_path: str) -> None:
+    """Same-input exact-path (DFS + MWIS) subset solves, budget-aware.
+
+    Fresh-solves as many services as ``TW_BENCH_BASELINE_BUDGET`` seconds
+    allow, cheapest first by the committed recording's measured times;
+    carries the recording for the rest (flagged ``measured: false``). A
+    full uncapped run of every service is regenerated by running with a
+    large budget and ``TW_BENCH_RECORD=<path>``.
+    """
     import signal
 
     # defensive: should any library path touch jnp, stay off the axon tunnel
@@ -422,15 +453,36 @@ def run_baseline_child(bundle_path: str, out_path: str) -> None:
 
     jax.config.update("jax_platforms", "cpu")
 
+    budget = float(os.environ.get("TW_BENCH_BASELINE_BUDGET", "110"))
+    deadline_ts = time.time() + budget
+    record_path = os.environ.get("TW_BENCH_RECORD")
+
     with open(bundle_path, "rb") as f:
         bundles = pickle.load(f)
 
     from traceweaver_tpu.algorithms.weaver_exact import WeaverExact
-    from traceweaver_tpu.metrics import accuracy_for_service, get_ground_truth
+    from traceweaver_tpu.metrics import accuracy_for_service
 
     flat = [(label, svc, prob, ta, dag, store)
             for store, problems in bundles
             for label, svc, prob, ta, dag in problems]
+
+    recorded = (load_recorded() or {}) if not record_path else {}
+    rec_svcs = recorded.get("services", {})
+    rec_valid = (recorded.get("subset_spans") == SUBSET_SPANS
+                 and recorded.get("compress") == COMPRESS)
+
+    # cheapest first (unknown services last), so the budget buys the
+    # maximum number of fresh same-input pairs; a recording for a
+    # DIFFERENT config (subset size / compress) is not comparable and
+    # must not gate anything
+    def est_cost(label):
+        rec = rec_svcs.get(label)
+        if rec_valid and rec and rec.get("finished"):
+            return rec["seconds"]
+        return 1e9
+
+    order = sorted(flat, key=lambda item: est_cost(item[0]))
 
     class _Timeout(Exception):
         pass
@@ -440,92 +492,90 @@ def run_baseline_child(bundle_path: str, out_path: str) -> None:
 
     signal.signal(signal.SIGALRM, _alarm)
 
-    # --- leg 1: same-input subsets, uncapped (safety alarm only); a
-    # service that trips the alarm at SUBSET_SPANS is retried at the
-    # smaller SUBSET_RETRY so every service contributes a finished,
-    # measured exact solve when at all feasible -------------------------
     subset = {}
-    for label, svc, prob, ta, dag, store in flat:
-        tried_sizes = set()
-        for n in dict.fromkeys((SUBSET_SPANS, SUBSET_RETRY)):
-            sub_in, sub_ta = subset_problem(prob, n)
-            if len(next(iter(sub_in.values()))) in tried_sizes:
-                continue  # shorter service: retry would be byte-identical
-            tried_sizes.add(len(next(iter(sub_in.values()))))
+    for label, svc, prob, ta, dag, store in order:
+        sub_in, sub_ta = subset_problem(prob, SUBSET_SPANS)
+        n_actual = len(next(iter(sub_in.values())))
+        rec = rec_svcs.get(label)
+        budget_left = deadline_ts - time.time()
+        # fresh-solve only when the recording says the solve fits BOTH the
+        # alarm and the remaining budget (unknown services get one alarm's
+        # worth of benefit of the doubt); otherwise carry the recording —
+        # a guaranteed-alarm fresh attempt would burn ~EXACT_ALARM seconds
+        # AND discard a carriable finished recorded pair
+        est = est_cost(label)
+        known = est < 1e8
+        fits_alarm = (est * 1.2 <= EXACT_ALARM_SECONDS) if known else True
+        want_fresh = fits_alarm and budget_left > (
+            est * 1.5 if known else EXACT_ALARM_SECONDS)
+        if want_fresh:
             algo = WeaverExact(store.all_spans, store.all_processes)
             t0 = time.perf_counter()
-            signal.alarm(EXACT_ALARM_SECONDS)
+            signal.alarm(min(EXACT_ALARM_SECONDS, max(5, int(budget_left))))
             try:
                 out = algo.FindAssignments(
                     "MaxScoreBatch", svc, sub_in, prob.out_span_partitions,
                     False, [], sub_ta,
                 )
-                dt = time.perf_counter() - t0
                 subset[label] = {
                     "finished": True,
-                    "seconds": dt,
-                    "n_spans": len(next(iter(sub_in.values()))),
+                    "seconds": time.perf_counter() - t0,
+                    "n_spans": n_actual,
                     "accuracy": accuracy_for_service(out[0], sub_ta, sub_in),
+                    "measured": True,
                 }
-                break
             except _Timeout:
                 subset[label] = {"finished": False,
-                                 "seconds": EXACT_ALARM_SECONDS,
-                                 "n_spans": len(next(iter(sub_in.values()))),
-                                 "accuracy": None}
+                                 "seconds": time.perf_counter() - t0,
+                                 "n_spans": n_actual, "accuracy": None,
+                                 "measured": True}
             finally:
                 signal.alarm(0)
-        log(f"baseline: subset {label} "
-            f"{'done' if subset[label]['finished'] else 'ALARM'} "
-            f"(n={subset[label]['n_spans']}, "
-            f"{subset[label]['seconds']:.1f}s)")
+            log(f"baseline: fresh {label} "
+                f"{'done' if subset[label]['finished'] else 'ALARM'} "
+                f"({subset[label]['seconds']:.1f}s)")
+        elif rec_valid and rec and rec.get("n_spans") == n_actual:
+            subset[label] = dict(rec, measured=False)
+            log(f"baseline: recorded {label} carried "
+                f"({rec['seconds']:.1f}s recorded)")
+        else:
+            subset[label] = {"finished": False, "seconds": 0.0,
+                             "n_spans": n_actual, "accuracy": None,
+                             "measured": False}
+            log(f"baseline: {label} skipped (no budget, no recording)")
 
-    # --- leg 2: legacy capped sweep (floor anchor for the ratio) --------
-    deadline = time.perf_counter() + CPU_CAP_SECONDS
-    per_service_cap = max(10, CPU_CAP_SECONDS // max(1, len(flat)))
-    cpu_spans = 0
-    cpu_time = 0.0
-    accs = {}
-    for label, svc, prob, ta, dag, store in flat:
-        if time.perf_counter() > deadline:
-            log("baseline: global cap hit, skipping remaining services")
-            break
-        in_ep = next(iter(prob.in_span_partitions))
-        sub_in = {in_ep: prob.in_span_partitions[in_ep][:CPU_SUBSET_SPANS]}
-        sub_ta = get_ground_truth(sub_in, prob.out_span_partitions)
-        algo = WeaverExact(store.all_spans, store.all_processes)
-        t0 = time.perf_counter()
-        signal.alarm(per_service_cap)
-        try:
-            out = algo.FindAssignments(
-                "MaxScoreBatch", svc, sub_in, prob.out_span_partitions,
-                False, [], sub_ta,
-            )
-            accs[label] = accuracy_for_service(out[0], sub_ta, sub_in)
-        except _Timeout:
-            accs[label] = None  # did not finish the subset within the cap
-        finally:
-            signal.alarm(0)
-        cpu_time += time.perf_counter() - t0
-        cpu_spans += len(sub_in[in_ep])
-        log(f"baseline: capped {label} done ({cpu_time:.1f}s cumulative)")
-
-    vals = [v for v in accs.values() if v is not None]
     fin = [v for v in subset.values() if v["finished"]]
+    fresh = [v for v in fin if v["measured"]]
     report = {
         "subset": subset,
         "subset_spans_total": sum(v["n_spans"] for v in fin),
         "subset_time_total_s": sum(v["seconds"] for v in fin),
         "subset_spans_per_sec": (
+            sum(v["n_spans"] for v in fresh) / sum(v["seconds"] for v in fresh)
+            if fresh else None),
+        "subset_spans_per_sec_incl_recorded": (
             sum(v["n_spans"] for v in fin) / sum(v["seconds"] for v in fin)
             if fin else None),
-        "capped_spans": cpu_spans,
-        "capped_time_s": cpu_time,
-        "spans_per_sec_upper_bound": cpu_spans / cpu_time if cpu_time else None,
-        "accuracy_mean_subset": sum(vals) / len(vals) if vals else None,
+        "n_fresh": len(fresh),
+        "n_recorded": len(fin) - len(fresh),
     }
-    with open(out_path, "w") as f:
-        json.dump(report, f)
+    write_json_atomic(out_path, report)
+    if record_path:
+        import datetime
+        import platform
+
+        write_json_atomic(record_path, {
+            "generated": datetime.date.today().isoformat(),
+            "host": platform.node(),
+            "note": "full uncapped exact-path subset run "
+                    "(regenerate: TW_BENCH_RECORD=<path> "
+                    "TW_BENCH_BASELINE_BUDGET=3600 bench.py --mode baseline)",
+            "subset_spans": SUBSET_SPANS,
+            "compress": COMPRESS,
+            "services": {k: {kk: vv for kk, vv in v.items()
+                             if kk != "measured"}
+                         for k, v in subset.items()},
+        })
     log("baseline: report written")
 
 
@@ -546,8 +596,28 @@ def _spawn(mode: str, bundle: str, out: str, backend: str | None,
     )
 
 
+def _wait_for_marker(proc: subprocess.Popen, marker: str,
+                     timeout: float) -> int | None:
+    """Poll until the child drops its timing marker, exits, or times out.
+    Returns the returncode if the child exited, else None (still running,
+    but safe to start the baseline)."""
+    end = time.time() + timeout
+    while time.time() < end:
+        rc = proc.poll()
+        if rc is not None:
+            return rc
+        if os.path.exists(marker):
+            return None
+        time.sleep(2.0)
+    proc.kill()
+    proc.wait()
+    return -9
+
+
 def main() -> None:
-    log("parent: building problems (no JAX backend init)")
+    deadline_ts = T_START + DEADLINE
+    log(f"parent: building problems (no JAX backend init); "
+        f"deadline {DEADLINE}s")
     bundles = build_problems()
     tmpdir = tempfile.mkdtemp(prefix="tw_bench_")
     bundle = os.path.join(tmpdir, "bundle.pkl")
@@ -559,44 +629,84 @@ def main() -> None:
 
     base_out = os.path.join(tmpdir, "baseline.json")
     solver_out = os.path.join(tmpdir, "solver.json")
+    marker = solver_out + ".timing.done"
 
     solver = None
+    solver_proc = None
     tried = []
     default_backend = os.environ.get("JAX_PLATFORMS", "axon") or "axon"
-    for backend, timeout in ((default_backend, TPU_TIMEOUT),
-                             ("cpu", CPU_TIMEOUT)):
-        if backend == "cpu" and default_backend == "cpu" and tried:
-            break
-        log(f"parent: solver child on backend={backend} (timeout {timeout}s)")
-        proc = _spawn("solver", bundle, solver_out, backend=backend)
-        try:
-            rc = proc.wait(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            log(f"parent: solver child on {backend} timed out — killing")
-            proc.kill()
-            proc.wait()
-            rc = -9
-        tried.append(backend)
-        if rc == 0 and os.path.exists(solver_out):
-            with open(solver_out) as f:
-                solver = json.load(f)
-            break
-        log(f"parent: solver child on {backend} failed (rc={rc})")
 
-    # baseline runs AFTER the solver measurement so neither side's timing
-    # is taken under host-CPU contention
-    log("parent: baseline child (sequential, no contention)")
-    base_proc = _spawn("baseline", bundle, base_out, backend="cpu")
-    try:
-        base_proc.wait(timeout=n_services * 2 * EXACT_ALARM_SECONDS
-                       + CPU_CAP_SECONDS + 240)
-    except subprocess.TimeoutExpired:
-        base_proc.kill()
-        base_proc.wait()
+    # --- phase 1: solver on the default (TPU) backend --------------------
+    tpu_budget = min(TPU_TIMEOUT_CAP,
+                     remaining(deadline_ts) - CPU_FALLBACK_RESERVE
+                     - BASELINE_RESERVE - MERGE_SLACK)
+    if tpu_budget > 60:
+        log(f"parent: solver child on backend={default_backend} "
+            f"(budget {tpu_budget:.0f}s)")
+        solver_proc = _spawn("solver", bundle, solver_out,
+                             backend=default_backend)
+        rc = _wait_for_marker(solver_proc, marker, tpu_budget)
+        tried.append(default_backend)
+        if rc not in (None, 0):
+            log(f"parent: solver child on {default_backend} failed (rc={rc})")
+
+    def harvest(proc):
+        if os.path.exists(solver_out):
+            with open(solver_out) as f:
+                return json.load(f)
+        return None
+
+    solver = harvest(solver_proc)
+
+    # --- phase 2: reduced CPU fallback only if the TPU leg produced
+    # nothing (hotel app only: media nginx alone costs ~410 s on CPU) ----
+    reduced_scope = False
+    if solver is None and default_backend != "cpu":
+        cpu_budget = remaining(deadline_ts) - BASELINE_RESERVE - MERGE_SLACK
+        if cpu_budget > 60:
+            log(f"parent: REDUCED solver child on cpu "
+                f"(budget {cpu_budget:.0f}s)")
+            hotel_bundle = os.path.join(tmpdir, "bundle_hotel.pkl")
+            with open(hotel_bundle, "wb") as f:
+                pickle.dump(build_problems(apps={"hotel"}), f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            cpu_proc = _spawn("solver", hotel_bundle, solver_out,
+                              backend="cpu")
+            _wait_for_marker(cpu_proc, marker, cpu_budget)
+            tried.append("cpu")
+            solver = harvest(cpu_proc)
+            reduced_scope = solver is not None
+            if cpu_proc.poll() is None:
+                cpu_proc.kill()
+                cpu_proc.wait()
+
+    # --- phase 3: exact-path baseline (overlaps only solver enrichment) --
     baseline = None
-    if os.path.exists(base_out):
-        with open(base_out) as f:
-            baseline = json.load(f)
+    base_budget = remaining(deadline_ts) - MERGE_SLACK
+    if base_budget > 10:
+        log(f"parent: baseline child (budget {base_budget:.0f}s)")
+        base_proc = _spawn(
+            "baseline", bundle, base_out, backend="cpu",
+            extra_env={"TW_BENCH_BASELINE_BUDGET":
+                       str(max(5.0, base_budget - 25))})
+        try:
+            base_proc.wait(timeout=base_budget)
+        except subprocess.TimeoutExpired:
+            base_proc.kill()
+            base_proc.wait()
+        if os.path.exists(base_out):
+            with open(base_out) as f:
+                baseline = json.load(f)
+
+    # give a still-running solver child the leftovers to finish enrichment
+    if solver_proc is not None and solver_proc.poll() is None:
+        try:
+            solver_proc.wait(timeout=max(1.0, remaining(deadline_ts) - 10))
+        except subprocess.TimeoutExpired:
+            log("parent: killing solver child (enrichment unfinished)")
+            solver_proc.kill()
+            solver_proc.wait()
+        solver = harvest(solver_proc) or solver
 
     if solver is None:
         # still emit a parseable line so the round records *something*
@@ -611,49 +721,63 @@ def main() -> None:
 
     # apples-to-apples accuracy delta on identical inputs (finished
     # services only; unfinished exact solves can't be compared)
-    delta = None
+    delta_fresh = delta_all = None
     subset_pairs = {}
     if baseline:
         tpu_sub = solver.get("subset_accuracy_per_service", {})
-        diffs = []
+        diffs_fresh, diffs_all = [], []
         for label, rec in baseline.get("subset", {}).items():
             key = f"{label}@{rec['n_spans']}"
             if rec["finished"] and key in tpu_sub:
-                diffs.append(tpu_sub[key] - rec["accuracy"])
+                d = tpu_sub[key] - rec["accuracy"]
+                diffs_all.append(d)
+                if rec.get("measured"):
+                    diffs_fresh.append(d)
                 subset_pairs[label] = {
                     "n_spans": rec["n_spans"],
                     "tpu": tpu_sub[key],
                     "exact": round(rec["accuracy"], 4),
                     "exact_seconds": round(rec["seconds"], 2),
+                    "exact_measured_here": bool(rec.get("measured")),
                 }
-        if diffs:
-            delta = sum(diffs) / len(diffs)
+        if diffs_fresh:
+            delta_fresh = sum(diffs_fresh) / len(diffs_fresh)
+        if diffs_all:
+            delta_all = sum(diffs_all) / len(diffs_all)
 
-    base_sps = (baseline or {}).get("spans_per_sec_upper_bound")
     exact_sps = (baseline or {}).get("subset_spans_per_sec")
-    # headline ratio: prefer the MEASURED uncapped exact-path speed on the
-    # same inputs; fall back to the capped upper bound (a floor)
-    ratio_base = exact_sps or base_sps
+    exact_sps_all = (baseline or {}).get("subset_spans_per_sec_incl_recorded")
+    ratio_base = exact_sps or exact_sps_all
     result = {
-        "metric": "span_assignment_throughput_hotel+media_load150_x10",
+        # the reduced fallback corpus (hotel only) is NOT comparable to the
+        # full two-app workload — it reports under its own metric name
+        "metric": ("span_assignment_throughput_hotel_only_x10_REDUCED"
+                   if reduced_scope else
+                   "span_assignment_throughput_hotel+media_load150_x10"),
+        "reduced_scope": reduced_scope,
         "value": round(solver["spans_per_sec"], 1),
         "unit": "spans/sec",
         "vs_baseline": (round(solver["spans_per_sec"] / ratio_base, 1)
                         if ratio_base else None),
         "backend": solver["backend"],
+        "backend_init_s": solver.get("backend_init_s"),
         "n_spans": solver["n_spans"],
         "n_services": solver.get("n_services"),
         "solve_time_s": round(solver["solve_time_s"], 2),
         "warmup_compile_s": round(solver["warmup_time_s"], 2),
         "compile_cache_warm": solver.get("compile_cache_warm"),
         "accuracy_tpu": round(solver["accuracy_mean"], 4),
-        "accuracy_delta_same_inputs": (round(delta, 4)
-                                       if delta is not None else None),
+        "accuracy_delta_same_inputs": (round(delta_fresh, 4)
+                                       if delta_fresh is not None else None),
+        "accuracy_delta_incl_recorded": (round(delta_all, 4)
+                                         if delta_all is not None else None),
         "subset_same_inputs": subset_pairs,
         "exact_spans_per_sec_same_inputs": (round(exact_sps, 3)
                                             if exact_sps else None),
-        "baseline_spans_per_sec_capped_upper_bound": (round(base_sps, 2)
-                                                      if base_sps else None),
+        "exact_spans_per_sec_incl_recorded": (round(exact_sps_all, 3)
+                                              if exact_sps_all else None),
+        "baseline_fresh_solves": (baseline or {}).get("n_fresh"),
+        "baseline_recorded_carried": (baseline or {}).get("n_recorded"),
         "pallas_on_device_ok": solver.get("pallas_on_device_ok"),
         "stage_seconds": solver.get("stage_seconds"),
         "fused_em_dispatches": solver.get("fused_em_dispatches"),
@@ -662,6 +786,7 @@ def main() -> None:
         "mfu_est_pct": solver.get("mfu_est_pct"),
         "hbm_util_est_pct": solver.get("hbm_util_est_pct"),
         "profile_top_ops": solver.get("profile_top_ops"),
+        "wall_clock_s": round(time.time() - T_START, 1),
     }
     print(json.dumps(result))
 

@@ -39,13 +39,18 @@ sys.path.insert(0, REPO)
 REF_PY = "/root/reference/src/trace_reconstructor/ports/python"
 
 DATASETS = [
-    # (label, path, fix)
+    # (label, path, fix[, max_traces])
     ("hotel_load25", "/root/reference/data/hotel_reservation/hotel_load25", 2),
     ("hotel_load150", "/root/reference/data/hotel_reservation/hotel_load150", 2),
     ("node_load25", "/root/reference/data/nodejs_microservices/node_load25", 0),
     ("node_load150", "/root/reference/data/nodejs_microservices/node_load150", 0),
     ("media_load25", "/root/reference/data/media_microservices/media_load25", 1),
     ("media_load150", "/root/reference/data/media_microservices/media_load150", 1),
+    # sub-sampled corpus on which the reference V3 can actually finish
+    # (the full 1000-trace corpus ran >4 h without completing, round-3
+    # PARITY footnote) — closes the one flagship-vs-flagship hole
+    ("media_load150_sub200",
+     "/root/reference/data/media_microservices/media_load150", 1, 200),
 ]
 
 # (registry method name, reference class name, ours class name, needs_dag)
@@ -151,6 +156,37 @@ def _run_one(cls, method, store, problems, use_dag):
     return out
 
 
+def _run_fleet(store, problems, method="MaxScoreBatchSubsetWithSkips"):
+    """Flagship rows via the PRODUCTION path: every service in one fused
+    device dispatch (fleet.py — the same route runtime/executor.py takes,
+    proven assignment-identical to per-service solves in
+    tests/test_fleet.py). The dispatch wall-clock is attributed to
+    services by incoming-span share; compile amortizes across the whole
+    dataset exactly as it does in the experiment sweeps."""
+    from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+    from traceweaver_tpu.metrics import accuracy_for_service
+
+    items = [
+        FleetItem(svc, copy.deepcopy(prob.in_span_partitions),
+                  copy.deepcopy(prob.out_span_partitions),
+                  copy.deepcopy(ta), dag, method=method, store=store)
+        for svc, prob, ta, dag in problems
+    ]
+    random.seed(10)
+    t0 = time.perf_counter()
+    with contextlib.redirect_stdout(io.StringIO()):
+        outs = solve_fleet(items)
+    total = time.perf_counter() - t0
+    n_spans = [len(next(iter(it.in_span_partitions.values())))
+               for it in items]
+    out = {}
+    for (svc, _, _, _), item, res, ns in zip(problems, items, outs, n_spans):
+        acc = accuracy_for_service(res[0], item.true_assignments,
+                                   item.in_span_partitions)
+        out[svc] = (acc, total * ns / max(1, sum(n_spans)))
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(REPO, "exps/parity/results"))
@@ -191,17 +227,27 @@ def main():
         build_service_problem, infer_invocation_dag, load_corpus,
     )
     from traceweaver_tpu.metrics import get_ground_truth
+    from traceweaver_tpu.runtime.jax_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    # same steady-state the experiment sweeps run in: compiled programs
+    # persist per backend+host, so repeat harness runs pay no recompiles
+    enable_persistent_compilation_cache()
 
     os.makedirs(args.out, exist_ok=True)
     results = {}
 
-    for label, path, fix in DATASETS:
+    for label, path, fix, *rest in DATASETS:
+        # a per-dataset cap (the sub-sampled corpora) tightens, never
+        # loosens, the CLI's --max-traces
+        max_traces = min(rest[0], args.max_traces) if rest else args.max_traces
         if dataset_filter and label not in dataset_filter:
             continue
         if not os.path.isdir(path):
             print(f"[parity] {label}: dataset missing, skipped", file=sys.stderr)
             continue
-        store = load_corpus(path, fix=fix, max_traces=args.max_traces, cache=True)
+        store = load_corpus(path, fix=fix, max_traces=max_traces, cache=True)
         problems = []
         for svc in store.out_spans_by_process:
             prob = build_service_problem(store, svc)
@@ -227,9 +273,15 @@ def main():
                 except Exception as e:  # pragma: no cover - report, keep going
                     table[f"{method}/reference"] = {"error": repr(e)}
             try:
-                our_cls = _load_our_class(ours_dotted)
-                table[f"{method}/ours"] = _run_one(
-                    our_cls, method, store, problems, use_dag)
+                if ours_dotted == "weaver_tpu.WeaverTPU":
+                    # flagship rides the production fleet path (one fused
+                    # dispatch per dataset; _run_fleet docstring)
+                    table[f"{method}/ours"] = _run_fleet(
+                        store, problems, method)
+                else:
+                    our_cls = _load_our_class(ours_dotted)
+                    table[f"{method}/ours"] = _run_one(
+                        our_cls, method, store, problems, use_dag)
             except Exception as e:  # pragma: no cover
                 table[f"{method}/ours"] = {"error": repr(e)}
 
@@ -237,10 +289,7 @@ def main():
                            or "MaxScoreBatchSubsetWithSkips" in method_filter)
         if (not args.no_tpu and flagship_wanted
                 and "MaxScoreBatchSubsetWithSkips/ours" not in table):
-            from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
-
-            table["Flagship(WeaverTPU)/ours"] = _run_one(
-                WeaverTPU, "MaxScoreBatchSubsetWithSkips", store, problems, True)
+            table["Flagship(WeaverTPU)/ours"] = _run_fleet(store, problems)
 
         results[label] = table
         print(f"[parity] {label} done", file=sys.stderr)
@@ -267,7 +316,15 @@ def main():
         "algorithm family of the reference's own license-free fallback",
         "`exact_MWIS` — and a no-op pygmmis stub for its unused import).",
         "`MaxScoreBatchSubsetWithSkips` is therefore flagship-vs-flagship:",
-        "reference V3 vs WeaverTPU.",
+        "reference V3 vs WeaverTPU. Flagship `ours` rows run the PRODUCTION",
+        "fleet path (every service in one fused device dispatch — the same",
+        "route `runtime/executor.py` takes, assignment-identical to",
+        "per-service solves per tests/test_fleet.py); the dispatch",
+        "wall-clock is attributed to services by incoming-span share, with",
+        "the persistent per-host compile cache warm (the sweeps'",
+        "steady-state). `media_load150_sub200` is the same corpus capped at",
+        "200 traces — the largest instance the reference V3 finishes in",
+        "reasonable time (the full corpus ran > 4 h without completing).",
         "",
     ]
     for label, table in results.items():

@@ -112,3 +112,53 @@ def test_synthesize_and_reconstruct(tmp_path):
         assert accuracy_for_service(out[0], ta, prob.in_span_partitions) > 0.8
         solved += 1
     assert solved >= 1
+
+
+def test_synthesize_writes_replica_table(tmp_path):
+    """The generator must regenerate the reference's missing
+    ``data/misc/service_to_replica_new.pickle`` artifact (loaded
+    unconditionally at reference executor.py:912 and used to divide the
+    compress factor per service, :922-929) next to the corpus, with
+    Alibaba-like replica counts."""
+    import pickle
+
+    out = tmp_path / "alibaba_microservices" / "call_graph_data"
+    synthesize_corpus(str(out), n_graphs=1, traces_per_graph=10, seed=7)
+    table_path = tmp_path / "misc" / "service_to_replica_new.pickle"
+    assert table_path.exists()
+    with open(table_path, "rb") as f:
+        table = pickle.load(f)
+    assert len(table) == 60  # every MS_* service has an entry
+    assert all(16 <= len(replicas) <= 128 for replicas in table.values())
+    # deterministic: same seed regenerates the identical table
+    synthesize_corpus(str(out), n_graphs=1, traces_per_graph=10, seed=7)
+    with open(table_path, "rb") as f:
+        assert pickle.load(f) == table
+
+
+def test_executor_replica_scaling_divides_compress(tmp_path):
+    """ExecutorConfig.replica_count feeds ceil(compress/replicas)
+    (reference executor.py:922-929): a 15000x corpus factor over ~100
+    replicas must land the per-service load factor in the identifiable
+    100-1000x regime, not at the raw floor."""
+    import math
+    import pickle
+
+    from traceweaver_tpu.ingest import load_corpus
+    from traceweaver_tpu.runtime.executor import ExecutorConfig
+
+    out = tmp_path / "alibaba_microservices" / "call_graph_data"
+    dirs = synthesize_corpus(str(out), n_graphs=1, traces_per_graph=10,
+                             seed=7)
+    with open(tmp_path / "misc" / "service_to_replica_new.pickle",
+              "rb") as f:
+        table = pickle.load(f)
+    store = load_corpus(dirs[0], fix=5, max_traces=10, cache=False)
+    cfg = ExecutorConfig(data_path="", results_directory="", fix=5,
+                         cache_rate=0.0, compress_factor=15000,
+                         service_to_replica=table)
+    factors = [
+        math.ceil(15000 / cfg.replica_count(svc, store))
+        for svc in store.out_spans_by_process
+    ]
+    assert factors and all(100 <= f <= 1000 for f in factors), factors

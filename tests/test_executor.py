@@ -81,3 +81,46 @@ def test_cli_requires_path(tmp_path):
     )
     assert out.returncode != 0
     assert "relative_path" in out.stderr or "absolute_path" in out.stderr
+
+
+def test_compile_cache_namespaced_per_host(tmp_path, monkeypatch):
+    """A cache dir populated on another machine must never be read here:
+    entries land under a backend+host-fingerprint subdir (round-3 driver
+    logs showed cpu_aot_loader feature-mismatch errors from foreign
+    entries at the cache root)."""
+    from traceweaver_tpu.runtime.jax_cache import (
+        enable_persistent_compilation_cache,
+        host_cache_key,
+    )
+
+    monkeypatch.setenv("TW_JAX_CACHE_DIR", str(tmp_path))
+    # a foreign machine's entry at the root (where rounds 1-3 wrote)
+    (tmp_path / "jit_foo-deadbeef-cache").write_bytes(b"not for this host")
+    used = enable_persistent_compilation_cache()
+    assert os.path.dirname(used) == str(tmp_path)
+    assert os.path.basename(used) == host_cache_key()
+    assert os.path.isdir(used)
+    # key is stable within a host and carries the platform selection
+    assert host_cache_key() == host_cache_key()
+    assert host_cache_key().startswith("cpu-")  # conftest pins JAX_PLATFORMS
+
+
+def test_run_experiment_fleet_identical_to_per_service(hotel_store):
+    """The production executor's fleet path (one fused dispatch for all
+    services) must be output-identical to the per-service dispatch path
+    on recorded data — same per-process accuracies, same e2e accuracy,
+    same confidence inputs."""
+    from traceweaver_tpu.runtime.executor import ExecutorConfig, run_experiment
+
+    def run(fleet):
+        cfg = ExecutorConfig(
+            data_path="", results_directory="", fix=2, cache_rate=0.0,
+            test_name="hotel", predictor_indices=[10], fleet=fleet,
+        )
+        return run_experiment(cfg, store=hotel_store)
+
+    a, b = run(True), run(False)
+    assert a.accuracy_per_process == b.accuracy_per_process
+    assert a.accuracy_overall == b.accuracy_overall
+    assert a.confidence_scores == b.confidence_scores
+    assert a.candidates_per_process == b.candidates_per_process

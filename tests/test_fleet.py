@@ -65,8 +65,13 @@ def test_fleet_single_dispatch_matches_per_service(hotel_problems):
                                                  singles):
         # identical hard assignments endpoint-for-endpoint
         assert f[0] == s[0], f"fleet assignments diverge on {svc}"
-        # and identical bookkeeping counts
+        # and identical bookkeeping: not_best count, per-span candidate
+        # counts, and unassigned count (padded endpoints must contribute
+        # nothing), plus the trivially-equal span count
+        assert f[2] == s[2], f"not_best_count diverges on {svc}"
         assert f[3] == s[3]
+        assert f[4] == s[4], f"per_span_candidates diverge on {svc}"
+        assert f[5] == s[5], f"cnt_unassigned diverges on {svc}"
         acc_f = accuracy_for_service(f[0], ta, prob.in_span_partitions)
         acc_s = accuracy_for_service(s[0], ta, prob.in_span_partitions)
         assert acc_f == acc_s
@@ -99,3 +104,45 @@ def test_fleet_budget_fallback_is_equivalent(hotel_problems, monkeypatch):
     assert stats.get("fleet_fallback_budget") == 1.0
     for f, s in zip(fused, fell_back):
         assert f[0] == s[0]
+
+
+def test_fleet_budget_bounds_refit_matrix_at_scale(hotel_problems,
+                                                   monkeypatch):
+    """exp5-scale fleets (P >= 15) must degrade gracefully: the budget
+    check bounds the gathered [P*Ne, Bmax*W] refit matrix too, and when
+    the combined block exceeds the budget every item still gets a correct
+    per-service solve (with overlapped dispatches + merged stats)."""
+    import traceweaver_tpu.algorithms.fleet as fleet_mod
+
+    base = [FleetItem(svc, prob.in_span_partitions,
+                      prob.out_span_partitions, ta, dag, store=store)
+            for store, svc, prob, ta, dag in hotel_problems]
+    # replicate to a 16-service fleet (distinct FleetItem objects)
+    items = [FleetItem(it.svc, it.in_span_partitions,
+                       it.out_span_partitions, it.true_assignments, it.dag,
+                       store=it.store)
+             for it in (base * ((15 // len(base)) + 1))][:16]
+    singles = solve_fleet(base)
+
+    # budget that the score block alone would pass but score+refit must
+    # trip: P*Ne*Bmax*W dominates here because Ne grows as E^2
+    stats = {}
+    monkeypatch.setattr(fleet_mod, "FLEET_BUDGET_ELEMS", 1 << 18)
+    out = solve_fleet(items, stats=stats)
+    assert stats.get("fleet_fallback_budget") == 1.0
+    assert stats.get("pack_s") is not None  # fallback stats merged
+    by_svc = {it.svc: s for it, s in zip(base, singles)}
+    for it, o in zip(items, out):
+        assert o is not None and len(o) == 6
+        assert o[0] == by_svc[it.svc][0]
+
+
+def test_fleet_services_stat_accumulates(hotel_problems):
+    items = [FleetItem(svc, prob.in_span_partitions,
+                       prob.out_span_partitions, ta, dag, store=store)
+             for store, svc, prob, ta, dag in hotel_problems]
+    stats = {}
+    solve_fleet(items, stats=stats)
+    solve_fleet(items, stats=stats)
+    assert stats["fleet_services"] == 2.0 * len(items)
+    assert stats["fleet_dispatches"] == 2.0

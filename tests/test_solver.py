@@ -285,3 +285,26 @@ def test_fused_em_matches_host_refit(hotel_store):
         assert "refit_s" in host.stats
 
         assert out_f[0] == out_h[0], svc  # assignments identical
+
+
+def test_sinkhorn_tol_default_matches_exact_potentials(hotel_store):
+    """WeaverTPU defaults to sinkhorn_tol=1e-3 (early-exit on converged
+    potentials). The tolerance must not flip any greedy-rounded
+    assignment vs the exact tol=0.0 solve on recorded data (advisor
+    round-3 finding: the default changed numerics for all callers but
+    was only validated on one synthetic problem)."""
+    e2e_tol, extras_tol = _run(
+        hotel_store,
+        lambda: WeaverTPU(hotel_store.all_spans, hotel_store.all_processes),
+        "MaxScoreBatchSubsetWithSkips",
+    )
+    e2e_exact, extras_exact = _run(
+        hotel_store,
+        lambda: WeaverTPU(hotel_store.all_spans, hotel_store.all_processes,
+                          sinkhorn_tol=0.0),
+        "MaxScoreBatchSubsetWithSkips",
+    )
+    assert e2e_tol == e2e_exact
+    for svc in extras_tol:
+        assert extras_tol[svc][0][0] == extras_exact[svc][0][0], (
+            f"tolerance flipped an assignment on {svc}")

@@ -92,6 +92,38 @@ def _prepare(item: FleetItem, solver: WeaverTPU):
                 skip_budget=skip_budget, dists=dists, n_in=n_in)
 
 
+def _run_fallback(entries, results, all_spans, all_processes,
+                  solver_kwargs, stats) -> None:
+    """Per-service solves for items the fused dispatch cannot carry.
+
+    Dispatches overlap through a thread pool (the reference's own
+    ThreadPool-over-services model, executor.py:1015-1026) and each
+    solver's stage stats merge into the caller's dict — a mixed workload
+    keeps both the overlap and the accounting it had on the pre-fleet
+    bench path."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def run(entry):
+        i, item = entry
+        algo = WeaverTPU(
+            item.store.all_spans if item.store else all_spans,
+            item.store.all_processes if item.store else all_processes,
+            **solver_kwargs)
+        out = algo.FindAssignments(
+            item.method, item.svc, item.in_span_partitions,
+            item.out_span_partitions, False, [], item.true_assignments,
+            item.dag,
+        )
+        return i, out, algo.stats
+
+    with ThreadPoolExecutor(max_workers=max(1, len(entries))) as pool:
+        for i, out, solver_stats in pool.map(run, entries):
+            results[i] = out
+            if stats is not None:
+                for k, v in solver_stats.items():
+                    stats[k] = stats.get(k, 0.0) + v
+
+
 def solve_fleet(
     items: List[FleetItem],
     all_spans=None,
@@ -109,29 +141,24 @@ def solve_fleet(
     ``(all_assignments, all_topk, not_best_count, n_spans,
     per_span_candidates, cnt_unassigned)``.
     """
-    solver = WeaverTPU(all_spans, all_processes, max_window=max_window,
-                       epsilon=epsilon, n_sinkhorn=n_sinkhorn,
-                       n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol)
+    solver_kwargs = dict(max_window=max_window, epsilon=epsilon,
+                         n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
+                         sinkhorn_tol=sinkhorn_tol)
+    solver = WeaverTPU(all_spans, all_processes, **solver_kwargs)
     results: List[Optional[Tuple]] = [None] * len(items)
 
     prepared = []
+    fallback_entries = []
     for i, item in enumerate(items):
         prep = _prepare(item, solver)
         if prep is None:
             # host-in-the-loop configuration: per-service path
-            algo = WeaverTPU(
-                item.store.all_spans if item.store else all_spans,
-                item.store.all_processes if item.store else all_processes,
-                max_window=max_window, epsilon=epsilon,
-                n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
-                sinkhorn_tol=sinkhorn_tol)
-            results[i] = algo.FindAssignments(
-                item.method, item.svc, item.in_span_partitions,
-                item.out_span_partitions, False, [], item.true_assignments,
-                item.dag,
-            )
+            fallback_entries.append((i, item))
         else:
             prepared.append((i, item, prep))
+    if fallback_entries:
+        _run_fallback(fallback_entries, results, all_spans, all_processes,
+                      solver_kwargs, stats)
     if not prepared:
         return results  # type: ignore[return-value]
 
@@ -158,20 +185,17 @@ def solve_fleet(
         E_pad = max(E_pad, len(out_eps))
 
     n_windows_total = sum(len(w) for _, _, _, w, _, _ in plans)
-    if n_windows_total * E_pad * W_pad * M_pad > FLEET_BUDGET_ELEMS:
+    bmax = max(len(w) for _, _, _, w, _, _ in plans)
+    P = len(plans)
+    # Ne family rows per service in the fused refit (in/edge/return)
+    Ne = E_pad + E_pad * E_pad + E_pad
+    score_elems = n_windows_total * E_pad * W_pad * M_pad
+    # the fused refit gathers each service's window rows: [P*Ne, Bmax*W]
+    refit_elems = P * Ne * bmax * W_pad
+    if score_elems + refit_elems > FLEET_BUDGET_ELEMS:
         # padded fleet block would stress HBM: per-service dispatches
-        for i, item, prep, *_ in plans:
-            algo = WeaverTPU(
-                item.store.all_spans if item.store else all_spans,
-                item.store.all_processes if item.store else all_processes,
-                max_window=max_window, epsilon=epsilon,
-                n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
-                sinkhorn_tol=sinkhorn_tol)
-            results[i] = algo.FindAssignments(
-                item.method, item.svc, item.in_span_partitions,
-                item.out_span_partitions, False, [], item.true_assignments,
-                item.dag,
-            )
+        _run_fallback([(i, item) for i, item, *_ in plans], results,
+                      all_spans, all_processes, solver_kwargs, stats)
         if stats is not None:
             stats["fleet_fallback_budget"] = 1.0
         return results  # type: ignore[return-value]
@@ -211,10 +235,19 @@ def solve_fleet(
     batch = {k: np.concatenate(v, axis=0) for k, v in arrays_cat.items()}
     params = {k: np.stack(v, axis=0) for k, v in param_rows.items()}
     pidx = np.asarray(param_idx, dtype=np.int32)
+    # each service's contiguous window-row block, for the gathered refit
+    window_rows = np.zeros((P, bmax), dtype=np.int32)
+    window_valid = np.zeros((P, bmax), dtype=bool)
+    row0 = 0
+    for p, (_, _, _, _, n_w) in enumerate(per_item_pack):
+        window_rows[p, :n_w] = np.arange(row0, row0 + n_w, dtype=np.int32)
+        window_valid[p, :n_w] = True
+        row0 += n_w
     if stats is not None:
         stats["pack_s"] = stats.get("pack_s", 0.0) + time.perf_counter() - t0
         stats["fleet_dispatches"] = stats.get("fleet_dispatches", 0.0) + 1
-        stats["fleet_services"] = float(len(per_item_pack))
+        stats["fleet_services"] = (stats.get("fleet_services", 0.0)
+                                   + float(len(per_item_pack)))
         # analytic op accounting (UPPER BOUND — sweep and Sinkhorn loops
         # exit early on convergence), same model as WeaverTPU._solve_once
         K = params["in_wt"].shape[2]
@@ -237,6 +270,7 @@ def solve_fleet(
         batch["in_start"], batch["in_end"], batch["in_valid"],
         batch["out_start"], batch["out_end"], batch["out_valid"],
         batch["skip_cap"], batch["force_skip"], pidx,
+        window_rows, window_valid,
         params["pred_mask"], params["root_mask"], params["is_last"],
         params["edge_wt"], params["edge_mu"], params["edge_sd"],
         params["in_wt"], params["in_mu"], params["in_sd"],

@@ -504,7 +504,7 @@ def solve_windows_fleet(
                                    "sinkhorn_tol"))
 def solve_em_fleet(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
-    skip_cap, force_skip, param_idx,
+    skip_cap, force_skip, param_idx, window_rows, window_valid,
     pred_masks, root_masks, is_lasts,
     edge_wts, edge_mus, edge_sds, in_wts, in_mus, in_sds,
     ret_wts, ret_mus, ret_sds,
@@ -515,15 +515,24 @@ def solve_em_fleet(
     """Both EM iterations for a whole service fleet in ONE dispatch.
 
     The fleet analogue of :func:`solve_em_packed`: pass 0 over every
-    service's windows, per-service three-family delay extraction (windows
-    contribute only to their own service's rows via ``param_idx``), one
+    service's windows, per-service three-family delay extraction, one
     batched BIC-GMM refit over the ``P*Ne`` family rows, then pass 1 —
     the whole bench workload's EM never leaves the device and costs a
-    single round trip through the tunnel."""
+    single round trip through the tunnel.
+
+    ``window_rows``/``window_valid`` ([P, Bmax] int32/bool) list each
+    service's window rows in the fleet batch (the packer emits services as
+    contiguous row blocks). The per-service refit matrix is built by
+    GATHERING those rows — ``[P*Ne, Bmax*W]`` — rather than broadcasting
+    the full sample matrix per service (``[P*Ne, B*W]``): the window axis
+    a service's EM sees shrinks from the whole fleet's to its own, so the
+    refit block stays ~P× smaller and scales to exp5-size fleets.
+    """
     B, E, M = out_start.shape
     W = in_start.shape[1]
     P, _, K = in_wts.shape
     Ne = E + E * E + E
+    Bmax = window_rows.shape[1]
 
     assign0, _, _, _ = _solve_windows_impl(
         in_start, in_end, in_valid, out_start, out_end, out_valid,
@@ -541,12 +550,11 @@ def solve_em_fleet(
         assign0, in_start, in_end, in_valid, out_start, out_end,
         pred_masks[param_idx], root_masks[param_idx])       # [Ne, B*W]
 
-    svc_of_pos = jnp.repeat(param_idx, W)                   # [B*W]
-    fleet_mask = (smask[None, :, :]
-                  & (svc_of_pos[None, None, :]
-                     == jnp.arange(P)[:, None, None])).reshape(P * Ne, B * W)
-    fleet_samples = jnp.broadcast_to(samples[None], (P, Ne, B * W)) \
-        .reshape(P * Ne, B * W)
+    fs = samples.reshape(Ne, B, W)[:, window_rows, :]       # [Ne, P, Bmax, W]
+    fm = (smask.reshape(Ne, B, W)[:, window_rows, :]
+          & window_valid[None, :, :, None])
+    fleet_samples = jnp.moveaxis(fs, 1, 0).reshape(P * Ne, Bmax * W)
+    fleet_mask = jnp.moveaxis(fm, 1, 0).reshape(P * Ne, Bmax * W)
 
     from traceweaver_tpu.ops.gmm import fit_gmm_in_graph
 

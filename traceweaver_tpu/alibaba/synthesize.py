@@ -85,6 +85,17 @@ def synthesize_corpus(
     # stresses (exp5/run_experiment.sh:270-284). A 40ms gap would compress
     # to ~3us, under the per-edge jitter, making every method (including
     # the reference's V3) statistically unable to distinguish candidates.
+    #
+    # That floor is exactly why the reference's own executor divides the
+    # compress factor by the service's REPLICA COUNT
+    # (executor.py:922-929, loading data/misc/service_to_replica_new.pickle
+    # — absent from the release, SURVEY §6 artifact gap): a 15000x corpus
+    # load spread over ~a hundred replicas stresses each replica at
+    # ~100-1000x, the "hard but physically identifiable" regime of fig6a.
+    # This generator therefore also regenerates the replica-table artifact
+    # (Alibaba-like log-uniform 16..128 replicas per microservice) next to
+    # the corpus; without it every service defaults to 1 replica and the
+    # top rungs measure an unidentifiability floor, not solver quality.
     """Generate, repair, convert, and group; returns the call_graph dirs."""
     rng = random.Random(seed)
     services = [f"MS_{i:05d}" for i in range(60)]
@@ -135,7 +146,42 @@ def synthesize_corpus(
             if repaired is not None:
                 traces[tid] = repaired
 
+    write_replica_table(out_root, services, seed)
     return group_traces(traces, out_root, top_n=n_graphs, min_traces=2)
+
+
+def write_replica_table(out_root: str, services: List[str],
+                        seed: int = 10) -> str:
+    """Regenerate the ``service_to_replica_new.pickle`` artifact.
+
+    The reference loads it unconditionally (executor.py:912) and scales
+    each service's compress factor by its replica count (:922-929), but
+    the release ships no ``data/misc/`` at all. Replica counts are drawn
+    log-uniform in [16, 128] per service (Alibaba microservices run tens
+    to hundreds of replicas), deterministically from ``seed`` so the
+    corpus and table regenerate together. Written beside the corpus at
+    ``<out_root>/../../misc/service_to_replica_new.pickle``; the CLI
+    checks the repo-root ``data/misc`` location first (the reference's
+    path, executor.py:912) and then this dataset-relative one
+    (runtime/cli.py).
+    """
+    import os
+    import pickle
+
+    rng = random.Random(seed + 1)
+    table = {
+        svc: [f"{svc}.r{i}" for i in range(
+            int(round(2 ** rng.uniform(4.0, 7.0))))]
+        for svc in services
+    }
+    assert all(16 <= len(v) <= 128 for v in table.values())
+    misc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(out_root))), "misc")
+    os.makedirs(misc, exist_ok=True)
+    path = os.path.join(misc, "service_to_replica_new.pickle")
+    with open(path, "wb") as f:
+        pickle.dump(table, f, protocol=pickle.HIGHEST_PROTOCOL)
+    return path
 
 
 def main(argv=None) -> int:

@@ -27,14 +27,14 @@ tiers, matched to the hardware's communication hierarchy:
 The two communication paths degrade gracefully:
 
 - With a JAX distributed runtime (`jax.distributed.initialize`, real
-  multi-host TPU or multi-process CPU), the statistics allreduce rides
-  `jax.lax.psum` over a global mesh — XLA routes it over DCN between
-  slices and ICI within them.
+  multi-host TPU or multi-process CPU with gloo collectives),
+  :func:`allreduce_stats_jax` reduces the stacked statistics with one
+  XLA allreduce over a global mesh — DCN between slices, ICI within.
 - Without one (plain OS processes, the reference's own process model),
   :func:`allreduce_stats_files` provides a filesystem barrier+reduce so
   the exp harness works on any box. Correctness is identical; only
   transport differs. tests/test_multislice.py proves the two-process
-  case end-to-end this way.
+  case end-to-end through BOTH transports and asserts they agree.
 """
 
 from __future__ import annotations
@@ -99,6 +99,59 @@ def edge_stats_from_samples(
         a = np.asarray(v, dtype=np.float64)
         out[k] = (float(len(a)), float(a.sum()), float((a * a).sum()))
     return out
+
+
+def stats_to_rows(
+    stats: Dict[EdgeKey, Tuple[float, float, float]],
+    edge_order: Sequence[EdgeKey],
+) -> np.ndarray:
+    """Dense [len(edge_order), 3] view of per-edge stats (absent edges are
+    zero rows — the additive identity, so reductions stay exact)."""
+    rows = np.zeros((len(edge_order), 3), dtype=np.float64)
+    for i, k in enumerate(edge_order):
+        if k in stats:
+            rows[i] = stats[k]
+    return rows
+
+
+def allreduce_stats_jax(local_rows: np.ndarray) -> np.ndarray:
+    """The JAX-distributed-runtime transport: one ``psum`` of the stacked
+    per-edge sufficient statistics across every process's devices.
+
+    Requires ``jax.distributed.initialize`` to have run (real multi-host
+    TPU, or multi-process CPU with gloo collectives) and every process to
+    call with a same-shaped ``[rows, 3]`` array. Each process contributes
+    its local rows as one shard of a global ``[n_devices, rows, 3]`` array
+    laid out over a 1-D "slices" mesh; the jitted sum over the sharded
+    axis lowers to an XLA allreduce — DCN between slices, ICI within —
+    and returns the identical merged rows on every process (the same
+    numbers :func:`allreduce_stats_files` produces over the filesystem
+    transport; tests/test_multislice.py asserts both).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = np.asarray(jax.devices())
+    mesh = Mesh(devs, ("slices",))
+    # f64 end-to-end: the stats are (n, Σd, Σd²) with Σd² ~ 1e13+ for
+    # ms-scale delays over big corpora — f32 would silently destroy the
+    # variance by cancellation, diverging from the filesystem transport
+    with jax.enable_x64(True):
+        local = jnp.asarray(local_rows, dtype=jnp.float64)
+        # only the FIRST local device carries the process's rows; the rest
+        # contribute exact-zero rows, so the global sum is correct for any
+        # devices-per-process split (no replica-count division needed)
+        zero = jnp.zeros_like(local)
+        shards = [
+            jax.device_put(local[None] if i == 0 else zero[None], d)
+            for i, d in enumerate(jax.local_devices())
+        ]
+        arr = jax.make_array_from_single_device_arrays(
+            (devs.size,) + local.shape,
+            NamedSharding(mesh, PartitionSpec("slices")), shards)
+        out = jax.jit(lambda x: jnp.sum(x, axis=0))(arr)
+        return np.asarray(out, dtype=np.float64)
 
 
 def allreduce_stats_files(

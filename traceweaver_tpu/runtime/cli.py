@@ -106,9 +106,19 @@ def main(argv=None) -> int:
         print(f"Error converting predictor indices: {e}", file=sys.stderr)
         return 1
 
+    # replica table (reference loads it unconditionally, executor.py:912):
+    # repo-root data/misc first (the reference's location), then the
+    # dataset-relative misc/ dir the Alibaba synthesizer writes
     replica_table = load_replica_table(
         os.path.join(root, "data/misc/service_to_replica_new.pickle")
     )
+    if replica_table is None:
+        # <data_root>/misc, three levels above the per-CG dataset dir
+        # (<data_root>/alibaba_microservices/call_graph_data/call_graph_N)
+        d = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(data_path.rstrip("/")))))
+        replica_table = load_replica_table(
+            os.path.join(d, "misc", "service_to_replica_new.pickle"))
 
     cfg = ExecutorConfig(
         data_path=data_path,

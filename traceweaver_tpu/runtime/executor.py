@@ -73,6 +73,10 @@ class ExecutorConfig:
     execute_parallel: bool = True
     clear_cache: bool = False
     compressed: bool = False
+    # fuse all services of a fleet-eligible method into one device
+    # dispatch (output-identical to the per-service path; supersedes the
+    # reference's ThreadPool-over-services, executor.py:1015-1026)
+    fleet: bool = True
     predictor_indices: List[int] = field(default_factory=list)
     max_traces: int = 1000
     # replica table for compress-factor scaling; absent in the reference
@@ -101,10 +105,12 @@ def load_replica_table(path: str) -> Optional[Dict[str, list]]:
     return None
 
 
-def _solve_service(cfg: ExecutorConfig, store: TraceStore, method: str,
-                   predictor, process: str):
-    """Per-service pipeline (reference ``process_single_process``,
-    executor.py:915-999). Returns None when the service is skipped."""
+def _prepare_service(cfg: ExecutorConfig, store: TraceStore, method: str,
+                     process: str):
+    """Host preamble of the per-service pipeline: problem construction,
+    ground truth, DAG inference, load/cache transforms (reference
+    ``process_single_process``, executor.py:915-964). Returns None when the
+    service is skipped."""
     prob = build_service_problem(store, process)
     if prob.skipped:
         return None
@@ -131,28 +137,12 @@ def _solve_service(cfg: ExecutorConfig, store: TraceStore, method: str,
             true_assignments, prob.in_span_partitions,
             prob.out_span_partitions, cache_rate=cfg.cache_rate,
         )
+    return dict(prob=prob, true=true_assignments, dag=invocation_graph)
 
-    parallel = cfg.parallel or method in (
-        "MaxScoreBatchParallel", "MaxScoreBatchParallelWithoutIterations"
-    )
-    # Always empty, matching the reference: --instrumented is parsed there
-    # too but instrumented_hops is hardcoded [] (executor.py:954, 1135).
-    instrumented_hops: List[int] = []
 
-    start = time.time()
-    args = [method, process, prob.in_span_partitions,
-            prob.out_span_partitions, parallel, instrumented_hops,
-            true_assignments]
-    kwargs = {}
-    if method in NEEDS_DAG_METHODS:
-        args.append(invocation_graph)
-    if method == "MaxScoreBatchSubsetWithTrueSkips":
-        kwargs = dict(true_skips=True)
-    elif method == "MaxScoreBatchSubsetWithTrueDist":
-        kwargs = dict(true_dist=True)
-    out = predictor.FindAssignments(*args, **kwargs)
-    elapsed = time.time() - start
-
+def _finish_service(prep, process: str, out, elapsed: float):
+    """Decode a FindAssignments result into the per-service record."""
+    prob, true_assignments = prep["prob"], prep["true"]
     pred_topk = not_best = num_spans = candidates = None
     if isinstance(out, tuple) and len(out) == 6:
         pred, pred_topk, not_best, num_spans, candidates, _unassigned = out
@@ -171,6 +161,76 @@ def _solve_service(cfg: ExecutorConfig, store: TraceStore, method: str,
                 pred_topk=pred_topk, acc=acc, acc_topk=acc_topk,
                 not_best=not_best, num_spans=num_spans,
                 candidates=candidates, seconds=elapsed)
+
+
+def _solve_service(cfg: ExecutorConfig, store: TraceStore, method: str,
+                   predictor, process: str):
+    """Per-service pipeline (reference ``process_single_process``,
+    executor.py:915-999). Returns None when the service is skipped."""
+    prep = _prepare_service(cfg, store, method, process)
+    if prep is None:
+        return None
+    prob, true_assignments = prep["prob"], prep["true"]
+
+    parallel = cfg.parallel or method in (
+        "MaxScoreBatchParallel", "MaxScoreBatchParallelWithoutIterations"
+    )
+    # Always empty, matching the reference: --instrumented is parsed there
+    # too but instrumented_hops is hardcoded [] (executor.py:954, 1135).
+    instrumented_hops: List[int] = []
+
+    start = time.time()
+    args = [method, process, prob.in_span_partitions,
+            prob.out_span_partitions, parallel, instrumented_hops,
+            true_assignments]
+    kwargs = {}
+    if method in NEEDS_DAG_METHODS:
+        args.append(prep["dag"])
+    if method == "MaxScoreBatchSubsetWithTrueSkips":
+        kwargs = dict(true_skips=True)
+    elif method == "MaxScoreBatchSubsetWithTrueDist":
+        kwargs = dict(true_dist=True)
+    out = predictor.FindAssignments(*args, **kwargs)
+    elapsed = time.time() - start
+    return _finish_service(prep, process, out, elapsed)
+
+
+def _solve_fleet_method(cfg: ExecutorConfig, store: TraceStore, method: str,
+                        predictor, services: List[str]):
+    """All services of one fleet-eligible method in ONE device dispatch.
+
+    The TPU-native replacement for the reference's ThreadPool-over-services
+    model (executor.py:1015-1026): every service's window batches ride a
+    single fused program (fleet.py), so per-service compile/dispatch round
+    trips are paid once per corpus. Per-item host-in-the-loop
+    configurations (dynamism from cache hits, missing DAGs) fall back to
+    per-service solves inside ``solve_fleet`` — output-identical either
+    way (tests/test_fleet.py, tests/test_executor.py)."""
+    from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+
+    preps = []
+    for process in services:
+        prep = _prepare_service(cfg, store, method, process)
+        if prep is not None:
+            preps.append((process, prep))
+    if not preps:
+        return []
+    items = [
+        FleetItem(process, prep["prob"].in_span_partitions,
+                  prep["prob"].out_span_partitions, prep["true"],
+                  prep["dag"], method=method, store=store)
+        for process, prep in preps
+    ]
+    start = time.time()
+    outs = solve_fleet(
+        items, max_window=predictor.max_window, epsilon=predictor.epsilon,
+        n_sinkhorn=predictor.n_sinkhorn, n_sweeps=predictor.n_sweeps,
+        sinkhorn_tol=predictor.sinkhorn_tol,
+    )
+    elapsed = time.time() - start
+    share = elapsed / max(1, len(preps))
+    return [_finish_service(prep, process, out, share)
+            for (process, prep), out in zip(preps, outs)]
 
 
 @dataclass
@@ -252,12 +312,24 @@ def run_experiment(cfg: ExecutorConfig,
     confidence_scores: Dict[str, list] = {}
     candidates_per_process: Dict[str, dict] = {}
 
+    from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
+
     for result_key, method, predictor in keyed_predictors:
         random.seed(10)
         services = list(store.out_spans_by_process.keys())
 
         results = []
-        if cfg.execute_parallel:
+        # --parallel flips the flagship to single-iteration parallel-sibling
+        # scoring (weaver_tpu.py parallel_mode), which the fused fleet
+        # program does not carry — route those runs per-service
+        use_fleet = (cfg.fleet and not cfg.parallel
+                     and method == "MaxScoreBatchSubsetWithSkips"
+                     and isinstance(predictor, WeaverTPU)
+                     and predictor.score_mode == "mixture")
+        if use_fleet:
+            results = _solve_fleet_method(cfg, store, method, predictor,
+                                          services)
+        elif cfg.execute_parallel:
             with concurrent.futures.ThreadPoolExecutor() as pool:
                 futures = [
                     pool.submit(_solve_service, cfg, store, method, predictor, p)

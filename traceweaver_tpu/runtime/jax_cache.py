@@ -11,11 +11,39 @@ not once per process.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_cache")
+
+
+def host_cache_key() -> str:
+    """Backend+host fingerprint namespacing the compile cache.
+
+    XLA:CPU serializes AOT executables specialized to the compiling
+    machine's CPU features; loading them on a different host fails
+    deserialization (or risks SIGILL — the loader says so verbatim).
+    The round-3 driver runs were flooded with exactly those
+    ``cpu_aot_loader.cc`` feature-mismatch errors from a cache directory
+    committed on another machine. Keying the directory by the selected
+    platforms plus a hash of the host's CPU flags makes a foreign cache
+    invisible instead of poisonous.
+    """
+    bits = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    bits.append(line.split(":", 1)[1].strip())
+                    break
+    except OSError:
+        bits.append(platform.processor() or "unknown")
+    fp = hashlib.sha1("|".join(bits).encode()).hexdigest()[:12]
+    platforms = os.environ.get("JAX_PLATFORMS", "") or "default"
+    return f"{platforms.replace(',', '+')}-{fp}"
 
 
 def enable_persistent_compilation_cache(cache_dir: str | None = None) -> str:
@@ -23,12 +51,15 @@ def enable_persistent_compilation_cache(cache_dir: str | None = None) -> str:
 
     ``TW_JAX_CACHE_DIR`` overrides the location; ``TW_JAX_CACHE=0``
     disables entirely. Must run before the first compilation (backend init
-    is fine). Returns the cache dir in use ("" when disabled).
+    is fine). Returns the cache dir in use ("" when disabled). The actual
+    directory is always namespaced per backend+host (:func:`host_cache_key`)
+    so entries compiled elsewhere can never be deserialized here.
     """
     if os.environ.get("TW_JAX_CACHE", "1") in ("0", "false", ""):
         return ""
-    cache_dir = (cache_dir or os.environ.get("TW_JAX_CACHE_DIR")
-                 or DEFAULT_CACHE_DIR)
+    base_dir = (cache_dir or os.environ.get("TW_JAX_CACHE_DIR")
+                or DEFAULT_CACHE_DIR)
+    cache_dir = os.path.join(base_dir, host_cache_key())
     os.makedirs(cache_dir, exist_ok=True)
 
     import jax
