@@ -5,9 +5,10 @@ recorded traces each), arrivals compressed 10x (reference
 ``repeat_change_spans`` semantics, transforms.py:10-40) — the
 high-interleave regime the reference's Alibaba scale sweep (exp5)
 stresses, where DFS candidate enumeration blows up combinatorially.
-Eight services total (hotel frontend/search + media's six), all fused
-into ONE device dispatch (fleet.py — supersedes the reference's
-per-service ThreadPool, executor.py:1015-1026).
+Eight services total (hotel frontend/search + media's six), fused into
+one device dispatch per window-shape class — typically 1-2 for this
+workload (fleet.py; supersedes the reference's per-service ThreadPool,
+executor.py:1015-1026).
 
 Two accuracy/throughput comparisons, both on identical inputs:
 

@@ -8,14 +8,17 @@ the sandbox's remote-device tunnel every dispatch costs ~100 ms of round
 trip, so an 8-service workload pays ~8 round trips of pure latency.
 
 This module is the TPU-native alternative (SURVEY.md §2.8 "services
-become a batch dimension"): the window batches of *all* services are
-padded to a common ``[B, E, W, M]`` shape class, each window tagged with
+become a batch dimension"): window batches of services are padded to
+shared ``[B, E, W, M]`` shape classes, each window tagged with
 ``param_idx`` — the row of its service's DAG-structure/distribution
-tables — and the whole fleet rides ONE jitted program
+tables — and each class rides ONE jitted program
 (:func:`traceweaver_tpu.algorithms.weaver_tpu.solve_em_fleet`), including
-both EM passes and the batched BIC-GMM refit between them. Padding is
-pure VPU work; the dispatch count (the actual bottleneck — measured MFU
-is <1%, so the VPU has headroom to burn) drops from O(services) to O(1).
+both EM passes and the batched BIC-GMM refit between them. Services with
+similar window geometry share a class; geometry outliers get their own
+dispatch rather than inflate everyone's padding (the merge budget is
+backend-aware — padding is nearly-free VPU headroom on TPU, real
+core-seconds on the CPU stand-in). Dispatch count drops from O(services)
+to O(shape classes), typically 1-2.
 
 Services whose method needs the host in the loop (KDE score mode,
 single-iteration parallel mode, the true-skips/true-dist oracles) fall
@@ -162,9 +165,8 @@ def solve_fleet(
     if not prepared:
         return results  # type: ignore[return-value]
 
+    # --- per-item window plan + shape class ------------------------------
     t0 = time.perf_counter()
-    # --- fleet shape class -----------------------------------------------
-    W_pad = M_pad = E_pad = 1
     plans = []
     for i, item, prep in prepared:
         in_spans, out_eps = prep["in_spans"], prep["out_eps"]
@@ -178,29 +180,105 @@ def solve_fleet(
         skip_caps = water_fill_skip_caps(
             windows, ranges, len(in_spans),
             [len(item.out_span_partitions[ep]) for ep in out_eps])
-        plans.append((i, item, prep, windows, ranges, skip_caps))
-        W_pad = max(W_pad, _bucket(max(hi - lo for lo, hi in windows)))
-        M_pad = max(M_pad, _bucket(
-            int((ranges[:, :, 1] - ranges[:, :, 0]).max(initial=1))))
-        E_pad = max(E_pad, len(out_eps))
+        w_b = _bucket(max(hi - lo for lo, hi in windows))
+        m_b = _bucket(int((ranges[:, :, 1] - ranges[:, :, 0]).max(initial=1)))
+        plans.append((i, item, prep, windows, ranges, skip_caps, w_b, m_b))
+    if stats is not None:
+        stats["pack_s"] = stats.get("pack_s", 0.0) + time.perf_counter() - t0
 
-    n_windows_total = sum(len(w) for _, _, _, w, _, _ in plans)
-    bmax = max(len(w) for _, _, _, w, _, _ in plans)
-    P = len(plans)
-    # Ne family rows per service in the fused refit (in/edge/return)
-    Ne = E_pad + E_pad * E_pad + E_pad
-    score_elems = n_windows_total * E_pad * W_pad * M_pad
-    # the fused refit gathers each service's window rows: [P*Ne, Bmax*W]
-    refit_elems = P * Ne * bmax * W_pad
-    if score_elems + refit_elems > FLEET_BUDGET_ELEMS:
-        # padded fleet block would stress HBM: per-service dispatches
-        _run_fallback([(i, item) for i, item, *_ in plans], results,
-                      all_spans, all_processes, solver_kwargs, stats)
-        if stats is not None:
-            stats["fleet_fallback_budget"] = 1.0
-        return results  # type: ignore[return-value]
+    # --- group services into dispatch shape classes ----------------------
+    # One fused program per class. Services with very different window
+    # geometry must not share one padded shape: hotel_load150's search
+    # (724 windows of 8x8x2) padded to its frontend's 32x32x3 pays 24x
+    # its own compute in padding. Small classes merge upward while the
+    # extra padded area stays under a budget that reflects the backend:
+    # on TPU padded cells are nearly-free VPU work and a saved dispatch
+    # is ~100 ms of tunnel latency (merge aggressively); on the CPU
+    # stand-in padded cells are real core-seconds (merge conservatively).
+    merge_env = os.environ.get("TW_FLEET_MERGE")
+    if merge_env:
+        merge_budget = int(merge_env)  # 0 = never merge shape classes
+    else:
+        import jax
 
-    # --- pack every service at the fleet shape ---------------------------
+        merge_budget = (1 << 24) if jax.default_backend() in ("tpu", "axon") \
+            else (1 << 20)
+
+    def shape_cost(group):
+        w = max(p[6] for p in group)
+        m = max(p[7] for p in group)
+        e = max(len(p[2]["out_eps"]) for p in group)
+        return sum(len(p[3]) for p in group) * w * m * e
+
+    # class key includes the endpoint-count bucket: an E=12 service fused
+    # with an E=1 service would pay 12x endpoint padding on the score
+    # block and E^2 growth on the refit rows — exactly the padding class
+    # the merge budget exists to arbitrate, so E outliers must start in
+    # their own class and only merge if shape_cost approves
+    classes: Dict[Tuple[int, int, int], List] = {}
+    for plan in plans:
+        e_b = _bucket(len(plan[2]["out_eps"]), minimum=1)
+        classes.setdefault((plan[6], plan[7], e_b), []).append(plan)
+    ordered = sorted(classes, key=lambda k: k[0] * k[1] * k[2])
+    groups: List[List] = []
+    carry: List = []
+    for idx, key in enumerate(ordered):
+        wins = carry + classes[key]
+        if idx + 1 < len(ordered):
+            nxt = wins + classes[ordered[idx + 1]]
+            extra = shape_cost(nxt) - shape_cost(wins) \
+                - shape_cost(classes[ordered[idx + 1]])
+            if extra <= merge_budget:
+                carry = wins
+                continue
+        groups.append(wins)
+        carry = []
+    if carry:
+        groups.append(carry)
+
+    # --- budget + dispatch per group -------------------------------------
+    pending = []
+    total_live = 0
+    for group in groups:
+        W_pad = max(p[6] for p in group)
+        M_pad = max(p[7] for p in group)
+        E_pad = max(len(p[2]["out_eps"]) for p in group)
+        n_windows_total = sum(len(p[3]) for p in group)
+        bmax = max(len(p[3]) for p in group)
+        P = len(group)
+        # Ne family rows per service in the fused refit (in/edge/return)
+        Ne = E_pad + E_pad * E_pad + E_pad
+        score_elems = n_windows_total * E_pad * W_pad * M_pad
+        # the fused refit gathers each service's window rows: [P*Ne, Bmax*W]
+        refit_elems = P * Ne * bmax * W_pad
+        if score_elems + refit_elems > FLEET_BUDGET_ELEMS:
+            # padded group block would stress HBM: per-service dispatches
+            _run_fallback([(p[0], p[1]) for p in group], results,
+                          all_spans, all_processes, solver_kwargs, stats)
+            if stats is not None:
+                stats["fleet_fallback_budget"] = 1.0
+            continue
+        if total_live + score_elems + refit_elems > FLEET_BUDGET_ELEMS:
+            # keep every live dispatch under one budget: drain first
+            for pend in pending:
+                _decode_group(solver, pend, results, stats)
+            pending = []
+            total_live = 0
+        total_live += score_elems + refit_elems
+        pending.append(_dispatch_group(
+            group, solver, stats, W_pad, M_pad, E_pad, bmax,
+            epsilon=epsilon, n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
+            sinkhorn_tol=sinkhorn_tol))
+    for pend in pending:
+        _decode_group(solver, pend, results, stats)
+    return results  # type: ignore[return-value]
+
+
+def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
+                    epsilon, n_sinkhorn, n_sweeps, sinkhorn_tol):
+    """Pack one shape-class group and launch its fused EM program
+    (asynchronous — the returned handle is fetched by _decode_group)."""
+    t0 = time.perf_counter()
     arrays_cat: Dict[str, List[np.ndarray]] = {}
     param_rows = {k: [] for k in (
         "pred_mask", "root_mask", "is_last",
@@ -208,7 +286,7 @@ def solve_fleet(
         "in_wt", "in_mu", "in_sd", "ret_wt", "ret_mu", "ret_sd")}
     per_item_pack = []
     param_idx = []
-    for p, (i, item, prep, windows, ranges, skip_caps) in enumerate(plans):
+    for p, (i, item, prep, windows, ranges, skip_caps, _, _) in enumerate(group):
         packed = pack_problem(
             prep["in_spans"], item.out_span_partitions, prep["out_eps"],
             prep["dists"], prep["in_ep"], item.dag,
@@ -236,6 +314,8 @@ def solve_fleet(
     params = {k: np.stack(v, axis=0) for k, v in param_rows.items()}
     pidx = np.asarray(param_idx, dtype=np.int32)
     # each service's contiguous window-row block, for the gathered refit
+    P = len(per_item_pack)
+    n_windows_total = len(param_idx)
     window_rows = np.zeros((P, bmax), dtype=np.int32)
     window_valid = np.zeros((P, bmax), dtype=bool)
     row0 = 0
@@ -262,7 +342,8 @@ def solve_fleet(
             cells * 4.0 * 2 * n_sinkhorn)
         stats["bytes_est_pallas"] = stats.get(
             "bytes_est_pallas", 0.0) + cells * 4.0 * 3
-        stats["fused_em_applied"] = 1.0
+        # counts fused dispatches (the grouping may produce several)
+        stats["fused_em_applied"] = stats.get("fused_em_applied", 0.0) + 1.0
 
     # --- one device program: pass0 + per-service BIC-GMM refit + pass1 ---
     t0 = time.perf_counter()
@@ -281,12 +362,21 @@ def solve_fleet(
     if stats is not None:
         stats["dispatch_s"] = (stats.get("dispatch_s", 0.0)
                                + time.perf_counter() - t0)
+    try:
+        out.copy_to_host_async()
+    except AttributeError:  # plain np.ndarray under some backends
+        pass
+    return per_item_pack, out
+
+
+def _decode_group(solver, pend, results, stats):
+    """Fetch one group's packed output and decode it per service."""
+    per_item_pack, out = pend
     t0 = time.perf_counter()
     o = np.asarray(out)
     if stats is not None:
         stats["wait_s"] = stats.get("wait_s", 0.0) + time.perf_counter() - t0
 
-    # --- split + decode per service --------------------------------------
     t0 = time.perf_counter()
     row = 0
     for i, item, prep, packed, n_w in per_item_pack:
@@ -323,4 +413,3 @@ def solve_fleet(
     if stats is not None:
         stats["decode_s"] = (stats.get("decode_s", 0.0)
                              + time.perf_counter() - t0)
-    return results  # type: ignore[return-value]

@@ -31,6 +31,14 @@ def host_cache_key() -> str:
     committed on another machine. Keying the directory by the selected
     platforms plus a hash of the host's CPU flags makes a foreign cache
     invisible instead of poisonous.
+
+    Known residual noise (upstream, harmless): this jaxlib's XLA:CPU
+    bakes ``+prefer-no-scatter``/``+prefer-no-gather`` tuning attrs into
+    some AOT entries' target-feature lists; the loader compares them
+    against real host CPU features, never matches, logs the same E-line,
+    and falls back to a fresh compile. Verified same-machine
+    (write + immediate reload) — not a poisoned cache, and the large
+    solver programs do reload (warm runs are 4-10x faster).
     """
     bits = [platform.machine()]
     try:
