@@ -192,7 +192,6 @@ def _parse_profile(profile_dir):
         name = plane.name or ""
         if not (name.startswith("/device:") or "TPU" in name.upper()):
             continue
-        source = "device_plane"
         for line in plane.lines:
             lname = (line.name or "").lower()
             # "XLA Modules" spans whole executables (busy time);
@@ -200,17 +199,22 @@ def _parse_profile(profile_dir):
             if "module" in lname:
                 for ev in line.events:
                     busy_ns += ev.duration_ns
+                    source = "device_plane"
             elif "op" in lname:
                 for ev in line.events:
                     ops[ev.name] = ops.get(ev.name, 0.0) + ev.duration_ns
+                    source = "device_plane"
     if source is None:
-        # CPU backend: no device plane — XLA op executions live on the
-        # host plane's tf_XLA* executor thread lines. Busy time is the
-        # ThunkExecutor wrapper events' total (the executor's actual run
-        # spans); per-op durations come from the op events themselves
-        # (NOTE: while.* loop events contain their body ops, so the op
-        # table is a containment profile, not additive self-time — fine
-        # for a ranked stand-in breakdown, and labeled by profile_source)
+        # CPU backend: no populated device plane — XLA op executions live
+        # on the host plane's tf_XLA* executor thread lines. Busy time is
+        # the exact ThunkExecutor::Execute run spans, summed across
+        # worker threads (so it can exceed wall-clock; the caller-side
+        # "... (wait for completion)" idle spans are excluded — they
+        # would double-count time the workers' spans already cover).
+        # Per-op durations are INCLUSIVE (while.* events contain their
+        # body ops) — reported under "dur_s", not "self_s", so consumers
+        # cannot mistake the CPU containment profile for additive
+        # self-time.
         for plane in data.planes:
             if (plane.name or "") != "/host:CPU":
                 continue
@@ -219,23 +223,24 @@ def _parse_profile(profile_dir):
                 if not (lname.startswith("tf_XLA")
                         or "xla-cpu-codegen" in lname):
                     continue
-                source = "host_cpu_xla_threads"
                 for ev in line.events:
-                    # executor wrapper/wait events are busy-time spans,
-                    # not ops ("ThunkExecutor::Execute", "... (wait for
-                    # completion)")
-                    if ev.name.startswith("ThunkExecutor::Execute"):
+                    if ev.name == "ThunkExecutor::Execute":
                         busy_ns += ev.duration_ns
+                        source = "host_cpu_xla_threads"
+                    elif ev.name.startswith("ThunkExecutor::Execute"):
+                        continue  # caller-side wait span
                     else:
                         ops[ev.name] = ops.get(ev.name, 0.0) + ev.duration_ns
+                        source = "host_cpu_xla_threads"
     if source is None:
         return None
+    dur_key = "self_s" if source == "device_plane" else "dur_s"
     top = sorted(ops.items(), key=lambda kv: -kv[1])[:12]
     return {
         "device_busy_s": busy_ns / 1e9,
         "profile_source": source,
         "top_ops": [
-            {"op": k[:120], "self_s": round(v / 1e9, 4)} for k, v in top
+            {"op": k[:120], dur_key: round(v / 1e9, 4)} for k, v in top
         ],
     }
 
@@ -399,17 +404,22 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         shutil.rmtree(profile_dir, ignore_errors=True)
 
     busy_measured = (profile or {}).get("device_busy_s") or 0.0
+    profile_source = (profile or {}).get("profile_source")
     report["device_busy_s_measured"] = (busy_measured if busy_measured > 0
                                         else None)
-    report["profile_source"] = (profile or {}).get("profile_source")
+    report["profile_source"] = profile_source
     report["profile_top_ops"] = (profile or {}).get("top_ops")
-    # "measured" metrics come ONLY from a trace with nonzero device busy
-    # time; otherwise they stay null rather than silently falling back to
-    # wall-clock under a measured label
+    # "measured" MFU comes ONLY from a real device plane: the CPU
+    # fallback's busy time is summed across XLA worker threads (can
+    # exceed wall-clock), which would silently deflate a "measured"
+    # utilization — on that path the metric stays null and the estimate
+    # (wall-clock denominator) is the number to read
     report["mfu_measured_pct"] = (
         round(100.0 * flops / busy_measured / peak_flops, 4)
-        if busy_measured > 0 else None)
-    device_s = busy_measured if busy_measured > 0 else device_s_wall
+        if busy_measured > 0 and profile_source == "device_plane" else None)
+    device_s = (busy_measured
+                if busy_measured > 0 and profile_source == "device_plane"
+                else device_s_wall)
 
     # --- Pallas kernel on-device proof (non-interpret) -------------------
     pallas_ok = None

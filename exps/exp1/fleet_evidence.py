@@ -1,0 +1,24 @@
+"""exp1-config wall-clock: production fleet path on vs off (evidence for
+wiring the fleet into the executor; identical outputs asserted)."""
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+from traceweaver_tpu.runtime.jax_cache import enable_persistent_compilation_cache
+enable_persistent_compilation_cache()
+from traceweaver_tpu.ingest import load_corpus
+from traceweaver_tpu.runtime.executor import ExecutorConfig, run_experiment
+
+store = load_corpus("/root/reference/data/hotel_reservation/hotel_load150",
+                    fix=2, max_traces=1000, cache=True)
+out = {}
+for fleet in (False, True, False, True):  # warm each leg, measure its 2nd pass
+    cfg = ExecutorConfig(data_path="", results_directory="", fix=2,
+                         cache_rate=0.0, predictor_indices=[3, 4, 7, 10],
+                         fleet=fleet)
+    t0 = time.perf_counter()
+    res = run_experiment(cfg, store=store)
+    out[f"fleet={fleet}"] = dict(
+        wall_s=round(time.perf_counter() - t0, 2),
+        acc={k: round(v, 3) for k, v in res.accuracy_overall.items()})
+print(json.dumps(out, indent=1))
