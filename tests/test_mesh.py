@@ -89,3 +89,38 @@ def test_flagship_identical_on_1_vs_8_devices(mesh8, hotel_store):
                            store.all_processes).FindAssignments(*args)
         assert sharded[0] == single[0], svc  # assignments
         assert sharded[2] == single[2], svc  # not_best_count
+
+
+def test_fleet_identical_on_1_vs_8_devices(mesh8, hotel_store):
+    """The PRODUCTION fleet path under a mesh: every dispatch group's
+    window-batch axis sharded over 8 devices must reproduce the
+    single-device fleet assignments service-for-service (padded rows are
+    invalid everywhere; the refit's cross-shard window gather lowers to
+    collectives under XLA SPMD)."""
+    from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+    from traceweaver_tpu.ingest import (
+        build_service_problem, infer_invocation_dag,
+    )
+    from traceweaver_tpu.metrics import get_ground_truth
+
+    items = []
+    for svc in hotel_store.out_spans_by_process:
+        prob = build_service_problem(hotel_store, svc)
+        if prob.skipped:
+            continue
+        ta = get_ground_truth(prob.in_span_partitions,
+                              prob.out_span_partitions)
+        dag = infer_invocation_dag(prob.in_span_partitions,
+                                   prob.out_span_partitions, ta,
+                                   hotel_store)
+        items.append(FleetItem(svc, prob.in_span_partitions,
+                               prob.out_span_partitions, ta, dag,
+                               store=hotel_store))
+    assert len(items) >= 2
+    single = solve_fleet(items)
+    stats = {}
+    sharded = solve_fleet(items, mesh=mesh8, stats=stats)
+    assert stats.get("fleet_dispatches", 0) >= 1
+    for it, s, m in zip(items, single, sharded):
+        assert m[0] == s[0], f"mesh fleet diverged on {it.svc}"
+        assert m[2] == s[2] and m[4] == s[4] and m[5] == s[5]

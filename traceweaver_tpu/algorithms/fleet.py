@@ -136,17 +136,31 @@ def solve_fleet(
     n_sinkhorn: int = 40,
     n_sweeps: int = 5,
     sinkhorn_tol: float = 1e-3,
+    mesh=None,
     stats: Optional[Dict[str, float]] = None,
 ) -> List[Tuple]:
     """Solve every item, fusing eligible ones into one device dispatch.
+
+    ``mesh`` (a ``jax.sharding.Mesh``) shards each dispatch group's
+    window-batch axis across the mesh devices under XLA SPMD — the
+    multi-chip form of the production path (the same window-axis
+    sharding :class:`WeaverTPU` uses per service, applied to the fused
+    program; the refit's cross-shard window gather lowers to XLA
+    collectives automatically).
 
     Returns one FindAssignments-style 6-tuple per item, in order:
     ``(all_assignments, all_topk, not_best_count, n_spans,
     per_span_candidates, cnt_unassigned)``.
     """
+    # the fused path shards any mesh size (rows pad to a multiple); the
+    # per-service fallback solver requires a power-of-two mesh, so a
+    # non-pow2 mesh degrades fallback items to single-device rather than
+    # crashing the whole mixed solve on WeaverTPU's assert
+    n_mesh = int(mesh.devices.size) if mesh is not None else 1
+    fallback_mesh = mesh if n_mesh & (n_mesh - 1) == 0 else None
     solver_kwargs = dict(max_window=max_window, epsilon=epsilon,
                          n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
-                         sinkhorn_tol=sinkhorn_tol)
+                         sinkhorn_tol=sinkhorn_tol, mesh=fallback_mesh)
     solver = WeaverTPU(all_spans, all_processes, **solver_kwargs)
     results: List[Optional[Tuple]] = [None] * len(items)
 
@@ -268,16 +282,20 @@ def solve_fleet(
         pending.append(_dispatch_group(
             group, solver, stats, W_pad, M_pad, E_pad, bmax,
             epsilon=epsilon, n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
-            sinkhorn_tol=sinkhorn_tol))
+            sinkhorn_tol=sinkhorn_tol, mesh=mesh))
     for pend in pending:
         _decode_group(solver, pend, results, stats)
     return results  # type: ignore[return-value]
 
 
 def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
-                    epsilon, n_sinkhorn, n_sweeps, sinkhorn_tol):
+                    epsilon, n_sinkhorn, n_sweeps, sinkhorn_tol,
+                    mesh=None):
     """Pack one shape-class group and launch its fused EM program
-    (asynchronous — the returned handle is fetched by _decode_group)."""
+    (asynchronous — the returned handle is fetched by _decode_group).
+    With ``mesh``, the window-batch axis is padded to the mesh size and
+    sharded (XLA SPMD); padded rows are invalid everywhere and decoded
+    by nobody."""
     t0 = time.perf_counter()
     arrays_cat: Dict[str, List[np.ndarray]] = {}
     param_rows = {k: [] for k in (
@@ -346,6 +364,32 @@ def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
         stats["fused_em_applied"] = stats.get("fused_em_applied", 0.0) + 1.0
 
     # --- one device program: pass0 + per-service BIC-GMM refit + pass1 ---
+    if mesh is not None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from traceweaver_tpu.parallel.mesh import _pad_batch, put_sharded
+
+        # padded rows are all-invalid windows of service 0: they assign
+        # nothing, contribute no refit samples (window_rows/window_valid
+        # index only real rows), and the per-item decode never reads them
+        n_dev = int(mesh.devices.size)
+        batch, true_b = _pad_batch(batch, n_dev)
+        pidx = np.concatenate(
+            [pidx, np.zeros(batch["in_start"].shape[0] - true_b,
+                            dtype=pidx.dtype)])
+        # put_sharded: window-axis keys sharded, everything else
+        # (param tables, window_rows/valid) replicated
+        placed = put_sharded(
+            {**batch, **params,
+             "window_rows": window_rows, "window_valid": window_valid},
+            mesh)
+        batch = {k: placed[k] for k in batch}
+        params = {k: placed[k] for k in params}
+        window_rows = placed["window_rows"]
+        window_valid = placed["window_valid"]
+        pidx = jax.device_put(
+            pidx, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
     t0 = time.perf_counter()
     out = solve_em_fleet(
         batch["in_start"], batch["in_end"], batch["in_valid"],

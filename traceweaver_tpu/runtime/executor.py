@@ -225,7 +225,7 @@ def _solve_fleet_method(cfg: ExecutorConfig, store: TraceStore, method: str,
     outs = solve_fleet(
         items, max_window=predictor.max_window, epsilon=predictor.epsilon,
         n_sinkhorn=predictor.n_sinkhorn, n_sweeps=predictor.n_sweeps,
-        sinkhorn_tol=predictor.sinkhorn_tol,
+        sinkhorn_tol=predictor.sinkhorn_tol, mesh=predictor.mesh,
     )
     elapsed = time.time() - start
     share = elapsed / max(1, len(preps))
