@@ -124,3 +124,23 @@ def test_run_experiment_fleet_identical_to_per_service(hotel_store):
     assert a.accuracy_overall == b.accuracy_overall
     assert a.confidence_scores == b.confidence_scores
     assert a.candidates_per_process == b.candidates_per_process
+
+
+def test_run_experiment_mesh_devices_identical(hotel_store):
+    """TW_MESH_DEVICES / ExecutorConfig.mesh_devices: the executor's
+    flagship results over an 8-device mesh must be identical to the
+    single-device run (the whole multi-chip path — fleet dispatch groups
+    sharded under XLA SPMD — behind the reference-compatible surface)."""
+    from traceweaver_tpu.runtime.executor import ExecutorConfig, run_experiment
+
+    def run(mesh_devices):
+        cfg = ExecutorConfig(
+            data_path="", results_directory="", fix=2, cache_rate=0.0,
+            test_name="hotel", predictor_indices=[10],
+            mesh_devices=mesh_devices,
+        )
+        return run_experiment(cfg, store=hotel_store)
+
+    a, b = run(0), run(8)
+    assert a.accuracy_per_process == b.accuracy_per_process
+    assert a.accuracy_overall == b.accuracy_overall

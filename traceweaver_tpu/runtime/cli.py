@@ -137,6 +137,10 @@ def main(argv=None) -> int:
         predictor_indices=indices,
         max_traces=args.max_traces,
         service_to_replica=replica_table,
+        # multi-chip: TW_MESH_DEVICES=N shards solver window batches over
+        # an N-device 1-D mesh (XLA SPMD; see parallel/mesh.py). Env, not
+        # a flag, to keep the reference CLI surface byte-compatible.
+        mesh_devices=int(os.environ.get("TW_MESH_DEVICES", "0") or 0),
     )
     run_experiment(cfg)  # prints per-method accuracy as it goes
     return 0

@@ -77,6 +77,10 @@ class ExecutorConfig:
     # dispatch (output-identical to the per-service path; supersedes the
     # reference's ThreadPool-over-services, executor.py:1015-1026)
     fleet: bool = True
+    # devices for a 1-D data mesh; solver predictors shard their window
+    # batches over it (0 = single device). The CLI maps TW_MESH_DEVICES
+    # onto this; tests/dryrun use the 8-virtual-CPU-device stand-in
+    mesh_devices: int = 0
     predictor_indices: List[int] = field(default_factory=list)
     max_traces: int = 1000
     # replica table for compress-factor scaling; absent in the reference
@@ -276,6 +280,14 @@ def run_experiment(cfg: ExecutorConfig,
                             clear_cache=cfg.clear_cache)
 
     predictors = make_predictors(store.all_spans, store.all_processes)
+    if cfg.mesh_devices:
+        from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU as _WT
+        from traceweaver_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(cfg.mesh_devices)
+        for _, predictor in predictors:
+            if isinstance(predictor, _WT):
+                predictor.mesh = mesh
     if cfg.predictor_indices:
         bad = [i for i in cfg.predictor_indices
                if not 0 <= i < len(predictors)]
