@@ -63,6 +63,22 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_mesh_devices(raw: str) -> int:
+    """TW_MESH_DEVICES must be 0 (single device) or a positive power of
+    two (the window-batch padding divides evenly across mesh devices);
+    anything else is a configuration error worth failing loudly on,
+    before any data loads."""
+    try:
+        n = int(raw or "0")
+    except ValueError:
+        raise SystemExit(
+            f"TW_MESH_DEVICES={raw!r} is not an integer") from None
+    if n < 0 or (n > 0 and n & (n - 1) != 0):
+        raise SystemExit(
+            f"TW_MESH_DEVICES={n} must be 0 or a positive power of two")
+    return n
+
+
 def main(argv=None) -> int:
     # Backend selection. The sandbox's sitecustomize force-selects the
     # remote "axon" TPU backend whose init can stall for minutes; the env
@@ -140,7 +156,8 @@ def main(argv=None) -> int:
         # multi-chip: TW_MESH_DEVICES=N shards solver window batches over
         # an N-device 1-D mesh (XLA SPMD; see parallel/mesh.py). Env, not
         # a flag, to keep the reference CLI surface byte-compatible.
-        mesh_devices=int(os.environ.get("TW_MESH_DEVICES", "0") or 0),
+        mesh_devices=_parse_mesh_devices(
+            os.environ.get("TW_MESH_DEVICES", "0")),
     )
     run_experiment(cfg)  # prints per-method accuracy as it goes
     return 0

@@ -279,14 +279,15 @@ def run_experiment(cfg: ExecutorConfig,
         store = load_corpus(cfg.data_path, cfg.fix, max_traces=cfg.max_traces,
                             clear_cache=cfg.clear_cache)
 
+    from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
+
     predictors = make_predictors(store.all_spans, store.all_processes)
     if cfg.mesh_devices:
-        from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU as _WT
         from traceweaver_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(cfg.mesh_devices)
         for _, predictor in predictors:
-            if isinstance(predictor, _WT):
+            if isinstance(predictor, WeaverTPU):
                 predictor.mesh = mesh
     if cfg.predictor_indices:
         bad = [i for i in cfg.predictor_indices
@@ -323,8 +324,6 @@ def run_experiment(cfg: ExecutorConfig,
     traces_overall: Dict[str, list] = {}
     confidence_scores: Dict[str, list] = {}
     candidates_per_process: Dict[str, dict] = {}
-
-    from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
 
     for result_key, method, predictor in keyed_predictors:
         random.seed(10)
