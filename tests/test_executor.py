@@ -126,6 +126,26 @@ def test_run_experiment_fleet_identical_to_per_service(hotel_store):
     assert a.candidates_per_process == b.candidates_per_process
 
 
+def test_run_experiment_fleet_identical_with_cache_rate(hotel_store):
+    """The exp2 workload (cache_rate > 0 -> frontend skip budget > 0) must
+    run THROUGH the fleet path — single-pass dynamism dispatch groups, no
+    per-service fallback — and stay output-identical to the per-service
+    route (VERDICT r4 #4)."""
+    from traceweaver_tpu.runtime.executor import ExecutorConfig, run_experiment
+
+    def run(fleet):
+        cfg = ExecutorConfig(
+            data_path="", results_directory="", fix=2, cache_rate=0.3,
+            test_name="hotel", predictor_indices=[10], fleet=fleet,
+        )
+        return run_experiment(cfg, store=hotel_store)
+
+    a, b = run(True), run(False)
+    assert a.accuracy_per_process == b.accuracy_per_process
+    assert a.accuracy_overall == b.accuracy_overall
+    assert a.confidence_scores == b.confidence_scores
+
+
 def test_run_experiment_mesh_devices_identical(hotel_store):
     """TW_MESH_DEVICES / ExecutorConfig.mesh_devices: the executor's
     flagship results over an 8-device mesh must be identical to the

@@ -137,6 +137,97 @@ def test_fleet_budget_bounds_refit_matrix_at_scale(hotel_problems,
         assert o[0] == by_svc[it.svc][0]
 
 
+def _cache_hit_copy(prob, ta, rate):
+    """Deep-copied partitions with cache hits injected (skip budget > 0)."""
+    import copy
+
+    from traceweaver_tpu.synth import create_cache_hits
+
+    inp = copy.deepcopy(prob.in_span_partitions)
+    outp = copy.deepcopy(prob.out_span_partitions)
+    ta2 = create_cache_hits(copy.deepcopy(ta), inp, outp, cache_rate=rate)
+    return inp, outp, ta2
+
+
+def test_fleet_carries_dynamism_single_pass(hotel_problems):
+    """Cache-hit services (skip budget > 0 — the exp2 workload) must ride
+    the fused dispatch as a single-pass group, NOT fall back per-service,
+    and reproduce the per-service dynamism path exactly."""
+    import copy
+
+    items, singles = [], []
+    n_dyn = 0
+    for store, svc, prob, ta, dag in hotel_problems:
+        if svc == "frontend":
+            inp, outp, ta2 = _cache_hit_copy(prob, ta, 0.3)
+            n_dyn += 1
+        else:
+            inp, outp, ta2 = (prob.in_span_partitions,
+                              prob.out_span_partitions, ta)
+        algo = WeaverTPU(store.all_spans, store.all_processes)
+        singles.append(algo.FindAssignments(
+            "MaxScoreBatchSubsetWithSkips", svc, copy.deepcopy(inp),
+            copy.deepcopy(outp), False, [], copy.deepcopy(ta2), dag))
+        items.append(FleetItem(svc, inp, outp, ta2, dag, store=store))
+    assert n_dyn == 1
+
+    stats = {}
+    fleet = solve_fleet(items, stats=stats)
+    # the cache-hit service formed a single-pass dynamism dispatch and
+    # every service (incl. it) rode a fused program — zero fallbacks
+    assert stats.get("fleet_dynamism_dispatches", 0) >= 1
+    assert stats.get("fleet_services") == len(items)
+    for (store, svc, *_), f, s in zip(hotel_problems, fleet, singles):
+        assert f[0] == s[0], f"dynamism fleet diverges on {svc}"
+        assert f[2] == s[2] and f[3] == s[3]
+        assert f[4] == s[4] and f[5] == s[5]
+
+
+def test_fleet_true_skips_oracle_rides_fleet(hotel_problems):
+    """The true-skips oracle ships forced rows as per-window force-skip
+    tensors inside the fused dispatch (weaver_tpu.py force_skip input) and
+    matches the per-service oracle exactly."""
+    import copy
+
+    items, singles = [], []
+    for store, svc, prob, ta, dag in hotel_problems:
+        if svc == "frontend":
+            inp, outp, ta2 = _cache_hit_copy(prob, ta, 0.3)
+        else:
+            inp, outp, ta2 = (prob.in_span_partitions,
+                              prob.out_span_partitions, ta)
+        algo = WeaverTPU(store.all_spans, store.all_processes)
+        singles.append(algo.FindAssignments(
+            "MaxScoreBatchSubsetWithTrueSkips", svc, copy.deepcopy(inp),
+            copy.deepcopy(outp), False, [], copy.deepcopy(ta2), dag,
+            true_skips=True))
+        items.append(FleetItem(svc, inp, outp, ta2, dag,
+                               method="MaxScoreBatchSubsetWithTrueSkips",
+                               store=store))
+
+    stats = {}
+    fleet = solve_fleet(items, stats=stats)
+    assert stats.get("fleet_services") == len(items)
+    for (store, svc, *_), f, s in zip(hotel_problems, fleet, singles):
+        assert f[0] == s[0], f"true-skips fleet diverges on {svc}"
+
+
+def test_fleet_item_cells_attribution(hotel_problems):
+    """solve_fleet reports per-item padded-cell costs (the wall-clock
+    attribution model shared by the executor and the parity harness):
+    every item gets a positive cost and bigger problems cost more."""
+    items = [FleetItem(svc, prob.in_span_partitions,
+                       prob.out_span_partitions, ta, dag, store=store)
+             for store, svc, prob, ta, dag in hotel_problems]
+    cells = [0.0] * len(items)
+    solve_fleet(items, item_cells=cells)
+    assert all(c > 0 for c in cells)
+    # frontend (more endpoints, wider windows) must out-cost search
+    by_svc = {it.svc: c for it, c in zip(items, cells)}
+    if "frontend" in by_svc and "search" in by_svc:
+        assert by_svc["frontend"] > by_svc["search"]
+
+
 def test_fleet_services_stat_accumulates(hotel_problems):
     items = [FleetItem(svc, prob.in_span_partitions,
                        prob.out_span_partitions, ta, dag, store=store)

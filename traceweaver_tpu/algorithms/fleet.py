@@ -20,10 +20,15 @@ backend-aware — padding is nearly-free VPU headroom on TPU, real
 core-seconds on the CPU stand-in). Dispatch count drops from O(services)
 to O(shape classes), typically 1-2.
 
-Services whose method needs the host in the loop (KDE score mode,
-single-iteration parallel mode, the true-skips/true-dist oracles) fall
-back to the per-service :class:`WeaverTPU` path; the fleet handles the
-production flagship configuration.
+Dynamism (cache-hit services with skip budget > 0, reference
+exp2/run_experiment.sh:128-158) rides the fleet too: those services form
+single-pass dispatch groups with bootstrap distributions and water-filled
+per-window skip-cap tensors, exactly the per-service dynamism
+configuration fused. The true-skips oracle ships its forced rows as
+per-window force-skip tensors. Only methods that need the host in the
+loop (KDE score mode, single-iteration parallel mode, the true-dist
+oracle, missing DAGs) fall back to the per-service :class:`WeaverTPU`
+path.
 """
 
 from __future__ import annotations
@@ -44,8 +49,9 @@ from traceweaver_tpu.algorithms.weaver_tpu import (
     pack_problem,
     perfect_cut_windows,
     solve_em_fleet,
+    solve_windows_fleet,
 )
-from traceweaver_tpu.spans import NA
+from traceweaver_tpu.spans import NA, SKIP
 
 # fleet single-dispatch budget: live f32 elements of the [B, E, W, M]
 # score block (the dominant allocation). Past this the padded single
@@ -72,8 +78,15 @@ class FleetItem:
 
 def _prepare(item: FleetItem, solver: WeaverTPU):
     """Host preamble of FindAssignments for one item (sort, topo order,
-    skip budget, bootstrap distributions). Returns None when the item
-    needs a code path the fleet does not cover."""
+    skip budget, distributions). Returns None when the item needs a code
+    path the fleet does not cover (no DAG, KDE scoring, true-dist oracle).
+
+    Dynamism (skip budget > 0 — the cache-hit workloads, reference
+    exp2/run_experiment.sh:128-158) stays IN the fleet: those services get
+    the per-service path's bootstrap distributions and a single-pass plan
+    (``n_passes=1``, no EM refit — identical to ``iterations = 1`` in
+    :meth:`WeaverTPU.FindAssignments`), with their water-filled skip caps
+    carried as per-window tensors in the fused dispatch."""
     in_ep, in_spans = next(iter(item.in_span_partitions.items()))
     in_spans = sorted(in_spans, key=lambda s: (s.start_mus, s.end_mus))
     out_eps = solver._topo_out_eps(item.out_span_partitions, item.dag)
@@ -82,17 +95,65 @@ def _prepare(item: FleetItem, solver: WeaverTPU):
         ep: n_in - len(item.out_span_partitions[ep]) for ep in out_eps
     }
     dynamism = any(b > 0 for b in skip_budget.values())
-    # fleet covers the two-iteration fused-EM flagship configuration only
-    if dynamism or item.dag is None or solver.score_mode != "mixture":
+    if item.dag is None or solver.score_mode != "mixture":
         return None
-    if item.method != "MaxScoreBatchSubsetWithSkips":
+    if item.method not in ("MaxScoreBatchSubsetWithSkips",
+                           "MaxScoreBatchSubsetWithTrueSkips"):
         return None
-    dists = timing.estimate_edge_params(
-        item.in_span_partitions, item.out_span_partitions, item.dag,
-        0, n_in,
-    )
+    force_skip_ids = None
+    if item.method == "MaxScoreBatchSubsetWithTrueSkips":
+        # true-skips oracle: forced rows ride the dispatch as per-window
+        # force-skip tensors (the device solver input, weaver_tpu.py:94)
+        force_skip_ids = {
+            ep: {
+                in_id for in_id, out_id in item.true_assignments[ep].items()
+                if tuple(out_id) == SKIP
+            }
+            for ep in out_eps
+        }
+    if dynamism:
+        dists = timing.bootstrap_distributions(
+            item.in_span_partitions, item.out_span_partitions, out_eps,
+            score_mode=solver.score_mode,
+        )
+        n_passes = 1
+    else:
+        dists = timing.estimate_edge_params(
+            item.in_span_partitions, item.out_span_partitions, item.dag,
+            0, n_in,
+        )
+        n_passes = 2
     return dict(in_ep=in_ep, in_spans=in_spans, out_eps=out_eps,
-                skip_budget=skip_budget, dists=dists, n_in=n_in)
+                skip_budget=skip_budget, dists=dists, n_in=n_in,
+                n_passes=n_passes, force_skip_ids=force_skip_ids)
+
+
+def _raw_cells(item: FleetItem, max_window: int) -> float:
+    """Padded-compute-cell count for an item solved OUTSIDE a fused
+    dispatch (host-in-the-loop fallbacks), from its raw partitions — the
+    same ``n_windows * W * M * E * n_passes`` model the fused plan
+    records, so mixed fused/fallback workloads attribute wall-clock on
+    one scale. The pass count mirrors ``WeaverTPU.FindAssignments``:
+    one pass under dynamism or the true-dist oracle, two otherwise."""
+    in_spans = sorted(next(iter(item.in_span_partitions.values())),
+                      key=lambda s: (s.start_mus, s.end_mus))
+    out_eps = list(item.out_span_partitions)
+    windows = perfect_cut_windows(in_spans, max_window)
+    out_starts_np = {
+        ep: np.array(sorted(float(s.start_mus)
+                            for s in item.out_span_partitions[ep]))
+        for ep in out_eps
+    }
+    ranges = candidate_ranges(in_spans, windows, out_eps, out_starts_np)
+    w_b = _bucket(max(hi - lo for lo, hi in windows))
+    m_b = _bucket(int((ranges[:, :, 1] - ranges[:, :, 0]).max(initial=1)))
+    n_in = len(in_spans)
+    dynamism = any(n_in - len(item.out_span_partitions[ep]) > 0
+                   for ep in out_eps)
+    n_passes = 1 if (dynamism
+                     or item.method == "MaxScoreBatchSubsetWithTrueDist") \
+        else 2
+    return float(len(windows) * w_b * m_b * max(1, len(out_eps)) * n_passes)
 
 
 def _run_fallback(entries, results, all_spans, all_processes,
@@ -112,10 +173,17 @@ def _run_fallback(entries, results, all_spans, all_processes,
             item.store.all_spans if item.store else all_spans,
             item.store.all_processes if item.store else all_processes,
             **solver_kwargs)
+        # oracle methods carry their flag through the fallback too
+        # (the same method-name -> kwarg mapping runtime/executor.py does)
+        kwargs = {}
+        if item.method == "MaxScoreBatchSubsetWithTrueSkips":
+            kwargs["true_skips"] = True
+        elif item.method == "MaxScoreBatchSubsetWithTrueDist":
+            kwargs["true_dist"] = True
         out = algo.FindAssignments(
             item.method, item.svc, item.in_span_partitions,
             item.out_span_partitions, False, [], item.true_assignments,
-            item.dag,
+            item.dag, **kwargs,
         )
         return i, out, algo.stats
 
@@ -138,6 +206,7 @@ def solve_fleet(
     sinkhorn_tol: float = 1e-3,
     mesh=None,
     stats: Optional[Dict[str, float]] = None,
+    item_cells: Optional[List[float]] = None,
 ) -> List[Tuple]:
     """Solve every item, fusing eligible ones into one device dispatch.
 
@@ -147,6 +216,12 @@ def solve_fleet(
     sharding :class:`WeaverTPU` uses per service, applied to the fused
     program; the refit's cross-shard window gather lowers to XLA
     collectives automatically).
+
+    ``item_cells`` (when given, a list the caller sized to ``len(items)``)
+    receives each item's padded-compute-cell count at its own shape class
+    (``n_windows * W * M * E``) — the quantity the device spends time on,
+    used by callers to attribute one dispatch's wall-clock to services
+    (runtime executor and the parity harness share this model).
 
     Returns one FindAssignments-style 6-tuple per item, in order:
     ``(all_assignments, all_topk, not_best_count, n_spans,
@@ -171,6 +246,8 @@ def solve_fleet(
         if prep is None:
             # host-in-the-loop configuration: per-service path
             fallback_entries.append((i, item))
+            if item_cells is not None:
+                item_cells[i] = _raw_cells(item, max_window)
         else:
             prepared.append((i, item, prep))
     if fallback_entries:
@@ -196,6 +273,9 @@ def solve_fleet(
             [len(item.out_span_partitions[ep]) for ep in out_eps])
         w_b = _bucket(max(hi - lo for lo, hi in windows))
         m_b = _bucket(int((ranges[:, :, 1] - ranges[:, :, 0]).max(initial=1)))
+        if item_cells is not None:
+            item_cells[i] = (len(windows) * w_b * m_b
+                             * max(1, len(out_eps)) * prep["n_passes"])
         plans.append((i, item, prep, windows, ranges, skip_caps, w_b, m_b))
     if stats is not None:
         stats["pack_s"] = stats.get("pack_s", 0.0) + time.perf_counter() - t0
@@ -228,17 +308,21 @@ def solve_fleet(
     # with an E=1 service would pay 12x endpoint padding on the score
     # block and E^2 growth on the refit rows — exactly the padding class
     # the merge budget exists to arbitrate, so E outliers must start in
-    # their own class and only merge if shape_cost approves
-    classes: Dict[Tuple[int, int, int], List] = {}
+    # their own class and only merge if shape_cost approves. The pass
+    # count splits classes too: single-pass (dynamism) and two-pass
+    # (fused EM) services run different device programs and cannot share
+    # a dispatch.
+    classes: Dict[Tuple[int, int, int, int], List] = {}
     for plan in plans:
         e_b = _bucket(len(plan[2]["out_eps"]), minimum=1)
-        classes.setdefault((plan[6], plan[7], e_b), []).append(plan)
-    ordered = sorted(classes, key=lambda k: k[0] * k[1] * k[2])
+        classes.setdefault(
+            (plan[2]["n_passes"], plan[6], plan[7], e_b), []).append(plan)
+    ordered = sorted(classes, key=lambda k: (k[0], k[1] * k[2] * k[3]))
     groups: List[List] = []
     carry: List = []
     for idx, key in enumerate(ordered):
         wins = carry + classes[key]
-        if idx + 1 < len(ordered):
+        if idx + 1 < len(ordered) and ordered[idx + 1][0] == key[0]:
             nxt = wins + classes[ordered[idx + 1]]
             extra = shape_cost(nxt) - shape_cost(wins) \
                 - shape_cost(classes[ordered[idx + 1]])
@@ -257,6 +341,7 @@ def solve_fleet(
         W_pad = max(p[6] for p in group)
         M_pad = max(p[7] for p in group)
         E_pad = max(len(p[2]["out_eps"]) for p in group)
+        n_passes = group[0][2]["n_passes"]  # uniform within a class
         n_windows_total = sum(len(p[3]) for p in group)
         bmax = max(len(p[3]) for p in group)
         P = len(group)
@@ -264,7 +349,8 @@ def solve_fleet(
         Ne = E_pad + E_pad * E_pad + E_pad
         score_elems = n_windows_total * E_pad * W_pad * M_pad
         # the fused refit gathers each service's window rows: [P*Ne, Bmax*W]
-        refit_elems = P * Ne * bmax * W_pad
+        # (single-pass dynamism groups never refit)
+        refit_elems = P * Ne * bmax * W_pad if n_passes == 2 else 0
         if score_elems + refit_elems > FLEET_BUDGET_ELEMS:
             # padded group block would stress HBM: per-service dispatches
             _run_fallback([(p[0], p[1]) for p in group], results,
@@ -282,7 +368,7 @@ def solve_fleet(
         pending.append(_dispatch_group(
             group, solver, stats, W_pad, M_pad, E_pad, bmax,
             epsilon=epsilon, n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
-            sinkhorn_tol=sinkhorn_tol, mesh=mesh))
+            sinkhorn_tol=sinkhorn_tol, mesh=mesh, n_passes=n_passes))
     for pend in pending:
         _decode_group(solver, pend, results, stats)
     return results  # type: ignore[return-value]
@@ -290,12 +376,13 @@ def solve_fleet(
 
 def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
                     epsilon, n_sinkhorn, n_sweeps, sinkhorn_tol,
-                    mesh=None):
-    """Pack one shape-class group and launch its fused EM program
-    (asynchronous — the returned handle is fetched by _decode_group).
-    With ``mesh``, the window-batch axis is padded to the mesh size and
-    sharded (XLA SPMD); padded rows are invalid everywhere and decoded
-    by nobody."""
+                    mesh=None, n_passes=2):
+    """Pack one shape-class group and launch its fused program
+    (asynchronous — the returned handle is fetched by _decode_group):
+    the two-pass EM program for static groups, the single-pass solve for
+    dynamism groups (``n_passes=1``). With ``mesh``, the window-batch
+    axis is padded to the mesh size and sharded (XLA SPMD); padded rows
+    are invalid everywhere and decoded by nobody."""
     t0 = time.perf_counter()
     arrays_cat: Dict[str, List[np.ndarray]] = {}
     param_rows = {k: [] for k in (
@@ -308,6 +395,7 @@ def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
         packed = pack_problem(
             prep["in_spans"], item.out_span_partitions, prep["out_eps"],
             prep["dists"], prep["in_ep"], item.dag,
+            force_skip_ids=prep["force_skip_ids"],
             parallel=False, windows=windows,
             pad_w=W_pad, pad_m=M_pad, pad_e=E_pad,
             ranges=ranges, skip_caps=skip_caps,
@@ -350,7 +438,7 @@ def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
         # exit early on convergence), same model as WeaverTPU._solve_once
         K = params["in_wt"].shape[2]
         cells = (n_windows_total * E_pad * W_pad * M_pad
-                 * n_sweeps * 2)  # 2 fused EM passes
+                 * n_sweeps * n_passes)
         stats["flops_est"] = stats.get("flops_est", 0.0) + cells * (
             8.0 * K * (E_pad + 2)
             + 6.0 * 2 * n_sinkhorn
@@ -360,8 +448,12 @@ def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
             cells * 4.0 * 2 * n_sinkhorn)
         stats["bytes_est_pallas"] = stats.get(
             "bytes_est_pallas", 0.0) + cells * 4.0 * 3
-        # counts fused dispatches (the grouping may produce several)
-        stats["fused_em_applied"] = stats.get("fused_em_applied", 0.0) + 1.0
+        if n_passes == 2:
+            # counts fused EM dispatches (the grouping may produce several)
+            stats["fused_em_applied"] = stats.get("fused_em_applied", 0.0) + 1.0
+        else:
+            stats["fleet_dynamism_dispatches"] = stats.get(
+                "fleet_dynamism_dispatches", 0.0) + 1.0
 
     # --- one device program: pass0 + per-service BIC-GMM refit + pass1 ---
     if mesh is not None:
@@ -391,18 +483,29 @@ def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
         pidx = jax.device_put(
             pidx, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
     t0 = time.perf_counter()
-    out = solve_em_fleet(
+    common = (
         batch["in_start"], batch["in_end"], batch["in_valid"],
         batch["out_start"], batch["out_end"], batch["out_valid"],
         batch["skip_cap"], batch["force_skip"], pidx,
-        window_rows, window_valid,
+    )
+    tables = (
         params["pred_mask"], params["root_mask"], params["is_last"],
         params["edge_wt"], params["edge_mu"], params["edge_sd"],
         params["in_wt"], params["in_mu"], params["in_sd"],
         params["ret_wt"], params["ret_mu"], params["ret_sd"],
-        epsilon=epsilon, n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
-        sinkhorn_tol=sinkhorn_tol,
     )
+    if n_passes == 2:
+        out = solve_em_fleet(
+            *common, window_rows, window_valid, *tables,
+            epsilon=epsilon, n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
+            sinkhorn_tol=sinkhorn_tol,
+        )
+    else:
+        out = solve_windows_fleet(
+            *common, *tables,
+            epsilon=epsilon, n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
+            sinkhorn_tol=sinkhorn_tol,
+        )
     if stats is not None:
         stats["dispatch_s"] = (stats.get("dispatch_s", 0.0)
                                + time.perf_counter() - t0)

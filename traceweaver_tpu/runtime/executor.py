@@ -226,15 +226,21 @@ def _solve_fleet_method(cfg: ExecutorConfig, store: TraceStore, method: str,
         for process, prep in preps
     ]
     start = time.time()
+    cells: List[float] = [1.0] * len(items)
     outs = solve_fleet(
         items, max_window=predictor.max_window, epsilon=predictor.epsilon,
         n_sinkhorn=predictor.n_sinkhorn, n_sweeps=predictor.n_sweeps,
         sinkhorn_tol=predictor.sinkhorn_tol, mesh=predictor.mesh,
+        item_cells=cells,
     )
     elapsed = time.time() - start
-    share = elapsed / max(1, len(preps))
-    return [_finish_service(prep, process, out, share)
-            for (process, prep), out in zip(preps, outs)]
+    # per-service seconds = share of the dispatch wall-clock proportional
+    # to each service's padded compute cells at its own shape class — the
+    # quantity the device spends time on (the same attribution model the
+    # parity harness uses); shares sum to the measured wall-clock
+    total_cells = max(1.0, sum(cells))
+    return [_finish_service(prep, process, out, elapsed * c / total_cells)
+            for (process, prep), out, c in zip(preps, outs, cells)]
 
 
 @dataclass
