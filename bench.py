@@ -535,15 +535,20 @@ def run_baseline_child(bundle_path: str, out_path: str) -> None:
     rec_valid = (recorded.get("subset_spans") == SUBSET_SPANS
                  and recorded.get("compress") == COMPRESS)
 
-    # cheapest first (unknown services last), so the budget buys the
-    # maximum number of fresh same-input pairs; a recording for a
-    # DIFFERENT config (subset size / compress) is not comparable and
-    # must not gate anything
+    # cheapest first, then unknown services, then recorded-DNF ones — so
+    # the budget buys the maximum number of fresh same-input pairs and
+    # never burns an alarm's worth on a solve the recording already
+    # proves cannot finish; a recording for a DIFFERENT config (subset
+    # size / compress) is not comparable and must not gate anything
+    UNKNOWN, RECORDED_DNF = 1e9, float("inf")
+
     def est_cost(label):
         rec = rec_svcs.get(label)
-        if rec_valid and rec and rec.get("finished"):
-            return rec["seconds"]
-        return 1e9
+        if rec_valid and rec:
+            if rec.get("finished"):
+                return rec["seconds"]
+            return RECORDED_DNF
+        return UNKNOWN
 
     order = sorted(flat, key=lambda item: est_cost(item[0]))
 
@@ -568,13 +573,25 @@ def run_baseline_child(bundle_path: str, out_path: str) -> None:
         # AND discard a carriable finished recorded pair
         est = est_cost(label)
         known = est < 1e8
-        fits_alarm = (est * 1.2 <= EXACT_ALARM_SECONDS) if known else True
-        want_fresh = fits_alarm and budget_left > (
-            est * 1.5 if known else EXACT_ALARM_SECONDS)
+        alarm_cap = EXACT_ALARM_SECONDS
+        if est == RECORDED_DNF:
+            # proven not to finish under the alarm: retry only with ample
+            # leftover budget (e.g. an uncapped recording regeneration) —
+            # otherwise the budget goes to unmeasured services instead.
+            # The retry must NOT re-impose the alarm the recording already
+            # proved insufficient: it may use the whole leftover budget
+            # minus one alarm of slack for services still to come.
+            want_fresh = budget_left > 2 * EXACT_ALARM_SECONDS
+            alarm_cap = max(EXACT_ALARM_SECONDS,
+                            int(budget_left - EXACT_ALARM_SECONDS))
+        else:
+            fits_alarm = (est * 1.2 <= EXACT_ALARM_SECONDS) if known else True
+            want_fresh = fits_alarm and budget_left > (
+                est * 1.5 if known else EXACT_ALARM_SECONDS)
         if want_fresh:
             algo = WeaverExact(store.all_spans, store.all_processes)
             t0 = time.perf_counter()
-            signal.alarm(min(EXACT_ALARM_SECONDS, max(5, int(budget_left))))
+            signal.alarm(min(alarm_cap, max(5, int(budget_left))))
             try:
                 out = algo.FindAssignments(
                     "MaxScoreBatch", svc, sub_in, prob.out_span_partitions,
@@ -859,7 +876,13 @@ def main() -> None:
 
     exact_sps = (baseline or {}).get("subset_spans_per_sec")
     exact_sps_all = (baseline or {}).get("subset_spans_per_sec_incl_recorded")
+    # the headline ratio prefers a same-run denominator; falling back to
+    # recorded timings (possibly another host/run) is flagged explicitly
+    # so consumers can't mistake a recorded-denominator ratio for a
+    # same-run measurement
     ratio_base = exact_sps or exact_sps_all
+    ratio_basis = ("fresh" if exact_sps
+                   else "recorded" if exact_sps_all else None)
     result = {
         # the reduced fallback corpus (hotel only) is NOT comparable to the
         # full two-app workload — it reports under its own metric name
@@ -871,6 +894,7 @@ def main() -> None:
         "unit": "spans/sec",
         "vs_baseline": (round(solver["spans_per_sec"] / ratio_base, 1)
                         if ratio_base else None),
+        "vs_baseline_basis": ratio_basis,
         "backend": solver["backend"],
         "backend_init_s": solver.get("backend_init_s"),
         "n_spans": solver["n_spans"],

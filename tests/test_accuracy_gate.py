@@ -1,0 +1,87 @@
+"""Subset-accuracy regression gate: flagship TPU solver vs exact path.
+
+Round 4's bench showed hotel/frontend TPU 0.80 vs exact 1.00 on an n=25
+same-input subset — noise or regression? This gate makes the comparison
+deterministic (VERDICT r4 #3): n=100 incoming spans per service on the
+bench regime (hotel+media load150, compress x10), TPU side solved fresh
+here, exact side from the committed recording
+``tests/data/exact_gate_recorded.json`` (regenerate:
+``python exps/parity/record_exact_gate.py`` — exact solves cost minutes
+per service, far over unit-test budget).
+
+Gate: per service, TPU accuracy >= exact accuracy - EPS; and the mean
+delta over services >= 0 (the bench's ``accuracy_delta_same_inputs``
+acceptance). Reference accuracy definitions: helpers/utils.py:62-79.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RECORD = os.path.join(REPO, "tests", "data", "exact_gate_recorded.json")
+EPS = 0.02
+
+
+def _load_recorder_module():
+    path = os.path.join(REPO, "exps", "parity", "record_exact_gate.py")
+    spec = importlib.util.spec_from_file_location("record_exact_gate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def gate_sides():
+    if not os.path.exists(RECORD):
+        pytest.skip("exact_gate_recorded.json not generated yet")
+    with open(RECORD) as f:
+        recorded = json.load(f)
+
+    rec_mod = _load_recorder_module()
+    assert recorded["gate_spans"] == rec_mod.GATE_SPANS
+    assert recorded["compress"] == rec_mod.COMPRESS
+
+    from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+    from traceweaver_tpu.metrics import accuracy_for_service
+
+    import copy
+
+    problems = rec_mod.build_gate_problems()
+    items = [
+        FleetItem(svc, copy.deepcopy(sub_in), out_parts,
+                  copy.deepcopy(sub_ta), dag, store=store)
+        for label, svc, sub_in, out_parts, sub_ta, dag, store in problems
+    ]
+    outs = solve_fleet(items)
+    tpu = {}
+    for (label, svc, sub_in, out_parts, sub_ta, dag, store), out in zip(
+            problems, outs):
+        tpu[label] = accuracy_for_service(
+            out[0], copy.deepcopy(sub_ta), sub_in)
+    return tpu, recorded["services"]
+
+
+def test_tpu_within_eps_of_exact_per_service(gate_sides):
+    tpu, exact = gate_sides
+    finished = {k: v for k, v in exact.items() if v.get("finished")}
+    assert len(finished) >= 4, "gate needs a meaningful service set"
+    for label, rec in finished.items():
+        assert label in tpu, f"gate problem set lost {label}"
+        assert tpu[label] >= rec["accuracy"] - EPS, (
+            f"{label}: TPU {tpu[label]:.4f} < exact {rec['accuracy']:.4f}"
+            f" - {EPS} — the r04 subset-accuracy signal is a regression,"
+            " not noise")
+
+
+def test_mean_delta_nonnegative(gate_sides):
+    tpu, exact = gate_sides
+    deltas = [tpu[k] - v["accuracy"] for k, v in exact.items()
+              if v.get("finished") and k in tpu]
+    assert deltas
+    mean = sum(deltas) / len(deltas)
+    assert mean >= 0.0, (
+        f"mean same-input accuracy delta {mean:.4f} < 0 over {len(deltas)}"
+        " services")

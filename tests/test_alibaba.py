@@ -136,6 +136,75 @@ def test_synthesize_writes_replica_table(tmp_path):
         assert pickle.load(f) == table
 
 
+def test_repair_fills_missing_callee_from_child():
+    recs = [_rec("t", "0", "USER", "A"), _rec("t", "0.1", "A", "(?)"),
+            _rec("t", "0.1.1", "B", "C")]
+    fixed = repair_trace(recs)
+    assert fixed[1].callee == "B"
+
+
+def test_repair_rejects_unrepairable_missing_leaf():
+    # a leaf's '(?)' callee has no child row to fill from -> whole trace
+    # rejected (reference real-parser.py:179-187 returns unfixable)
+    assert repair_trace([_rec("t", "0", "U", "A"),
+                         _rec("t", "0.1", "A", "(?)")]) is None
+
+
+def test_synthesize_messy_corpus_repairs_and_rejects(tmp_path):
+    """The hard corpus (VERDICT r4 #5): defects are injected BEFORE
+    repair, repairable classes survive, structural corruption is
+    rejected, and grouped datasets still come out the other end."""
+    from traceweaver_tpu.alibaba.synthesize import MESSY_DEFAULT
+
+    stats = {}
+    dirs = synthesize_corpus(str(tmp_path / "cg"), n_graphs=2,
+                             traces_per_graph=60, seed=7,
+                             messy=MESSY_DEFAULT, stats=stats)
+    assert stats["defect_injected"] > 0
+    assert stats["dropped"] > 0, "structural corruption must be rejected"
+    assert stats["kept"] > stats["dropped"], \
+        "repairable defects must survive repair"
+    assert stats["kept"] + stats["dropped"] == stats["emitted"]
+    assert dirs, "grouped call-graph datasets must still be produced"
+
+
+def test_synthesize_messy_multi_invocation_callees(tmp_path):
+    """multi_invoke emits services that are callees of several calls in
+    one trace (violating the clean-corpus invariant the way real
+    MSCallGraph data does); the ingest pipeline must carry them without
+    crashing — multi-upstream services end up skipped by the partitioner
+    exactly as in the reference (executor.py:949-950)."""
+    from traceweaver_tpu.ingest import build_service_problem, load_corpus
+
+    dirs = synthesize_corpus(
+        str(tmp_path / "cg"), n_graphs=3, traces_per_graph=40, seed=11,
+        messy={"multi_invoke": 0.5})
+    multi = 0
+    for d in dirs:
+        store = load_corpus(d, fix=5, max_traces=40, cache=False)
+        for svc, spans in store.in_spans_by_process.items():
+            by_trace = {}
+            for s in spans:
+                by_trace[s.trace_id] = by_trace.get(s.trace_id, 0) + 1
+            if any(v > 1 for v in by_trace.values()):
+                multi += 1
+        for svc in store.out_spans_by_process:
+            build_service_problem(store, svc)  # must not raise
+    assert multi > 0, "expected at least one multi-invocation callee"
+
+
+def test_replica_dist_knob():
+    from traceweaver_tpu.alibaba.synthesize import replica_counts
+
+    svcs = [f"MS_{i:05d}" for i in range(10)]
+    fixed = replica_counts(svcs, seed=7, dist="fixed-64")
+    assert set(fixed.values()) == {64}
+    lo = replica_counts(svcs, seed=7, dist="loguniform-4-32")
+    assert all(4 <= v <= 32 for v in lo.values())
+    # deterministic per seed
+    assert lo == replica_counts(svcs, seed=7, dist="loguniform-4-32")
+
+
 def test_executor_replica_scaling_divides_compress(tmp_path):
     """ExecutorConfig.replica_count feeds ceil(compress/replicas)
     (reference executor.py:922-929): a 15000x corpus factor over ~100

@@ -103,3 +103,47 @@ def test_baseline_child_carries_recording_for_over_alarm_services(
         report2 = json.load(f)
     assert all(v["measured"] for v in report2["subset"].values())
     assert report2["n_recorded"] == 0
+
+
+def test_baseline_child_skips_recorded_dnf_without_ample_budget(
+        bench, tmp_path, monkeypatch):
+    """A service the recording proves cannot finish (finished=false) must
+    NOT get a benefit-of-the-doubt fresh attempt on a normal budget — the
+    budget goes to unmeasured services instead (ADVICE r4). With an ample
+    budget (> 2 alarms) the DNF service is retried."""
+    import pickle
+
+    monkeypatch.setenv("TW_BENCH_APPS", "hotel")
+    monkeypatch.setenv("TW_BENCH_MAX_TRACES", "40")
+    monkeypatch.setenv("TW_BENCH_SUBSET", "8")
+    monkeypatch.setenv("TW_BENCH_BASELINE_BUDGET", "60")
+    b = importlib.reload(bench)
+
+    bundles = b.build_problems()
+    bundle = tmp_path / "bundle.pkl"
+    with open(bundle, "wb") as f:
+        pickle.dump(bundles, f)
+
+    rec = {
+        "subset_spans": 8, "compress": b.COMPRESS,
+        "services": {
+            "hotel/frontend": {"finished": False, "seconds": 95.0,
+                               "n_spans": 8, "accuracy": None},
+            "hotel/search": {"finished": True, "seconds": 0.5,
+                             "n_spans": 8, "accuracy": 1.0},
+        },
+    }
+    monkeypatch.setattr(b, "RECORDED_PATH", str(tmp_path / "rec.json"))
+    with open(b.RECORDED_PATH, "w") as f:
+        json.dump(rec, f)
+
+    out = tmp_path / "baseline.json"
+    b.run_baseline_child(str(bundle), str(out))
+    with open(out) as f:
+        report = json.load(f)
+    sub = report["subset"]
+    # DNF carried (not retried), cheap service solved fresh
+    assert sub["hotel/frontend"]["measured"] is False
+    assert sub["hotel/frontend"]["finished"] is False
+    assert sub["hotel/search"]["measured"] is True
+    assert report["n_fresh"] == 1

@@ -23,6 +23,30 @@ def test_mixture_logpdf_matches_scipy():
     np.testing.assert_allclose(got, want, rtol=1e-4)  # float32 on device
 
 
+def test_mixture_logpdf_gemm_matches_elementwise_at_delay_scale():
+    """The GEMM (quadratic-feature matmul) formulation must agree with
+    the elementwise form at the solver's real magnitudes — µs-scale
+    delays against tens-of-µs sds, where the UNcentered expansion loses
+    every mantissa bit (x=5e5, sd=50: x^2 ~ 2.5e11, f32 ulp ~ 1.6e4)."""
+    from traceweaver_tpu.ops.scores import mixture_logpdf_gemm
+
+    cases = [
+        # (x values, weights, means, stds) — matched-candidate regimes
+        (jnp.array([5.0e5, 5.001e5, 4.999e5]),
+         jnp.array([1.0, 0.0, 0.0]),
+         jnp.array([5.001e5, 0.0, 0.0]),
+         jnp.array([50.0, 1.0, 1.0])),
+        (jnp.array([1.0e6, 1.0001e6]),
+         jnp.array([0.4, 0.6, 0.0]),
+         jnp.array([1.0001e6, 1.00005e6, 0.0]),
+         jnp.array([20.0, 80.0, 1.0])),
+    ]
+    for x, w, mu, sd in cases:
+        ref = np.asarray(mixture_logpdf(x, w, mu, sd))
+        got = np.asarray(mixture_logpdf_gemm(x, w, mu, sd))
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-2)
+
+
 def test_sinkhorn_marginals():
     rng = np.random.default_rng(0)
     S = jnp.asarray(rng.normal(size=(6, 8)))

@@ -39,7 +39,6 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from traceweaver_tpu.algorithms import timing
 from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
 from traceweaver_tpu.algorithms.weaver_tpu import (
     DEFAULT_MAX_WINDOW,
@@ -48,10 +47,11 @@ from traceweaver_tpu.algorithms.weaver_tpu import (
     candidate_ranges,
     pack_problem,
     perfect_cut_windows,
+    plan_find_assignments,
     solve_em_fleet,
     solve_windows_fleet,
 )
-from traceweaver_tpu.spans import NA, SKIP
+from traceweaver_tpu.spans import NA
 
 # fleet single-dispatch budget: live f32 elements of the [B, E, W, M]
 # score block (the dominant allocation). Past this the padded single
@@ -87,45 +87,27 @@ def _prepare(item: FleetItem, solver: WeaverTPU):
     (``n_passes=1``, no EM refit — identical to ``iterations = 1`` in
     :meth:`WeaverTPU.FindAssignments`), with their water-filled skip caps
     carried as per-window tensors in the fused dispatch."""
-    in_ep, in_spans = next(iter(item.in_span_partitions.items()))
-    in_spans = sorted(in_spans, key=lambda s: (s.start_mus, s.end_mus))
-    out_eps = solver._topo_out_eps(item.out_span_partitions, item.dag)
-    n_in = len(in_spans)
-    skip_budget = {
-        ep: n_in - len(item.out_span_partitions[ep]) for ep in out_eps
-    }
-    dynamism = any(b > 0 for b in skip_budget.values())
     if item.dag is None or solver.score_mode != "mixture":
         return None
     if item.method not in ("MaxScoreBatchSubsetWithSkips",
                            "MaxScoreBatchSubsetWithTrueSkips"):
         return None
-    force_skip_ids = None
-    if item.method == "MaxScoreBatchSubsetWithTrueSkips":
-        # true-skips oracle: forced rows ride the dispatch as per-window
-        # force-skip tensors (the device solver input, weaver_tpu.py:94)
-        force_skip_ids = {
-            ep: {
-                in_id for in_id, out_id in item.true_assignments[ep].items()
-                if tuple(out_id) == SKIP
-            }
-            for ep in out_eps
-        }
-    if dynamism:
-        dists = timing.bootstrap_distributions(
-            item.in_span_partitions, item.out_span_partitions, out_eps,
-            score_mode=solver.score_mode,
-        )
-        n_passes = 1
-    else:
-        dists = timing.estimate_edge_params(
-            item.in_span_partitions, item.out_span_partitions, item.dag,
-            0, n_in,
-        )
-        n_passes = 2
+    in_ep, in_spans = next(iter(item.in_span_partitions.items()))
+    in_spans = sorted(in_spans, key=lambda s: (s.start_mus, s.end_mus))
+    out_eps = solver._topo_out_eps(item.out_span_partitions, item.dag)
+    # the SAME plan the per-service entry point computes (one definition,
+    # weaver_tpu.plan_find_assignments — the paths cannot drift); the
+    # true-skips oracle's forced rows ride the dispatch as per-window
+    # force-skip tensors (the device solver input, weaver_tpu.py:94)
+    plan = plan_find_assignments(
+        item.in_span_partitions, item.out_span_partitions, out_eps,
+        item.dag, item.true_assignments, score_mode=solver.score_mode,
+        true_skips=(item.method == "MaxScoreBatchSubsetWithTrueSkips"),
+    )
     return dict(in_ep=in_ep, in_spans=in_spans, out_eps=out_eps,
-                skip_budget=skip_budget, dists=dists, n_in=n_in,
-                n_passes=n_passes, force_skip_ids=force_skip_ids)
+                skip_budget=plan["skip_budget"], dists=plan["dists"],
+                n_in=plan["n_in"], n_passes=plan["iterations"],
+                force_skip_ids=plan["force_skip_ids"])
 
 
 def _raw_cells(item: FleetItem, max_window: int) -> float:
@@ -419,6 +401,12 @@ def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
     batch = {k: np.concatenate(v, axis=0) for k, v in arrays_cat.items()}
     params = {k: np.stack(v, axis=0) for k, v in param_rows.items()}
     pidx = np.asarray(param_idx, dtype=np.int32)
+    # static neighbour bounds over the whole group (fleet max in/out
+    # degree, power-of-two bucketed): the score build gathers only real
+    # DAG edges instead of evaluating all E_pad per endpoint
+    pm_all = params["pred_mask"]
+    _mp = _bucket(max(1, int(pm_all.sum(axis=2).max(initial=0))), minimum=1)
+    _ms = _bucket(max(1, int(pm_all.sum(axis=1).max(initial=0))), minimum=1)
     # each service's contiguous window-row block, for the gathered refit
     P = len(per_item_pack)
     n_windows_total = len(param_idx)
@@ -440,7 +428,7 @@ def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
         cells = (n_windows_total * E_pad * W_pad * M_pad
                  * n_sweeps * n_passes)
         stats["flops_est"] = stats.get("flops_est", 0.0) + cells * (
-            8.0 * K * (E_pad + 2)
+            8.0 * K * (min(_mp, E_pad) + min(_ms, E_pad) + 2)
             + 6.0 * 2 * n_sinkhorn
             + 8.0 * max(1, W_pad.bit_length())
         )
@@ -498,13 +486,13 @@ def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
         out = solve_em_fleet(
             *common, window_rows, window_valid, *tables,
             epsilon=epsilon, n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
-            sinkhorn_tol=sinkhorn_tol,
+            sinkhorn_tol=sinkhorn_tol, max_preds=_mp, max_succs=_ms,
         )
     else:
         out = solve_windows_fleet(
             *common, *tables,
             epsilon=epsilon, n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
-            sinkhorn_tol=sinkhorn_tol,
+            sinkhorn_tol=sinkhorn_tol, max_preds=_mp, max_succs=_ms,
         )
     if stats is not None:
         stats["dispatch_s"] = (stats.get("dispatch_s", 0.0)

@@ -10,11 +10,57 @@ traceweaver_v1.py:117-148, with one fused vectorized evaluation).
 from __future__ import annotations
 
 import math
+import os
 
 import jax.numpy as jnp
 from jax.scipy.special import logsumexp
 
 LOG_2PI = math.log(2.0 * math.pi)
+
+# TW_SCORE_GEMM=1 routes eligible mixture evaluations through the
+# quadratic-feature matmul formulation (see mixture_logpdf_gemm) — the
+# "put the MXU to work" experiment. Default off: the measured roofline
+# (docs/ROOFLINE.md) shows the [.., 3] x [3, K<=5] contraction cannot
+# tile the 128x128 systolic array and the elementwise form wins.
+_USE_GEMM = os.environ.get("TW_SCORE_GEMM") == "1"
+
+
+def mixture_logpdf_gemm(x: jnp.ndarray, weights: jnp.ndarray,
+                        means: jnp.ndarray, stds: jnp.ndarray) -> jnp.ndarray:
+    """GEMM formulation of the K-component Gaussian-mixture log-density.
+
+    Expanding the per-component exponent makes each logit an inner
+    product of quadratic features against per-component coefficients.
+    The expansion is CENTERED at the weighted mean of component means
+    (``y = x - mu_bar``, ``d_k = mu_k - mu_bar``) — the naive ``[x^2, x,
+    1]`` form cancels catastrophically in f32 when ``|x| >> sd`` (µs-
+    scale delays against tens-of-µs sds lose all mantissa bits in x^2)::
+
+        comp_k(x) + log w_k = a_k y^2 + b_k y + c_k
+        a_k = -1/(2 sd_k^2);  b_k = d_k/sd_k^2
+        c_k = -d_k^2/(2 sd_k^2) - log sd_k - log sqrt(2 pi) + log w_k
+
+    i.e. ``logits = [y^2, y, 1] @ C`` with ``C`` a ``[3, K]`` matrix —
+    a batched matmul the MXU *could* execute. Centering keeps the
+    feature scale at the deviation scale (matched candidates have
+    ``y ~ d_k``); residual f32 error grows as ``(y/sd)^2 * eps`` and is
+    asserted against the elementwise form in tests/test_ops.py.
+    x: [...]; params: [K].
+    """
+    var = stds * stds
+    wsum = jnp.maximum(jnp.sum(weights), 1e-30)
+    mu_bar = jnp.sum(weights * means) / wsum
+    d = means - mu_bar
+    a = -0.5 / var
+    b = d / var
+    logw = jnp.where(weights > 0, jnp.log(jnp.maximum(weights, 1e-30)),
+                     -jnp.inf)
+    c = -0.5 * d * d / var - jnp.log(stds) - 0.5 * LOG_2PI + logw
+    coef = jnp.stack([a, b, c], axis=0)                      # [3, K]
+    y = x - mu_bar
+    feats = jnp.stack([y * y, y, jnp.ones_like(y)], axis=-1)  # [..., 3]
+    logits = jnp.tensordot(feats, coef, axes=([-1], [0]))     # [..., K]
+    return logsumexp(logits, axis=-1)
 
 
 def mixture_logpdf(x: jnp.ndarray, weights: jnp.ndarray, means: jnp.ndarray,
@@ -24,6 +70,8 @@ def mixture_logpdf(x: jnp.ndarray, weights: jnp.ndarray, means: jnp.ndarray,
     x: [...]; weights/means/stds: [..., K] broadcastable against x[..., None].
     Components with weight 0 are padding.
     """
+    if _USE_GEMM and weights.ndim == 1:
+        return mixture_logpdf_gemm(x, weights, means, stds)
     z = (x[..., None] - means) / stds
     comp = -0.5 * z * z - jnp.log(stds) - 0.5 * LOG_2PI
     logw = jnp.where(weights > 0, jnp.log(jnp.maximum(weights, 1e-30)), -jnp.inf)

@@ -129,6 +129,12 @@ def main(argv=None) -> int:
         os.path.join(root, "data/misc/service_to_replica_new.pickle")
     )
     if replica_table is None:
+        # <out_root>/misc, one level above the per-CG dataset dir — where
+        # the synthesizer writes for non-reference --out layouts
+        d1 = os.path.dirname(os.path.abspath(data_path.rstrip("/")))
+        replica_table = load_replica_table(
+            os.path.join(d1, "misc", "service_to_replica_new.pickle"))
+    if replica_table is None:
         # <data_root>/misc, three levels above the per-CG dataset dir
         # (<data_root>/alibaba_microservices/call_graph_data/call_graph_N)
         d = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -1,0 +1,151 @@
+"""Measured before/after for the score-build reformulations (VERDICT r4 #2).
+
+Times the flagship device solve on the bench workload's heaviest service
+(hotel/frontend, load150, compress x10 — the reference hot loop's home,
+traceweaver_v1.py:117-148) under three score-build configurations:
+
+- ``full``    — every endpoint's score matrix sums masked mixture blocks
+                over ALL E endpoints (the round-4 codegen: O(E^2) [W,M,K]
+                blocks per sweep);
+- ``bounded`` — the production path: per-endpoint gathers over the DAG's
+                real neighbours only (max in/out degree, power-of-two
+                bucketed);
+- ``gemm``    — ``bounded`` plus TW_SCORE_GEMM=1: mixture logits via the
+                quadratic-feature matmul (ops/scores.py
+                ``mixture_logpdf_gemm``).
+
+Each configuration runs in its OWN subprocess (the GEMM flag is read at
+import; jit caches must not leak between configs). Two timed passes per
+config: cold (compile + solve) and warm (solve only); the warm pass is
+the comparable number. Prints one JSON line per config and a summary.
+
+Usage: ``python utils/score_roofline.py``  (parent; runs all three)
+       ``python utils/score_roofline.py --config bounded``  (one child)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DATA = "/root/reference/data/hotel_reservation/hotel_load150"
+COMPRESS = 10.0
+
+
+def run_child(config: str) -> None:
+    import jax
+
+    if os.environ.get("TW_ROOFLINE_BACKEND", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import traceweaver_tpu.algorithms.weaver_tpu as wt
+    from traceweaver_tpu.ingest import (
+        build_service_problem, infer_invocation_dag, load_corpus,
+    )
+    from traceweaver_tpu.metrics import get_ground_truth
+    from traceweaver_tpu.synth import compress_spans
+
+    store = load_corpus(DATA, fix=2, max_traces=1000, cache=True)
+    prob = build_service_problem(store, "frontend")
+    ta = get_ground_truth(prob.in_span_partitions, prob.out_span_partitions)
+    dag = infer_invocation_dag(prob.in_span_partitions,
+                               prob.out_span_partitions, ta, store)
+    compress_spans(prob.in_span_partitions, prob.out_span_partitions,
+                   1, COMPRESS)
+    ta = get_ground_truth(prob.in_span_partitions, prob.out_span_partitions)
+
+    if config == "full":
+        # monkeypatch the neighbour bounds off: every pack/solve falls
+        # back to n_pred = n_succ = E (the round-4 codegen)
+        orig = wt._solve_windows_impl
+
+        def unbounded(*args, **kw):
+            kw["max_preds"] = 0
+            kw["max_succs"] = 0
+            return orig(*args, **kw)
+
+        wt._solve_windows_impl = unbounded
+
+    def solve():
+        algo = wt.WeaverTPU(store.all_spans, store.all_processes)
+        import copy
+        out = algo.FindAssignments(
+            "MaxScoreBatchSubsetWithSkips", "frontend",
+            copy.deepcopy(prob.in_span_partitions),
+            copy.deepcopy(prob.out_span_partitions), False, [],
+            copy.deepcopy(ta), dag)
+        return algo.stats, out
+
+    t0 = time.perf_counter()
+    stats_cold, out_cold = solve()
+    cold_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    stats_warm, out_warm = solve()
+    warm_s = time.perf_counter() - t0
+
+    from traceweaver_tpu.metrics import accuracy_for_service
+    import copy as _copy
+    acc = accuracy_for_service(out_warm[0], _copy.deepcopy(ta),
+                               prob.in_span_partitions)
+    print(json.dumps({
+        "config": config,
+        "backend": jax.default_backend(),
+        "cold_s": round(cold_s, 2),
+        "warm_s": round(warm_s, 2),
+        "warm_dispatch_wait_s": round(
+            stats_warm.get("dispatch_s", 0.0) + stats_warm.get("wait_s", 0.0),
+            2),
+        "accuracy": round(acc, 4),
+        "flops_est": stats_warm.get("flops_est"),
+    }), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None,
+                    choices=["full", "bounded", "gemm"])
+    args = ap.parse_args()
+    if args.config:
+        run_child(args.config)
+        return
+    results = []
+    for config in ("full", "bounded", "gemm"):
+        env = dict(os.environ)
+        if config == "gemm":
+            env["TW_SCORE_GEMM"] = "1"
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--config", config],
+            capture_output=True, text=True, env=env)
+        line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+        if line:
+            results.append(json.loads(line[-1]))
+            print(line[-1], flush=True)
+        else:
+            print(json.dumps({"config": config, "error": r.stderr[-500:]}),
+                  flush=True)
+    if len(results) == 3:
+        by = {r["config"]: r for r in results}
+        print(json.dumps({
+            "summary": "warm seconds full -> bounded -> gemm",
+            "full_s": by["full"]["warm_s"],
+            "bounded_s": by["bounded"]["warm_s"],
+            "gemm_s": by["gemm"]["warm_s"],
+            "bounded_speedup_vs_full": round(
+                by["full"]["warm_s"] / by["bounded"]["warm_s"], 2),
+            "gemm_speedup_vs_bounded": round(
+                by["bounded"]["warm_s"] / by["gemm"]["warm_s"], 2),
+            "accuracy_equal": len({r["accuracy"] for r in results}) == 1,
+        }, indent=1), flush=True)
+
+
+if __name__ == "__main__":
+    main()
