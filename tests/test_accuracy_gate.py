@@ -2,10 +2,13 @@
 
 Round 4's bench showed hotel/frontend TPU 0.80 vs exact 1.00 on an n=25
 same-input subset — noise or regression? This gate makes the comparison
-deterministic (VERDICT r4 #3): n=100 incoming spans per service on the
-bench regime (hotel+media load150, compress x10), TPU side solved fresh
-here, exact side from the committed recording
-``tests/data/exact_gate_recorded.json`` (regenerate:
+deterministic (VERDICT r4 #3): n=100 incoming spans per service,
+hotel+media at load25 with the bench's compress x10 — NOT load150,
+because there the exact DFS+MWIS side cannot finish hotel/frontend
+n=100 inside a 20-minute alarm on this host (measured DNF; see
+record_exact_gate.py's docstring), which would starve the gate. TPU
+side is solved fresh here; exact side comes from the committed
+recording ``tests/data/exact_gate_recorded.json`` (regenerate:
 ``python exps/parity/record_exact_gate.py`` — exact solves cost minutes
 per service, far over unit-test budget).
 

@@ -1,0 +1,89 @@
+"""Ground-truth-free invocation-DAG discovery (VERDICT r4 #6).
+
+The reference carries an unwired sketch of this capability
+(``FindConstraintsUsingFit``, executor.py:152-212); here it is a
+production path: ``discover_invocation_dag`` infers each service's
+precedence DAG by EM over structure — solve unconstrained, prune edges
+contradicted by the predicted assignments, re-solve — with ground truth
+used for grading ONLY. Acceptance bar from the verdict: flagship
+accuracy within 1 pt of the GT-DAG path on exp1 datasets.
+"""
+
+import pytest
+
+from traceweaver_tpu.ingest import (
+    build_service_problem,
+    discover_invocation_dag,
+    infer_dag_from_predictions,
+    infer_invocation_dag,
+    load_corpus,
+)
+from traceweaver_tpu.metrics import get_ground_truth
+
+HOTEL = "/root/reference/data/hotel_reservation/hotel_load25"
+MEDIA = "/root/reference/data/media_microservices/media_load25"
+
+
+def test_prediction_pruning_equals_gt_pruning_on_truth():
+    """Feeding the TRUE assignments through the prediction-driven variant
+    must reproduce the ground-truth inference exactly (same core rule)."""
+    store = load_corpus(HOTEL, fix=2, max_traces=200, cache=False)
+    for svc in store.out_spans_by_process:
+        prob = build_service_problem(store, svc)
+        if prob.skipped:
+            continue
+        ta = get_ground_truth(prob.in_span_partitions,
+                              prob.out_span_partitions)
+        g_true = infer_invocation_dag(
+            prob.in_span_partitions, prob.out_span_partitions, ta, store)
+        g_pred = infer_dag_from_predictions(
+            prob.in_span_partitions, prob.out_span_partitions, ta, store)
+        assert set(g_true.edges()) == set(g_pred.edges()), svc
+
+
+def test_prediction_pruning_never_returns_cycles():
+    """Prediction rows can MISS endpoints (NA/SKIP): endpoint pairs that
+    never co-occur must keep neither direction (a surviving 2-cycle
+    would crash the solver's topological sort), and the result is always
+    a DAG."""
+    import networkx as nx
+
+    store = load_corpus(HOTEL, fix=2, max_traces=120, cache=False)
+    svc = "frontend"
+    prob = build_service_problem(store, svc)
+    ta = get_ground_truth(prob.in_span_partitions, prob.out_span_partitions)
+    out_eps = list(prob.out_span_partitions)
+    assert len(out_eps) >= 2
+    # degrade predictions: endpoint B is NA wherever A has a real
+    # assignment, so (A, B) is never tested in any row
+    a_ep, b_ep = out_eps[0], out_eps[1]
+    degraded = {ep: dict(m) for ep, m in ta.items()}
+    for in_id in list(degraded[a_ep]):
+        degraded[b_ep].pop(in_id, None)
+    g = infer_dag_from_predictions(
+        prob.in_span_partitions, prob.out_span_partitions, degraded, store)
+    assert nx.is_directed_acyclic_graph(g)
+    assert not (g.has_edge(a_ep, b_ep) and g.has_edge(b_ep, a_ep))
+
+
+@pytest.mark.parametrize("path,fix", [(HOTEL, 2), (MEDIA, 1)])
+def test_flagship_accuracy_within_1pt_of_gt_dag_path(path, fix):
+    """End-to-end: run_experiment with gt_free_dag=True must land within
+    1 accuracy point of the GT-DAG run on exp1 datasets."""
+    from traceweaver_tpu.runtime.executor import ExecutorConfig, run_experiment
+
+    store = load_corpus(path, fix=fix, max_traces=300, cache=False)
+
+    def run(gt_free):
+        cfg = ExecutorConfig(
+            data_path="", results_directory="", fix=fix, cache_rate=0.0,
+            test_name="gtfree", predictor_indices=[10],
+            gt_free_dag=gt_free,
+        )
+        return run_experiment(cfg, store=store)
+
+    gt = run(False).accuracy_overall["MaxScoreBatchSubsetWithSkips"]
+    free = run(True).accuracy_overall["MaxScoreBatchSubsetWithSkips"]
+    assert free >= gt - 1.0, (
+        f"GT-free DAG path {free:.2f}% vs GT-DAG {gt:.2f}% "
+        f"(> 1 pt loss) on {path}")

@@ -12,6 +12,7 @@ from traceweaver_tpu.ingest.partition import (  # noqa: F401
     partition_spans_by_endpoint,
 )
 from traceweaver_tpu.ingest.order import (  # noqa: F401
-    fit_invocation_dag, infer_invocation_dag, solver_misfit,
+    discover_invocation_dag, fit_invocation_dag, infer_dag_from_predictions,
+    infer_invocation_dag, solver_misfit,
     topological_sort_grouped,
 )

@@ -36,6 +36,33 @@ def topological_sort_grouped(G: nx.DiGraph) -> List[List]:
     return groups
 
 
+def _complete_digraph(out_eps: List[str]) -> nx.DiGraph:
+    G = nx.DiGraph()
+    G.add_nodes_from(out_eps)
+    for a in out_eps:
+        for b in out_eps:
+            if a != b:
+                G.add_edge(a, b)
+    return G
+
+
+def _prune_contradicted_edges(G: nx.DiGraph, per_request_rows) -> None:
+    """Delete every edge (a, b) contradicted by a request in which a's
+    span overlaps b's (a does not complete before b starts) — the core
+    rule shared by the ground-truth and prediction-driven inferences
+    (reference executor.py:214-285, the ``G1`` graph)."""
+    for outgoing in per_request_rows:
+        outgoing.sort(key=lambda x: x[0])
+        for i, (xs, xd, xep) in enumerate(outgoing):
+            for j, (ys, yd, yep) in enumerate(outgoing):
+                if i == j:
+                    continue
+                if xs + xd > ys and G.has_edge(xep, yep):
+                    G.remove_edge(xep, yep)
+                if ys + yd > xs and G.has_edge(yep, xep):
+                    G.remove_edge(yep, xep)
+
+
 def infer_invocation_dag(
     in_span_partitions: Dict[str, List[Span]],
     out_span_partitions: Dict[str, List[Span]],
@@ -51,31 +78,136 @@ def infer_invocation_dag(
     _, in_spans = next(iter(in_span_partitions.items()))
     out_eps = list(out_span_partitions.keys())
 
-    G = nx.DiGraph()
-    G.add_nodes_from(out_eps)
-    for a in out_eps:
-        for b in out_eps:
-            if a != b:
-                G.add_edge(a, b)
-
+    G = _complete_digraph(out_eps)
+    rows = []
     for in_span in in_spans:
         outgoing = []
         for out_ep in out_eps:
             span = store.all_spans[true_assignments[out_ep][in_span.GetId()]]
             child = span.GetChildProcess(store.all_processes, store.all_spans)
             outgoing.append((span.start_mus, span.duration_mus, child))
-        outgoing.sort(key=lambda x: x[0])
+        rows.append(outgoing)
+    _prune_contradicted_edges(G, rows)
+    return G
 
+
+def infer_dag_from_predictions(
+    in_span_partitions: Dict[str, List[Span]],
+    out_span_partitions: Dict[str, List[Span]],
+    assignments: Dict[str, Dict],
+    store: TraceStore,
+) -> nx.DiGraph:
+    """The same contradiction pruning, driven by PREDICTED assignments.
+
+    Tolerates what predictions contain and truth never does: NA (span
+    unassigned) and SKIP (cache-served) entries simply contribute no row
+    for that endpoint. Endpoint labels come from the partition keys, not
+    span child lookups, so wrong-but-real assignments still prune the
+    intended endpoint pair.
+
+    Unlike truth rows (which always contain every endpoint), prediction
+    rows can MISS endpoints, so the complete-digraph seed needs two
+    guards the ground-truth variant never does: endpoint pairs that
+    never co-occur in any row carry no ordering evidence and keep
+    NEITHER direction (a surviving 2-cycle would crash the topological
+    sort downstream), and residual longer cycles (inconsistent
+    orderings across different rows) are broken at their
+    weakest-supported edge, deterministically.
+    """
+    assert len(in_span_partitions) == 1
+    _, in_spans = next(iter(in_span_partitions.items()))
+    out_eps = list(out_span_partitions.keys())
+
+    G = _complete_digraph(out_eps)
+    rows = []
+    for in_span in in_spans:
+        outgoing = []
+        for out_ep in out_eps:
+            out_id = assignments.get(out_ep, {}).get(in_span.GetId())
+            if out_id is None or not isinstance(out_id, tuple):
+                continue
+            span = store.all_spans.get(out_id)
+            if span is None:  # NA / SKIP sentinels are 2-tuples too
+                continue
+            outgoing.append((span.start_mus, span.duration_mus, out_ep))
+        if len(outgoing) > 1:
+            rows.append(outgoing)
+
+    tested = set()
+    support: Dict[tuple, int] = {}
+    for outgoing in rows:
+        outgoing.sort(key=lambda x: x[0])
         for i, (xs, xd, xep) in enumerate(outgoing):
             for j, (ys, yd, yep) in enumerate(outgoing):
                 if i == j:
                     continue
+                tested.add((xep, yep))
+                if xs + xd <= ys:  # x completed before y started
+                    support[(xep, yep)] = support.get((xep, yep), 0) + 1
                 if xs + xd > ys and G.has_edge(xep, yep):
                     G.remove_edge(xep, yep)
                 if ys + yd > xs and G.has_edge(yep, xep):
                     G.remove_edge(yep, xep)
 
+    for a in out_eps:
+        for b in out_eps:
+            if a != b and (a, b) not in tested and G.has_edge(a, b):
+                G.remove_edge(a, b)
+    while True:
+        try:
+            cycle = nx.find_cycle(G)
+        except nx.NetworkXNoCycle:
+            break
+        weakest = min(cycle, key=lambda e: (support.get(e, 0), e))
+        G.remove_edge(*weakest)
     return G
+
+
+def discover_invocation_dag(
+    in_span_partitions: Dict[str, List[Span]],
+    out_span_partitions: Dict[str, List[Span]],
+    store: TraceStore,
+    solver,
+    method: str = "MaxScoreBatchSubsetWithSkips",
+    max_iters: int = 3,
+) -> nx.DiGraph:
+    """GROUND-TRUTH-FREE invocation-DAG discovery (the capability the
+    reference sketches but never wires: ``FindConstraintsUsingFit``,
+    executor.py:152-212 — dead code there, production here).
+
+    EM over structure: solve once with the unconstrained DAG (every
+    endpoint scored from the incoming span), prune precedence edges
+    contradicted by the PREDICTED assignments
+    (:func:`infer_dag_from_predictions`), re-solve under the pruned DAG,
+    and repeat until the edge set reaches a fixed point (typically one
+    refinement). No step reads ``true_assignments`` — the empty dict is
+    passed where the plugin signature demands one (the flagship only
+    dereferences it for the true-skips/true-dist oracles).
+    """
+    import copy
+
+    out_eps = list(out_span_partitions)
+    empty_truth = {ep: {} for ep in out_eps}
+    dag = nx.DiGraph()
+    dag.add_nodes_from(out_eps)
+
+    prev_edges = None
+    for _ in range(max_iters):
+        out = solver.FindAssignments(
+            method, "gt-free-dag",
+            copy.deepcopy(in_span_partitions),
+            copy.deepcopy(out_span_partitions),
+            False, [], empty_truth, dag,
+        )
+        pred = out[0] if isinstance(out, tuple) else out
+        new_dag = infer_dag_from_predictions(
+            in_span_partitions, out_span_partitions, pred, store)
+        edges = frozenset(new_dag.edges())
+        if edges == prev_edges:
+            break
+        prev_edges = edges
+        dag = new_dag
+    return dag
 
 
 def fit_invocation_dag(out_span_partitions: Dict[str, List[Span]], evaluate,

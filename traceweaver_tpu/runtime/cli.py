@@ -164,6 +164,10 @@ def main(argv=None) -> int:
         # a flag, to keep the reference CLI surface byte-compatible.
         mesh_devices=_parse_mesh_devices(
             os.environ.get("TW_MESH_DEVICES", "0")),
+        # TW_GT_FREE_DAG=1: ground-truth-free invocation-DAG discovery
+        # (ingest.discover_invocation_dag); env for the same reason
+        gt_free_dag=os.environ.get("TW_GT_FREE_DAG", "")
+        not in ("", "0", "false"),
     )
     run_experiment(cfg)  # prints per-method accuracy as it goes
     return 0

@@ -81,6 +81,13 @@ class ExecutorConfig:
     # batches over it (0 = single device). The CLI maps TW_MESH_DEVICES
     # onto this; tests/dryrun use the 8-virtual-CPU-device stand-in
     mesh_devices: int = 0
+    # GROUND-TRUTH-FREE invocation-DAG discovery: infer each service's
+    # precedence DAG by EM over structure (ingest.discover_invocation_dag
+    # — the capability the reference sketches as dead code,
+    # FindConstraintsUsingFit, executor.py:152-212) instead of from
+    # true_assignments. Ground truth is then used for GRADING only. The
+    # CLI maps TW_GT_FREE_DAG=1 onto this.
+    gt_free_dag: bool = False
     predictor_indices: List[int] = field(default_factory=list)
     max_traces: int = 1000
     # replica table for compress-factor scaling; absent in the reference
@@ -122,10 +129,29 @@ def _prepare_service(cfg: ExecutorConfig, store: TraceStore, method: str,
     true_assignments = get_ground_truth(
         prob.in_span_partitions, prob.out_span_partitions
     )
-    invocation_graph = infer_invocation_dag(
-        prob.in_span_partitions, prob.out_span_partitions, true_assignments,
-        store,
-    )
+    if cfg.gt_free_dag:
+        # discovery costs up to 3 full solves and is method-independent:
+        # memoize per service on the store so a multi-method sweep pays
+        # it once, not once per (method, predictor)
+        cache = getattr(store, "_gt_free_dag_cache", None)
+        if cache is None:
+            cache = {}
+            store._gt_free_dag_cache = cache
+        invocation_graph = cache.get(process)
+        if invocation_graph is None:
+            from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
+            from traceweaver_tpu.ingest import discover_invocation_dag
+
+            invocation_graph = discover_invocation_dag(
+                prob.in_span_partitions, prob.out_span_partitions, store,
+                WeaverTPU(store.all_spans, store.all_processes),
+            )
+            cache[process] = invocation_graph
+    else:
+        invocation_graph = infer_invocation_dag(
+            prob.in_span_partitions, prob.out_span_partitions,
+            true_assignments, store,
+        )
 
     if cfg.compress_factor > 1:
         replicas = cfg.replica_count(process, store)
