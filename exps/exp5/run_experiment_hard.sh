@@ -1,0 +1,35 @@
+#!/bin/bash
+# exp5-hard — the Alibaba scale sweep on the MESSY corpus (VERDICT r4 #5):
+# same 15-CG x compress {1,200,1000,4000,10000,15000} ladder as
+# run_experiment.sh, but over data/alibaba_microservices_hard — generated
+# with the real-clusterdata defect profile (multi-invocation callees,
+# '(?)' fields, mirrored duplicates, orphans, multi-roots; ~11% of traces
+# structurally corrupt and rejected by the repair pipeline, the rest
+# repaired). Regenerate the corpus with:
+#   python -m traceweaver_tpu.alibaba.synthesize \
+#       --out $TW_DATA/alibaba_microservices_hard/call_graph_data --messy
+# Produces fig6a_hard.pdf / fig6b_hard.pdf beside the clean-corpus figures.
+set -u
+source "$(dirname "$0")/../common.sh"
+
+clear_cache="${1:-0}"
+suffix="load_multiple"
+results_directory="$(cd "$(dirname "$0")" && pwd)/results_hard/"
+rm -rf "$results_directory" && mkdir -p "$results_directory"
+predictor_indices="3,4,7,10"
+
+if [ ! -d "$TW_DATA/alibaba_microservices_hard/call_graph_data/call_graph_0" ]; then
+    echo "hard corpus not found under $TW_DATA — see header" >&2
+    exit 1
+fi
+
+for compress in 1 200 1000 4000 10000 15000; do
+    for cg in 0 1 2 3 4 5 6 7 8 9 10 11 12 13 14; do
+        run_executor "alibaba_microservices_hard/call_graph_data/call_graph_$cg" 0 0 5 "alibaba_cg_${cg}_$suffix" 1 "$compress" 1 0 "$results_directory" "$clear_cache" "$predictor_indices"
+    done
+    wait
+done
+echo "All tests have concluded."
+
+python3 "$REPO_ROOT/utils/plot_accuracy_vs_load_multiple_cgs.py" "$results_directory" "$suffix" "$results_directory/fig6a_hard.pdf"
+python3 "$REPO_ROOT/utils/plot_accuracy_vs_confidence_multiple_cgs.py" "$results_directory" "$suffix" "$results_directory/fig6b_hard.pdf"

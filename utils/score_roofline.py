@@ -143,7 +143,11 @@ def main() -> None:
                 by["full"]["warm_s"] / by["bounded"]["warm_s"], 2),
             "gemm_speedup_vs_bounded": round(
                 by["bounded"]["warm_s"] / by["gemm"]["warm_s"], 2),
-            "accuracy_equal": len({r["accuracy"] for r in results}) == 1,
+            # gemm is documented to differ numerically; the
+            # bit-compatibility claim is full vs bounded only
+            "accuracy_equal_full_vs_bounded": (
+                by["full"]["accuracy"] == by["bounded"]["accuracy"]),
+            "gemm_accuracy": by["gemm"]["accuracy"],
         }, indent=1), flush=True)
 
 
