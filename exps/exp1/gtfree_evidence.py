@@ -1,0 +1,88 @@
+"""GT-free invocation-DAG evidence over the exp1 grid (VERDICT r4 #6).
+
+Runs the flagship twice per (app, load) exp1 configuration — once with
+the ground-truth-derived invocation DAG (the reference's FindOrder
+semantics) and once with TW_GT_FREE_DAG-style discovery
+(``ingest.discover_invocation_dag``, which never reads true
+assignments) — and reports the e2e accuracy delta. Acceptance bar:
+within 1 pt everywhere.
+
+Writes ``exps/exp1/results_gtfree/gtfree_evidence.json`` and prints a
+table. Usage: ``python exps/exp1/gtfree_evidence.py [--loads 25,75,150]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+APPS = (
+    ("hotel", "/root/reference/data/hotel_reservation/hotel_load{load}", 2),
+    ("node", "/root/reference/data/nodejs_microservices/node_load{load}", 0),
+    ("media", "/root/reference/data/media_microservices/media_load{load}", 1),
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--loads", default="25,75,150")
+    ap.add_argument("--max-traces", type=int, default=1000)
+    args = ap.parse_args()
+    loads = [int(x) for x in args.loads.split(",")]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from traceweaver_tpu.ingest import load_corpus
+    from traceweaver_tpu.runtime.executor import ExecutorConfig, run_experiment
+    from traceweaver_tpu.runtime.jax_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+    rows = []
+    for app, tmpl, fix in APPS:
+        for load in loads:
+            path = tmpl.format(load=load)
+            if not os.path.isdir(path):
+                continue
+            store = load_corpus(path, fix=fix, max_traces=args.max_traces,
+                                cache=True)
+
+            def run(gt_free):
+                cfg = ExecutorConfig(
+                    data_path="", results_directory="", fix=fix,
+                    cache_rate=0.0, test_name="gtfree",
+                    predictor_indices=[10], gt_free_dag=gt_free,
+                )
+                res = run_experiment(cfg, store=store)
+                return res.accuracy_overall["MaxScoreBatchSubsetWithSkips"]
+
+            gt = run(False)
+            free = run(True)
+            rows.append(dict(app=app, load=load, gt_dag=round(gt, 2),
+                             gt_free=round(free, 2),
+                             delta=round(free - gt, 2)))
+            print(f"{app}_load{load}: GT-DAG {gt:.2f}%  GT-free {free:.2f}%"
+                  f"  delta {free - gt:+.2f}", flush=True)
+
+    worst = min((r["delta"] for r in rows), default=0.0)
+    out_dir = os.path.join(REPO, "exps", "exp1", "results_gtfree")
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "gtfree_evidence.json"), "w") as f:
+        json.dump({"rows": rows, "worst_delta_pts": worst}, f, indent=1)
+    print(json.dumps({"worst_delta_pts": worst, "n_configs": len(rows)}))
+    # enforce the acceptance bar: a vacuous grid or a >1pt loss must fail
+    # the invocation, not just print numbers
+    if not rows or worst < -1.0:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,8 +36,12 @@ def test_prediction_pruning_equals_gt_pruning_on_truth():
                               prob.out_span_partitions)
         g_true = infer_invocation_dag(
             prob.in_span_partitions, prob.out_span_partitions, ta, store)
+        # tol=0 restores strict any-contradiction pruning — with noiseless
+        # truth the two variants must agree exactly (production uses a
+        # small tolerance so one wrong prediction can't delete an edge)
         g_pred = infer_dag_from_predictions(
-            prob.in_span_partitions, prob.out_span_partitions, ta, store)
+            prob.in_span_partitions, prob.out_span_partitions, ta, store,
+            tol=0.0)
         assert set(g_true.edges()) == set(g_pred.edges()), svc
 
 

@@ -96,6 +96,7 @@ def infer_dag_from_predictions(
     out_span_partitions: Dict[str, List[Span]],
     assignments: Dict[str, Dict],
     store: TraceStore,
+    tol: float = 0.05,
 ) -> nx.DiGraph:
     """The same contradiction pruning, driven by PREDICTED assignments.
 
@@ -105,14 +106,21 @@ def infer_dag_from_predictions(
     span child lookups, so wrong-but-real assignments still prune the
     intended endpoint pair.
 
-    Unlike truth rows (which always contain every endpoint), prediction
-    rows can MISS endpoints, so the complete-digraph seed needs two
-    guards the ground-truth variant never does: endpoint pairs that
-    never co-occur in any row carry no ordering evidence and keep
-    NEITHER direction (a surviving 2-cycle would crash the topological
-    sort downstream), and residual longer cycles (inconsistent
-    orderings across different rows) are broken at their
-    weakest-supported edge, deterministically.
+    Unlike truth rows, prediction rows carry two kinds of noise the
+    ground-truth variant never sees, each with its own guard:
+
+    - rows can MISS endpoints (NA/SKIP): endpoint pairs that never
+      co-occur in any row carry no ordering evidence and keep NEITHER
+      direction (a surviving 2-cycle would crash the topological sort
+      downstream); residual longer cycles (inconsistent orderings
+      across different rows) are broken at their weakest-supported
+      edge, deterministically;
+    - individual assignments can be WRONG: one bad assignment must not
+      delete a true precedence edge, so an edge is pruned only when
+      contradicted in more than ``tol`` of its co-occurrence rows
+      (truth uses strict any-contradiction; truly-parallel endpoint
+      pairs overlap in far more rows than any plausible error rate, so
+      false edges still die).
     """
     assert len(in_span_partitions) == 1
     _, in_spans = next(iter(in_span_partitions.items()))
@@ -133,7 +141,8 @@ def infer_dag_from_predictions(
         if len(outgoing) > 1:
             rows.append(outgoing)
 
-    tested = set()
+    cooccur: Dict[tuple, int] = {}
+    contra: Dict[tuple, int] = {}
     support: Dict[tuple, int] = {}
     for outgoing in rows:
         outgoing.sort(key=lambda x: x[0])
@@ -141,17 +150,23 @@ def infer_dag_from_predictions(
             for j, (ys, yd, yep) in enumerate(outgoing):
                 if i == j:
                     continue
-                tested.add((xep, yep))
+                # the full i != j cross product visits every ordered pair
+                # once per row, so each directed key is counted exactly
+                # once here — adding a symmetric reverse-direction branch
+                # would double contra relative to cooccur and silently
+                # halve the effective tolerance
+                cooccur[(xep, yep)] = cooccur.get((xep, yep), 0) + 1
                 if xs + xd <= ys:  # x completed before y started
                     support[(xep, yep)] = support.get((xep, yep), 0) + 1
-                if xs + xd > ys and G.has_edge(xep, yep):
-                    G.remove_edge(xep, yep)
-                if ys + yd > xs and G.has_edge(yep, xep):
-                    G.remove_edge(yep, xep)
+                else:              # overlap contradicts edge (x -> y)
+                    contra[(xep, yep)] = contra.get((xep, yep), 0) + 1
 
     for a in out_eps:
         for b in out_eps:
-            if a != b and (a, b) not in tested and G.has_edge(a, b):
+            if a == b or not G.has_edge(a, b):
+                continue
+            n = cooccur.get((a, b), 0)
+            if n == 0 or contra.get((a, b), 0) > tol * n:
                 G.remove_edge(a, b)
     while True:
         try:
