@@ -308,3 +308,44 @@ def test_sinkhorn_tol_default_matches_exact_potentials(hotel_store):
     for svc in extras_tol:
         assert extras_tol[svc][0][0] == extras_exact[svc][0][0], (
             f"tolerance flipped an assignment on {svc}")
+
+
+def test_bounded_neighbour_score_build_identical_to_full():
+    """The production score build gathers only real DAG neighbours
+    (static max in/out degree); it must reproduce the unbounded
+    all-endpoints sum exactly — gathered entries are the mask-true
+    entries, padding contributes 0.0 (docs/ROOFLINE.md measured 1.70x
+    from this; identity is the contract)."""
+    import jax.numpy as jnp
+
+    from traceweaver_tpu.algorithms.weaver_tpu import solve_windows
+
+    rng = np.random.default_rng(0)
+    B, E, W, M, K = 2, 4, 8, 8, 3
+    in_start = jnp.asarray(
+        np.sort(rng.uniform(0, 100, (B, W)), axis=1).astype(np.float32))
+    in_end = in_start + 50
+    out_start = jnp.asarray(
+        np.sort(rng.uniform(0, 120, (B, E, M)), axis=2).astype(np.float32))
+    pred_mask = np.zeros((E, E), bool)
+    pred_mask[1, 0] = pred_mask[2, 1] = pred_mask[3, 1] = True  # branching
+    root_mask = np.array([True, False, False, False])
+    is_last = np.array([False, False, False, True])
+    wt = np.zeros((E, E, K), np.float32); wt[..., 0] = 1
+    mu = np.full((E, E, K), 10.0, np.float32)
+    sd = np.full((E, E, K), 5.0, np.float32)
+    iwt = np.zeros((E, K), np.float32); iwt[:, 0] = 1
+    imu = np.full((E, K), 10.0, np.float32)
+    isd = np.full((E, K), 5.0, np.float32)
+    args = (in_start, in_end, jnp.ones((B, W), bool),
+            out_start, out_start + 5, jnp.ones((B, E, M), bool),
+            jnp.zeros((B, E), jnp.float32), jnp.zeros((B, E, W), bool),
+            jnp.asarray(pred_mask), jnp.asarray(root_mask),
+            jnp.asarray(is_last),
+            jnp.asarray(wt), jnp.asarray(mu), jnp.asarray(sd),
+            jnp.asarray(iwt), jnp.asarray(imu), jnp.asarray(isd),
+            jnp.asarray(iwt), jnp.asarray(imu), jnp.asarray(isd))
+    full = solve_windows(*args)  # max_preds/max_succs = 0 -> all E
+    bounded = solve_windows(*args, max_preds=2, max_succs=2)
+    for a, b in zip(full, bounded):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
