@@ -91,16 +91,19 @@ EXACT_ALARM_SECONDS = int(os.environ.get("TW_BENCH_EXACT_ALARM", "95"))
 DEADLINE = int(os.environ.get("TW_BENCH_DEADLINE", "780"))
 # How long the solver child may sit inside backend init before the
 # parent declares the remote backend down. Evidence base: a DOWN axon
-# does not init slowly — it blocks 30-40 min and then raises UNAVAILABLE
-# (observed twice, round 4); when axon was healthy (round 2) the whole
-# child — init + cold compile + solve — fit well inside a 540 s budget.
-# 180 s therefore gives a degraded-but-healthy relay generous room while
-# still converting a down backend into CPU budget. Raise via env on
-# relay-saturated deployments.
-BACKEND_UP_BUDGET = int(os.environ.get("TW_BENCH_BACKEND_UP", "180"))
+# does not init slowly — it blocks 25-40 min and then raises UNAVAILABLE
+# (observed twice in round 4 and all of round 5's watcher probes); when
+# axon was healthy (round 2) init + cold compile together took ~15 s
+# (BENCH_r02 warmup_compile_s) and the whole child fit inside 85 s.
+# 120 s therefore still gives a degraded-but-healthy relay ~8x headroom
+# while converting a down backend into CPU budget early enough that the
+# FULL two-app CPU leg fits the envelope on a 1-core host (round-5 host:
+# warm full leg ~280 s measured). Raise via env on relay-saturated
+# deployments.
+BACKEND_UP_BUDGET = int(os.environ.get("TW_BENCH_BACKEND_UP", "120"))
 # reserves the parent holds back when budgeting earlier phases
 CPU_FALLBACK_RESERVE = int(os.environ.get("TW_BENCH_CPU_RESERVE", "170"))
-BASELINE_RESERVE = int(os.environ.get("TW_BENCH_BASELINE_RESERVE", "130"))
+BASELINE_RESERVE = int(os.environ.get("TW_BENCH_BASELINE_RESERVE", "110"))
 MERGE_SLACK = 20
 TPU_TIMEOUT_CAP = int(os.environ.get("TW_BENCH_TPU_TIMEOUT", "480"))
 
@@ -764,18 +767,44 @@ def main() -> None:
     # --- phase 2: CPU fallback only if the TPU leg produced nothing.
     # Scope depends on what budget the failed phase left behind: a fast
     # backend-down detection leaves enough for the FULL two-app workload
-    # (measured ~350-400 s on a 1-core host, warm disk cache); otherwise
-    # fall back to hotel-only, which provably finishes in its slice
-    # (media nginx alone costs ~410 s on a cold CPU path) --------------
+    # (warm compile cache ~245 s on the round-5 1-core host; ~345+ s
+    # cold); otherwise fall back to hotel-only, which provably finishes
+    # in its slice -----------------------------------------------------
     reduced_scope = False
     if solver is None and default_backend != "cpu":
         # scope ladder: try FULL only when the budget covers it PLUS a
         # reduced retry (the full leg's first report lands only after its
         # whole timed pass, so a mid-pass kill yields nothing — the
-        # reduced retry is the guarantee the old hotel-only fallback gave)
-        full_needs = int(os.environ.get("TW_BENCH_CPU_FULL_NEEDS", "430"))
+        # reduced retry is the guarantee the old hotel-only fallback gave).
+        # full_needs, measured on the round-5 1-core host
+        # (BENCH_r05_builder_cpu / the dress-rehearsal log): WARM cache
+        # warmup ~105 s + timed pass ~90 s + subset ~3 s ≈ 200-245 s;
+        # COLD cache 175 + 120 + 50 ≈ 345+ s. The cheap default applies
+        # only when this host's CPU cache dir already has entries —
+        # a cold host keeps the conservative bar so it never burns the
+        # reduced retry's slice on a doomed full attempt.
+        from traceweaver_tpu.runtime.jax_cache import (
+            DEFAULT_CACHE_DIR, host_cache_key,
+        )
+
+        # evaluate the cache key AS THE CPU CHILD will see it (the child
+        # is spawned with JAX_PLATFORMS=cpu; the key embeds that)
+        saved = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            cpu_key = host_cache_key()
+        finally:
+            if saved is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = saved
+        cpu_cache = os.path.join(
+            os.environ.get("TW_JAX_CACHE_DIR", DEFAULT_CACHE_DIR), cpu_key)
+        cache_primed = os.path.isdir(cpu_cache) and bool(os.listdir(cpu_cache))
+        full_needs = int(os.environ.get(
+            "TW_BENCH_CPU_FULL_NEEDS", "320" if cache_primed else "430"))
         retry_reserve = int(os.environ.get("TW_BENCH_CPU_RETRY_RESERVE",
-                                           "150"))
+                                           "130"))
         scopes = []
         if (remaining(deadline_ts) - BASELINE_RESERVE - MERGE_SLACK
                 - retry_reserve > full_needs):
