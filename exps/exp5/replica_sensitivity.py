@@ -1,0 +1,95 @@
+"""Replica-distribution sensitivity for the exp5 ladder (ADVICE r4).
+
+The regenerated ``service_to_replica_new.pickle`` artifact assumes a
+log-uniform 16-128 replica distribution; the real artifact's contents
+are unknown, and the exp5 top-rung absolute accuracies scale with the
+assumption. This harness re-runs the STRESSED rungs (compress 4000 /
+10000 / 15000, where replica scaling matters — the lower rungs are at
+~100 % under any distribution) over all 15 call graphs with an
+ALTERNATE distribution (``fixed-64``: every service exactly 64
+replicas) and reports the flagship-vs-baseline separation under both,
+so the headline claim ("clear separation at every stressed rung") is
+shown to be robust to the assumption rather than an artifact of it.
+
+Writes ``exps/exp5/results_sensitivity/replica_sensitivity.json``.
+Usage: ``python exps/exp5/replica_sensitivity.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+DATA = os.path.join(REPO, "data", "alibaba_microservices", "call_graph_data")
+RUNGS = (4000, 10000, 15000)
+PREDICTORS = [3, 4, 10]  # WAP5, FCFS, flagship
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from traceweaver_tpu.alibaba.synthesize import replica_counts
+    from traceweaver_tpu.ingest import load_corpus
+    from traceweaver_tpu.runtime.executor import ExecutorConfig, run_experiment
+    from traceweaver_tpu.runtime.jax_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+    services = [f"MS_{i:05d}" for i in range(60)]
+    table = {
+        svc: [f"{svc}.r{i}" for i in range(n)]
+        for svc, n in replica_counts(services, seed=10, dist="fixed-64").items()
+    }
+
+    cgs = sorted(d for d in os.listdir(DATA) if d.startswith("call_graph"))
+    acc: dict = {}
+    for compress in RUNGS:
+        per_method: dict = {}
+        for cg in cgs:
+            store = load_corpus(os.path.join(DATA, cg), fix=5,
+                                max_traces=1000, cache=True)
+            cfg = ExecutorConfig(
+                data_path="", results_directory="", fix=5, cache_rate=0.0,
+                test_name="sens", compress_factor=compress,
+                predictor_indices=PREDICTORS, service_to_replica=table,
+            )
+            res = run_experiment(cfg, store=store)
+            for method, a in res.accuracy_overall.items():
+                if "TopK" in method:
+                    continue
+                per_method.setdefault(method, []).append(a)
+        acc[compress] = {
+            m: round(sum(v) / len(v), 1) for m, v in per_method.items()
+        }
+        print(f"fixed-64 x{compress}: {acc[compress]}", flush=True)
+
+    out_dir = os.path.join(REPO, "exps", "exp5", "results_sensitivity")
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "replica_sensitivity.json"), "w") as f:
+        json.dump({"distribution": "fixed-64", "rungs": acc,
+                   "loguniform_16_128_reference_ladder": {
+                       4000: {"MaxScoreBatchSubsetWithSkips": 99.8,
+                              "FCFS": 97.4, "WAP5": 15.2},
+                       10000: {"MaxScoreBatchSubsetWithSkips": 97.7,
+                               "FCFS": 77.3, "WAP5": 3.0},
+                       15000: {"MaxScoreBatchSubsetWithSkips": 92.9,
+                               "FCFS": 60.5, "WAP5": 0.8}}}, f, indent=1)
+    # separation must hold at every stressed rung under the alternate
+    # distribution too
+    for compress in RUNGS:
+        flag = acc[compress].get("MaxScoreBatchSubsetWithSkips", 0.0)
+        fcfs = acc[compress].get("FCFS", 100.0)
+        if flag <= fcfs:
+            print(f"SEPARATION LOST at x{compress}: {flag} <= {fcfs}")
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
