@@ -30,6 +30,27 @@ import jax.numpy as jnp
 
 NEG = -1.0e9
 
+# VMEM sizing. The default Mosaic scoped-vmem limit is 16 MB; a gridded
+# (vmapped) call double-buffers the in and out blocks across grid steps,
+# so a padded [N, M] f32 block needs ~4x its size in scoped VMEM plus
+# temporaries (the bench fleet block 1032x1152 costs 19.5 MB and tripped
+# the default limit on chip). Budget 6x the block, capped well under the
+# v5e's 128 MB/core; blocks whose 6x estimate cannot fit under the cap
+# take the XLA path instead (sinkhorn() gate).
+_VMEM_CAP_BYTES = int(os.environ.get("TW_PALLAS_VMEM_CAP",
+                                     str(96 * 1024 * 1024)))
+_VMEM_FLOOR_BYTES = 32 * 1024 * 1024
+
+
+def _padded_block_bytes(n: int, m: int) -> int:
+    return _round_up(n, 8) * _round_up(m, 128) * 4
+
+
+def fits_pallas_vmem(n: int, m: int) -> bool:
+    """True when the padded [n, m] f32 block's pipeline footprint
+    (~6x block) fits the scoped-VMEM cap."""
+    return 6 * _padded_block_bytes(n, m) <= _VMEM_CAP_BYTES
+
 
 def _kernel(s_ref, r_ref, c_ref, out_ref, *, n_iters: int, inv_eps: float,
             tol_phi: float):
@@ -120,6 +141,8 @@ def sinkhorn_log_pallas(
     kernel = functools.partial(
         _kernel, n_iters=n_iters, inv_eps=1.0 / epsilon,
         tol_phi=tol / epsilon)
+    vmem_budget = min(_VMEM_CAP_BYTES,
+                      max(_VMEM_FLOOR_BYTES, 6 * np_ * mp * 4))
     plan = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((np_, mp), jnp.float32),
@@ -130,6 +153,8 @@ def sinkhorn_log_pallas(
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_budget),
     )(s, r, c)
     return plan[:n, :m].astype(scores.dtype)
 
@@ -165,7 +190,8 @@ def sinkhorn(scores, row_marginals, col_marginals, epsilon=1.0, n_iters=50,
     from traceweaver_tpu.ops.sinkhorn import sinkhorn_log
 
     n, m = scores.shape
-    if not use_pallas() or n * m < 64 * 128:
+    if (not use_pallas() or n * m < 64 * 128
+            or not fits_pallas_vmem(n, m)):
         return sinkhorn_log(scores, row_marginals, col_marginals,
                             epsilon=epsilon, n_iters=n_iters, tol=tol)
     if os.environ.get("TW_PALLAS_INTERPRET") == "1":
