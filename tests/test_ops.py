@@ -348,3 +348,50 @@ def test_solver_early_exit_assignments_identical():
     base = solve_windows(*args, n_sinkhorn=40, n_sweeps=5, sinkhorn_tol=0.0)
     fast = solve_windows(*args, n_sinkhorn=40, n_sweeps=5, sinkhorn_tol=1e-3)
     np.testing.assert_array_equal(np.asarray(base[0]), np.asarray(fast[0]))
+
+
+def test_pallas_vmem_gate(monkeypatch):
+    """Blocks whose padded pipeline footprint (~6x the [N, M] f32 block,
+    double-buffered in+out across grid steps) cannot fit the scoped-VMEM
+    cap must take the XLA path — on chip the fleet-batched bench block
+    tripped Mosaic's 16 MB default before the kernel sized its own
+    budget (commit 795d50f)."""
+    from traceweaver_tpu.ops import pallas_sinkhorn as ps
+
+    # pin the default cap: _VMEM_CAP_BYTES is env-overridable at import
+    monkeypatch.setattr(ps, "_VMEM_CAP_BYTES", 96 * 1024 * 1024)
+    # the bench fleet shape that OOM'd on chip now fits the raised cap
+    assert ps.fits_pallas_vmem(1032, 1152)
+    # a block over the cap must be gated out (cap 96 MB -> 16 MB block)
+    assert not ps.fits_pallas_vmem(4096, 4096)
+    # gate respects lane/sublane padding: 1 x 1 pads to 8 x 128
+    assert ps.fits_pallas_vmem(1, 1)
+
+
+def test_sinkhorn_dispatch_oversized_block_takes_jnp_path(monkeypatch):
+    """With TW_PALLAS=1, an oversized block still routes to sinkhorn_log
+    (no pallas lowering attempted) and produces the jnp answer."""
+    from traceweaver_tpu.ops import pallas_sinkhorn as ps
+    from traceweaver_tpu.ops.sinkhorn import sinkhorn_log
+
+    monkeypatch.setenv("TW_PALLAS", "1")
+    monkeypatch.delenv("TW_PALLAS_INTERPRET", raising=False)
+    monkeypatch.setattr(ps, "fits_pallas_vmem", lambda n, m: False)
+    called = {"pallas": False}
+
+    def boom(*a, **k):
+        called["pallas"] = True
+        raise AssertionError("pallas path must not be taken")
+
+    monkeypatch.setattr(ps, "sinkhorn_log_pallas", boom)
+    rng = np.random.default_rng(3)
+    n, m = 64, 128
+    S = rng.normal(size=(n, m)).astype(np.float32)
+    r = np.ones(n, np.float32)
+    c = np.full(m, n / m, np.float32)
+    got = np.asarray(ps.sinkhorn(jnp.asarray(S), jnp.asarray(r),
+                                 jnp.asarray(c), epsilon=0.9, n_iters=25))
+    want = np.asarray(sinkhorn_log(jnp.asarray(S), jnp.asarray(r),
+                                   jnp.asarray(c), epsilon=0.9, n_iters=25))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    assert not called["pallas"]
