@@ -365,6 +365,7 @@ def test_pallas_vmem_gate(monkeypatch):
     # a block over the cap must be gated out (cap 96 MB -> 16 MB block)
     assert not ps.fits_pallas_vmem(4096, 4096)
     # gate respects lane/sublane padding: 1 x 1 pads to 8 x 128
+    assert ps._padded_block_bytes(1, 1) == 8 * 128 * 4
     assert ps.fits_pallas_vmem(1, 1)
 
 
@@ -395,3 +396,54 @@ def test_sinkhorn_dispatch_oversized_block_takes_jnp_path(monkeypatch):
                                    jnp.asarray(c), epsilon=0.9, n_iters=25))
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
     assert not called["pallas"]
+
+
+def test_topk_peel_matches_lax_top_k():
+    """topk_peel must be bit-identical to lax.top_k (values AND indices,
+    incl. tie order: equal values -> lower index first) — it replaces it
+    in the solver purely to avoid the TPU lane-sort lowering."""
+    from traceweaver_tpu.ops.rounding import topk_peel
+
+    rng = np.random.default_rng(11)
+    # random, with duplicates and NEG-masked cells like a real plan block
+    x = rng.normal(size=(7, 33)).astype(np.float32)
+    x[x < -0.5] = -1.0e9
+    x[2] = -1.0e9                      # fully masked row
+    x[3, :5] = x[3, 10:15] = 0.25      # exact ties across positions
+    for k in (1, 3, 5):
+        pv, pi = topk_peel(jnp.asarray(x), k)
+        lv, li = jax.lax.top_k(jnp.asarray(x), k)
+        np.testing.assert_array_equal(np.asarray(pv), np.asarray(lv))
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(li))
+    # batched (the solver calls it on [W, M+1] inside vmapped windows)
+    xb = rng.normal(size=(4, 9, 130)).astype(np.float32)
+    pv, pi = topk_peel(jnp.asarray(xb), 5)
+    lv, li = jax.lax.top_k(jnp.asarray(xb), 5)
+    np.testing.assert_array_equal(np.asarray(pv), np.asarray(lv))
+    np.testing.assert_array_equal(np.asarray(pi), np.asarray(li))
+
+
+def test_topk_peel_neg_inf_and_k_guard():
+    """-inf inputs (the common JAX mask idiom) must still match
+    lax.top_k exactly; k beyond the lane size raises at trace time as
+    top_k does."""
+    from traceweaver_tpu.ops.rounding import topk_peel
+
+    x = jnp.asarray(np.array(
+        [[5.0, -np.inf, -np.inf],
+         [-np.inf, -np.inf, -np.inf],
+         [2.0, 7.0, -np.inf]], np.float32))
+    for k in (1, 2, 3):
+        pv, pi = topk_peel(x, k)
+        lv, li = jax.lax.top_k(x, k)
+        np.testing.assert_array_equal(np.asarray(pv), np.asarray(lv))
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(li))
+    with pytest.raises(ValueError):
+        topk_peel(x, 4)
+    # k=0 parity: empty arrays like lax.top_k, not a stack error
+    pv, pi = topk_peel(x, 0)
+    assert pv.shape == (3, 0) and pi.shape == (3, 0)
+    # int dtypes are rejected (the -inf mask would promote to f32 where
+    # ints >= 2^24 collide and tie order diverges from top_k)
+    with pytest.raises(TypeError):
+        topk_peel(jnp.asarray(np.array([[1, 2, 3]], np.int32)), 2)

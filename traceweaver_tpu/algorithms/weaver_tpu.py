@@ -53,7 +53,7 @@ from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
 from traceweaver_tpu.algorithms.timing import MAX_COMPONENTS, EdgeDist
 from traceweaver_tpu.metrics.accuracy import get_out_eps_in_order
 from traceweaver_tpu.ops.pallas_sinkhorn import sinkhorn
-from traceweaver_tpu.ops.rounding import greedy_round
+from traceweaver_tpu.ops.rounding import greedy_round, topk_peel
 from traceweaver_tpu.ops.scores import mixture_logpdf, pair_scores
 from traceweaver_tpu.spans import NA, SKIP, Span
 
@@ -244,7 +244,10 @@ def _solve_windows_impl(
             # with negligible mass (timing-infeasible: score NEG -> plan
             # ~ 0) are dropped to -1 so cross-window duplicate resolution
             # can never fall back onto an infeasible out-span
-            tk_mass, tk = jax.lax.top_k(
+            # exact top_k via k argmax+mask passes: lax.top_k lowers to a
+            # full lane sort on TPU (~20 % of device busy, sort.47 in
+            # PROFILE_r05_tpu.json); identical outputs incl. tie order
+            tk_mass, tk = topk_peel(
                 jnp.where(col_valid[None, :], plan, NEG), topk)
             tk = jnp.where(tk_mass > MIN_TOPK_MASS, tk, -1)
 

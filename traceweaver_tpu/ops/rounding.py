@@ -114,3 +114,53 @@ def greedy_round(
             jnp.asarray(True))
     _, assign, _, _, _ = jax.lax.while_loop(cond, body, init)
     return assign
+
+
+@partial(jax.jit, static_argnames=("k",))
+def topk_peel(x: jnp.ndarray, k: int):
+    """Exact ``jax.lax.top_k`` for small static ``k`` via k argmax+mask
+    passes over the last axis.
+
+    XLA lowers ``top_k`` on TPU to a full variadic sort of the lane axis
+    (measured at ~20 % of device-busy time on the bench workload for a
+    [W, M+1] plan block, PROFILE_r05_tpu.json ``sort.47``); k passes of a
+    max-reduction are O(k) lane sweeps instead of the sort network's
+    O(log^2 M). Tie-breaking matches ``top_k`` (equal values yield the
+    lower index first — argmax picks the first occurrence and each pass
+    masks only the picked position), including ``-inf`` inputs: a pass
+    whose masked maximum is ``-inf`` cannot trust argmax (picked
+    positions share the sentinel), so it falls back to the first
+    *unpicked* index and returns the original value there — exactly the
+    index order ``top_k`` emits for trailing ``-inf`` entries.
+
+    One contract caveat vs ``top_k``: ties are broken by ``argmax``'s
+    value equality, so ``-0.0`` and ``0.0`` tie here where ``top_k``'s
+    total-order sort ranks ``0.0`` first — irrelevant for the solver's
+    plan blocks (non-negative masses; near-zero candidates are dropped
+    by the ``MIN_TOPK_MASS`` filter) but not bit-identical for inputs
+    that mix signed zeros.
+    """
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        # the -inf mask would promote integer comparisons to float32,
+        # where ints >= 2^24 collide and the tie order diverges from
+        # lax.top_k's total-order sort
+        raise TypeError(f"topk_peel: floating dtype required, got {x.dtype}")
+    if k > x.shape[-1]:
+        raise ValueError(
+            f"topk_peel: k={k} > last-axis size {x.shape[-1]}")
+    if k == 0:
+        empty = x.shape[:-1] + (0,)
+        return (jnp.zeros(empty, x.dtype), jnp.zeros(empty, jnp.int32))
+    vals, idxs = [], []
+    iota = jnp.arange(x.shape[-1])
+    picked = jnp.zeros(x.shape, bool)
+    for _ in range(k):
+        masked = jnp.where(picked, -jnp.inf, x)
+        i = jnp.argmax(masked, axis=-1)
+        mv = jnp.take_along_axis(masked, i[..., None], -1)[..., 0]
+        first_unpicked = jnp.argmax(~picked, axis=-1)
+        i = jnp.where(jnp.isneginf(mv), first_unpicked, i)
+        vals.append(jnp.take_along_axis(x, i[..., None], -1)[..., 0])
+        idxs.append(i)
+        picked = picked | (iota == i[..., None])
+    return jnp.stack(vals, -1), jnp.stack(idxs, -1).astype(jnp.int32)
