@@ -91,3 +91,28 @@ def test_flagship_accuracy_within_1pt_of_gt_dag_path(path, fix):
     assert free >= gt - 1.0, (
         f"GT-free DAG path {free:.2f}% vs GT-DAG {gt:.2f}% "
         f"(> 1 pt loss) on {path}")
+
+
+def test_adaptive_tol_widens_on_bimodal_rates_only():
+    """The prune tolerance must widen to the largest-gap midpoint on a
+    clearly bimodal contradiction-rate spectrum (the measured hotel
+    frontend load150x10 rates below: true edges 0.02/0.135/0.28 vs
+    parallel pairs 0.782/0.88/0.988) and stand pat otherwise."""
+    from traceweaver_tpu.ingest.order import _adaptive_tol
+
+    measured = [0.020, 0.135, 0.280, 0.782, 0.880, 0.988]
+    t = _adaptive_tol(measured, 0.05)
+    assert abs(t - (0.280 + 0.782) / 2) < 1e-12
+    # edge-free fan-out service: low cluster is parallelism (>= 0.35),
+    # the floor stands and every pair still gets pruned
+    assert _adaptive_tol([0.5, 0.9], 0.05) == 0.05
+    # skewed-but-parallel pair (b tends to start after a: contra 0.40)
+    # must NOT anchor a fake bimodal spectrum — ambiguous band, floor
+    assert _adaptive_tol([0.02, 0.40, 0.95], 0.05) == 0.05
+    # no wide gap: floor stands
+    assert _adaptive_tol([0.2, 0.4, 0.45], 0.05) == 0.05
+    # degenerate spectra: floor stands
+    assert _adaptive_tol([0.3], 0.05) == 0.05
+    assert _adaptive_tol([], 0.05) == 0.05
+    # never returns below the floor
+    assert _adaptive_tol([0.0, 0.9], 0.5) == 0.5

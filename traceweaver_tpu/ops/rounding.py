@@ -154,12 +154,17 @@ def topk_peel(x: jnp.ndarray, k: int):
     vals, idxs = [], []
     iota = jnp.arange(x.shape[-1])
     picked = jnp.zeros(x.shape, bool)
-    for _ in range(k):
+    for step in range(k):
         masked = jnp.where(picked, -jnp.inf, x)
         i = jnp.argmax(masked, axis=-1)
-        mv = jnp.take_along_axis(masked, i[..., None], -1)[..., 0]
-        first_unpicked = jnp.argmax(~picked, axis=-1)
-        i = jnp.where(jnp.isneginf(mv), first_unpicked, i)
+        if step > 0:
+            # pass 0 needs no fallback: nothing is picked yet, so an
+            # all--inf row's argmax is already index 0 — top_k's answer.
+            # (Also keeps XLA from constant-folding an argmax over the
+            # constant all-False mask, ~12 s of compile time at W=1024.)
+            mv = jnp.take_along_axis(masked, i[..., None], -1)[..., 0]
+            first_unpicked = jnp.argmax(~picked, axis=-1)
+            i = jnp.where(jnp.isneginf(mv), first_unpicked, i)
         vals.append(jnp.take_along_axis(x, i[..., None], -1)[..., 0])
         idxs.append(i)
         picked = picked | (iota == i[..., None])
