@@ -116,3 +116,55 @@ def test_adaptive_tol_widens_on_bimodal_rates_only():
     assert _adaptive_tol([], 0.05) == 0.05
     # never returns below the floor
     assert _adaptive_tol([0.0, 0.9], 0.5) == 0.5
+
+
+def test_adaptive_pruning_integration_on_synthetic_noisy_rows():
+    """End-to-end through infer_dag_from_predictions: a true edge whose
+    contradiction rate (0.2) sits far above the fixed 5% tolerance must
+    survive when the spectrum is bimodal, while skewed/parallel pairs
+    (0.7/1.0) are pruned; explicit tol=0.0 stays strict."""
+    from traceweaver_tpu.spans import Span, TraceStore
+
+    store = TraceStore()
+    in_spans, assign = [], {"A": {}, "B": {}, "C": {}}
+    parts = {"A": [], "B": [], "C": []}
+    for i in range(100):
+        t = float(i * 1000)
+        s_in = Span(f"t{i}", "in", t, 500.0, None, [], "p", "server")
+        in_spans.append(s_in)
+        # A: [t+10, t+40]
+        spans = {"A": Span(f"t{i}", "a", t + 10, 30.0, None, [], "p",
+                           "client")}
+        # B truly follows A, but 20% of rows carry noisy overlap
+        b_start = t + 20 if i % 5 == 0 else t + 50
+        spans["B"] = Span(f"t{i}", "b", b_start, 30.0, None, [], "p",
+                          "client")
+        # C: skewed-parallel — overlaps A and B in 70% of rows
+        c_start = t + 15 if i % 10 < 7 else t + 200
+        c_dur = 100.0 if i % 10 < 7 else 30.0
+        spans["C"] = Span(f"t{i}", "c", c_start, c_dur, None, [], "p",
+                          "client")
+        for ep, sp in spans.items():
+            store.all_spans[sp.GetId()] = sp
+            parts[ep].append(sp)
+            assign[ep][s_in.GetId()] = sp.GetId()
+    in_parts = {"IN": in_spans}
+
+    # D co-occurs in only 3 rows (NA elsewhere) with 1 contradiction vs A
+    # (rate 1/3): statistically worthless — it must neither anchor the
+    # bimodality spectrum nor ride the widened tolerance
+    assign["D"] = {}
+    parts["D"] = []
+    for i in (0, 11, 22):
+        t = float(i * 1000)
+        d_start = t + 30 if i == 0 else t + 300  # i=0 overlaps A
+        sp = Span(f"t{i}", "d", d_start, 20.0, None, [], "p", "client")
+        store.all_spans[sp.GetId()] = sp
+        parts["D"].append(sp)
+        assign["D"][in_spans[i].GetId()] = sp.GetId()
+
+    g = infer_dag_from_predictions(in_parts, parts, assign, store)
+    assert set(g.edges()) == {("A", "B")}
+    g_strict = infer_dag_from_predictions(in_parts, parts, assign, store,
+                                          tol=0.0)
+    assert set(g_strict.edges()) == set()

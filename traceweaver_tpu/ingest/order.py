@@ -201,14 +201,23 @@ def infer_dag_from_predictions(
                 else:              # overlap contradicts edge (x -> y)
                     contra[(xep, yep)] = contra.get((xep, yep), 0) + 1
 
-    rates = [contra.get(k, 0) / n for k, n in cooccur.items() if n > 0]
-    tol_eff = _adaptive_tol(rates, tol)
+    # tol=0 is an explicit request for strict any-contradiction pruning
+    # (the truth-equivalence contract) — never widened adaptively.
+    # Low-support pairs (common under NA/SKIP-heavy predictions) carry
+    # statistically worthless rates: a 3-row pair at 1/3 must neither
+    # anchor the bimodality spectrum nor enjoy the widened tolerance, so
+    # pairs under MIN_SUPPORT rows are judged at the fixed tol only.
+    MIN_SUPPORT = 20
+    rates = [contra.get(k, 0) / n
+             for k, n in cooccur.items() if n >= MIN_SUPPORT]
+    tol_eff = _adaptive_tol(rates, tol) if tol > 0 else 0.0
     for a in out_eps:
         for b in out_eps:
             if a == b or not G.has_edge(a, b):
                 continue
             n = cooccur.get((a, b), 0)
-            if n == 0 or contra.get((a, b), 0) > tol_eff * n:
+            t_ab = tol_eff if n >= MIN_SUPPORT else tol
+            if n == 0 or contra.get((a, b), 0) > t_ab * n:
                 G.remove_edge(a, b)
     while True:
         try:
