@@ -168,3 +168,66 @@ def test_adaptive_pruning_integration_on_synthetic_noisy_rows():
     g_strict = infer_dag_from_predictions(in_parts, parts, assign, store,
                                           tol=0.0)
     assert set(g_strict.edges()) == set()
+
+
+def test_directional_evidence_gates_widened_tolerance():
+    """Per-pair directional evidence (ADVICE r5): a pair whose
+    contradiction rate exceeds the fixed tolerance survives the widened
+    bimodal-spectrum guard only with forward support well above an even
+    split (>= 0.7) OR a near-totally-contradicted reverse direction
+    (>= 0.98). Synthetic bimodal spectrum:
+
+    - (A, B): true edge, 20% noisy overlap -> rate 0.20, support 0.80
+      (kept via the support bar);
+    - (A, E) and (B, E): noisy true edges at 0.34 whose reverse
+      directions are contradicted in EVERY row (kept via the reverse
+      bar);
+    - (A, C) and (B, C): skewed-but-parallel at 0.34 — support 0.66 and
+      a reverse direction C occasionally wins (reverse rate 0.90). Under
+      the widened tolerance alone (midpoint 0.62 here) these became
+      false precedence edges; the directional guard prunes them.
+    """
+    from traceweaver_tpu.spans import Span, TraceStore
+
+    store = TraceStore()
+    in_spans = []
+    assign = {ep: {} for ep in ("A", "B", "C", "E")}
+    parts = {ep: [] for ep in ("A", "B", "C", "E")}
+    for i in range(100):
+        t = float(i * 1000)
+        s_in = Span(f"t{i}", "in", t, 500.0, None, [], "p", "server")
+        in_spans.append(s_in)
+        spans = {"A": Span(f"t{i}", "a", t + 10, 30.0, None, [], "p",
+                           "client")}
+        # B truly follows A; 20% of rows overlap (noise)
+        b_start = t + 20 if i % 5 == 0 else t + 50
+        spans["B"] = Span(f"t{i}", "b", b_start, 30.0, None, [], "p",
+                          "client")
+        # C: skewed-parallel. 24 rows long-overlap, 10 rows C completes
+        # BEFORE A/B even start (the reverse direction is not near-1),
+        # 66 rows strictly after -> (A,C)=(B,C)=0.34, (C,A)=(C,B)=0.90
+        if i < 24:
+            c_start, c_dur = t + 18, 100.0
+        elif i < 34:
+            c_start, c_dur = t + 1, 5.0
+        else:
+            c_start, c_dur = t + 130, 30.0
+        spans["C"] = Span(f"t{i}", "c", c_start, c_dur, None, [], "p",
+                          "client")
+        # E: noisy true successor of A and B. 34 rows overlap, 66 rows
+        # strictly after; E NEVER completes before A or B start, so the
+        # reverse direction is contradicted in every row
+        if i < 34:
+            e_start, e_dur = t + 20, 100.0
+        else:
+            e_start, e_dur = t + 130, 30.0
+        spans["E"] = Span(f"t{i}", "e", e_start, e_dur, None, [], "p",
+                          "client")
+        for ep, sp in spans.items():
+            store.all_spans[sp.GetId()] = sp
+            parts[ep].append(sp)
+            assign[ep][s_in.GetId()] = sp.GetId()
+    in_parts = {"IN": in_spans}
+
+    g = infer_dag_from_predictions(in_parts, parts, assign, store)
+    assert set(g.edges()) == {("A", "B"), ("A", "E"), ("B", "E")}

@@ -358,8 +358,8 @@ def test_pallas_vmem_gate(monkeypatch):
     budget (commit 795d50f)."""
     from traceweaver_tpu.ops import pallas_sinkhorn as ps
 
-    # pin the default cap: _VMEM_CAP_BYTES is env-overridable at import
-    monkeypatch.setattr(ps, "_VMEM_CAP_BYTES", 96 * 1024 * 1024)
+    # pin the default cap (TW_PALLAS_VMEM_CAP is read at CALL time)
+    monkeypatch.delenv("TW_PALLAS_VMEM_CAP", raising=False)
     # the bench fleet shape that OOM'd on chip now fits the raised cap
     assert ps.fits_pallas_vmem(1032, 1152)
     # a block over the cap must be gated out (cap 96 MB -> 16 MB block)
@@ -367,6 +367,22 @@ def test_pallas_vmem_gate(monkeypatch):
     # gate respects lane/sublane padding: 1 x 1 pads to 8 x 128
     assert ps._padded_block_bytes(1, 1) == 8 * 128 * 4
     assert ps.fits_pallas_vmem(1, 1)
+    # the env override takes effect per call (not frozen at import):
+    # a ~55 MB-footprint block fits the 96 MB default but not a 32 MB cap
+    assert ps.fits_pallas_vmem(1500, 1500)
+    monkeypatch.setenv("TW_PALLAS_VMEM_CAP", str(32 * 1024 * 1024))
+    assert not ps.fits_pallas_vmem(1500, 1500)
+    # ... and is clamped to the v5e's physical per-core VMEM, so an
+    # oversized override cannot push Mosaic past the hardware and fail
+    # at compile time on chip
+    monkeypatch.setenv("TW_PALLAS_VMEM_CAP", str(1 << 40))
+    assert ps._vmem_cap_bytes() == ps._VMEM_HW_BYTES_V5E
+    # a sub-floor override clamps up to the floor the kernel budgets
+    monkeypatch.setenv("TW_PALLAS_VMEM_CAP", "1024")
+    assert ps._vmem_cap_bytes() == ps._VMEM_FLOOR_BYTES
+    # unparsable values fall back to the default rather than crashing
+    monkeypatch.setenv("TW_PALLAS_VMEM_CAP", "lots")
+    assert ps._vmem_cap_bytes() == ps._VMEM_CAP_DEFAULT_BYTES
 
 
 def test_sinkhorn_dispatch_oversized_block_takes_jnp_path(monkeypatch):
@@ -440,6 +456,12 @@ def test_topk_peel_neg_inf_and_k_guard():
         np.testing.assert_array_equal(np.asarray(pi), np.asarray(li))
     with pytest.raises(ValueError):
         topk_peel(x, 4)
+    # the documented small-k bound: above MAX_PEEL_K the O(k*M) peel
+    # loses to the sort and callers must use lax.top_k
+    from traceweaver_tpu.ops.rounding import MAX_PEEL_K
+
+    with pytest.raises(ValueError, match="MAX_PEEL_K"):
+        topk_peel(jnp.zeros((2, 64), jnp.float32), MAX_PEEL_K + 1)
     # k=0 parity: empty arrays like lax.top_k, not a stack error
     pv, pi = topk_peel(x, 0)
     assert pv.shape == (3, 0) and pi.shape == (3, 0)

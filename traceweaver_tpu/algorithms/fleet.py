@@ -64,7 +64,8 @@ class FleetItem:
 
     def __init__(self, svc, in_span_partitions, out_span_partitions,
                  true_assignments, dag=None,
-                 method="MaxScoreBatchSubsetWithSkips", store=None):
+                 method="MaxScoreBatchSubsetWithSkips", store=None,
+                 warm_dists=None):
         self.svc = svc
         self.in_span_partitions = in_span_partitions
         self.out_span_partitions = out_span_partitions
@@ -74,6 +75,11 @@ class FleetItem:
         # optional TraceStore for the per-service fallback path (its host
         # EM refit reads the global span table); unused by the fused path
         self.store = store
+        # optional carried {edge key -> EdgeDist} (streaming warm start):
+        # replaces the plan's cold fit and collapses the solve to a single
+        # pass — the on-device EM refit is what the carried statistics
+        # already are (stream/state.py CarriedState)
+        self.warm_dists = warm_dists
 
 
 def _prepare(item: FleetItem, solver: WeaverTPU):
@@ -104,9 +110,16 @@ def _prepare(item: FleetItem, solver: WeaverTPU):
         item.dag, item.true_assignments, score_mode=solver.score_mode,
         true_skips=(item.method == "MaxScoreBatchSubsetWithTrueSkips"),
     )
+    dists, n_passes = plan["dists"], plan["iterations"]
+    if item.warm_dists is not None:
+        # streaming warm start: carried per-edge statistics from earlier
+        # windows replace both the cold fit and the refit pass; the item
+        # joins the single-pass dispatch groups (unseen edges fall back
+        # to pack_problem's near-flat wide Gaussian)
+        dists, n_passes = item.warm_dists, 1
     return dict(in_ep=in_ep, in_spans=in_spans, out_eps=out_eps,
-                skip_budget=plan["skip_budget"], dists=plan["dists"],
-                n_in=plan["n_in"], n_passes=plan["iterations"],
+                skip_budget=plan["skip_budget"], dists=dists,
+                n_in=plan["n_in"], n_passes=n_passes,
                 force_skip_ids=plan["force_skip_ids"])
 
 

@@ -161,6 +161,15 @@ def infer_dag_from_predictions(
     0.35 therefore fall back to the fixed ``tol`` and are pruned; this
     keeps edge-free and fan-out services edge-free at the price of
     missing hypothetical true edges noisier than any measured so far.
+
+    The spectrum guard is population-level, so it is backed by a
+    PER-PAIR check: a pair whose contradiction rate exceeds the fixed
+    ``tol`` (i.e. it survives only because the tolerance widened) must
+    also carry directional evidence — forward support well above an
+    even split (support/cooccur ≥ 0.7) or a near-totally-contradicted
+    reverse direction (≥ 0.98). A skewed-but-parallel pair that lands
+    below the low cluster's 0.35 cap (say at 0.34) has neither and is
+    pruned instead of minting a false precedence edge.
     """
     assert len(in_span_partitions) == 1
     _, in_spans = next(iter(in_span_partitions.items()))
@@ -208,6 +217,20 @@ def infer_dag_from_predictions(
     # anchor the bimodality spectrum nor enjoy the widened tolerance, so
     # pairs under MIN_SUPPORT rows are judged at the fixed tol only.
     MIN_SUPPORT = 20
+    # Directional-evidence bars for pairs that survive ONLY through the
+    # widened tolerance (contra rate above the fixed tol). The spectrum
+    # guard is population-level; these are per-pair: a true precedence
+    # edge at the worst measured noise (0.28) still supports a-before-b
+    # in >= 0.72 of its rows, and its reverse direction is contradicted
+    # in essentially every row (b is invoked only after a completes —
+    # prediction noise puts the measured reverse rates at ~0.99). A
+    # skewed-but-parallel pair at 0.34 fails both: forward support 0.66
+    # and a reverse direction that b's occasional early completion keeps
+    # below the near-1 bar. Without this check such a pair becomes a
+    # false precedence edge whenever the spectrum happens to be bimodal
+    # around it.
+    MIN_DIR_SUPPORT = 0.7
+    MIN_REVERSE_CONTRA = 0.98
     rates = [contra.get(k, 0) / n
              for k, n in cooccur.items() if n >= MIN_SUPPORT]
     tol_eff = _adaptive_tol(rates, tol) if tol > 0 else 0.0
@@ -217,8 +240,19 @@ def infer_dag_from_predictions(
                 continue
             n = cooccur.get((a, b), 0)
             t_ab = tol_eff if n >= MIN_SUPPORT else tol
-            if n == 0 or contra.get((a, b), 0) > t_ab * n:
+            c_ab = contra.get((a, b), 0)
+            if n == 0 or c_ab > t_ab * n:
                 G.remove_edge(a, b)
+                continue
+            if c_ab > tol * n:
+                # surviving only under the widened tolerance: demand
+                # per-pair directional evidence
+                sup_rate = support.get((a, b), 0) / n
+                n_rev = cooccur.get((b, a), 0)
+                rev_rate = (contra.get((b, a), 0) / n_rev) if n_rev else 0.0
+                if (sup_rate < MIN_DIR_SUPPORT
+                        and rev_rate < MIN_REVERSE_CONTRA):
+                    G.remove_edge(a, b)
     while True:
         try:
             cycle = nx.find_cycle(G)

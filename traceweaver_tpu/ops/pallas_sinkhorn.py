@@ -37,9 +37,26 @@ NEG = -1.0e9
 # the default limit on chip). Budget 6x the block, capped well under the
 # v5e's 128 MB/core; blocks whose 6x estimate cannot fit under the cap
 # take the XLA path instead (sinkhorn() gate).
-_VMEM_CAP_BYTES = int(os.environ.get("TW_PALLAS_VMEM_CAP",
-                                     str(96 * 1024 * 1024)))
+_VMEM_CAP_DEFAULT_BYTES = 96 * 1024 * 1024
+# physical per-core VMEM on the v5e. TW_PALLAS_VMEM_CAP is clamped to
+# this: requesting a scoped-vmem budget past the hardware would fail at
+# Mosaic compile time, on chip, long after the env var was set.
+_VMEM_HW_BYTES_V5E = 128 * 1024 * 1024
 _VMEM_FLOOR_BYTES = 32 * 1024 * 1024
+
+
+def _vmem_cap_bytes() -> int:
+    """Scoped-VMEM cap, read from TW_PALLAS_VMEM_CAP at CALL time (an
+    import-time read would freeze the value before test fixtures or a
+    launcher export it) and clamped into [floor, v5e per-core VMEM]."""
+    raw = os.environ.get("TW_PALLAS_VMEM_CAP")
+    if raw is None:
+        return _VMEM_CAP_DEFAULT_BYTES
+    try:
+        cap = int(raw)
+    except ValueError:
+        return _VMEM_CAP_DEFAULT_BYTES
+    return max(_VMEM_FLOOR_BYTES, min(cap, _VMEM_HW_BYTES_V5E))
 
 
 def _padded_block_bytes(n: int, m: int) -> int:
@@ -49,7 +66,7 @@ def _padded_block_bytes(n: int, m: int) -> int:
 def fits_pallas_vmem(n: int, m: int) -> bool:
     """True when the padded [n, m] f32 block's pipeline footprint
     (~6x block) fits the scoped-VMEM cap."""
-    return 6 * _padded_block_bytes(n, m) <= _VMEM_CAP_BYTES
+    return 6 * _padded_block_bytes(n, m) <= _vmem_cap_bytes()
 
 
 def _kernel(s_ref, r_ref, c_ref, out_ref, *, n_iters: int, inv_eps: float,
@@ -141,7 +158,7 @@ def sinkhorn_log_pallas(
     kernel = functools.partial(
         _kernel, n_iters=n_iters, inv_eps=1.0 / epsilon,
         tol_phi=tol / epsilon)
-    vmem_budget = min(_VMEM_CAP_BYTES,
+    vmem_budget = min(_vmem_cap_bytes(),
                       max(_VMEM_FLOOR_BYTES, 6 * np_ * mp * 4))
     plan = pl.pallas_call(
         kernel,

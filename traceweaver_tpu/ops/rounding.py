@@ -39,6 +39,10 @@ import jax.numpy as jnp
 
 NEG = -1.0e9
 
+# topk_peel refuses k above this: the O(k*M) peel only beats lax.top_k's
+# O(M log^2 M) sort for small k (the solver's DEFAULT_TOPK is 5)
+MAX_PEEL_K = 16
+
 
 @partial(jax.jit, static_argnames=("n_steps",))
 def greedy_round(
@@ -133,12 +137,26 @@ def topk_peel(x: jnp.ndarray, k: int):
     *unpicked* index and returns the original value there — exactly the
     index order ``top_k`` emits for trailing ``-inf`` entries.
 
-    One contract caveat vs ``top_k``: ties are broken by ``argmax``'s
-    value equality, so ``-0.0`` and ``0.0`` tie here where ``top_k``'s
-    total-order sort ranks ``0.0`` first — irrelevant for the solver's
-    plan blocks (non-negative masses; near-zero candidates are dropped
-    by the ``MIN_TOPK_MASS`` filter) but not bit-identical for inputs
-    that mix signed zeros.
+    Two contract caveats vs ``top_k``, both irrelevant for the solver's
+    plan blocks (non-negative finite masses; near-zero candidates are
+    dropped by the ``MIN_TOPK_MASS`` filter) but not bit-identical in
+    general:
+
+    - signed zeros: ties are broken by ``argmax``'s value equality, so
+      ``-0.0`` and ``0.0`` tie here where ``top_k``'s total-order sort
+      ranks ``0.0`` first;
+    - NaN: ``top_k`` uses a total order that ranks NaN above every
+      finite value (NaNs come back FIRST), while ``argmax``'s NaN
+      propagation makes a NaN-containing row's picks here follow
+      first-occurrence argmax semantics instead — order and values
+      both diverge. Callers with possibly-NaN inputs must mask them
+      (or use ``lax.top_k``) first.
+
+    Cost bound: each pass is a full lane sweep, so the peel is
+    O(k·M) versus the sort network's O(M·log²M) — a win only while k
+    stays small. ``MAX_PEEL_K`` (16; solver uses k = 5) is asserted:
+    above it the crossover with ``lax.top_k``'s sort approaches on
+    realistic M (~1e3) and callers should use ``lax.top_k`` instead.
     """
     if not jnp.issubdtype(x.dtype, jnp.floating):
         # the -inf mask would promote integer comparisons to float32,
@@ -148,6 +166,11 @@ def topk_peel(x: jnp.ndarray, k: int):
     if k > x.shape[-1]:
         raise ValueError(
             f"topk_peel: k={k} > last-axis size {x.shape[-1]}")
+    if k > MAX_PEEL_K:
+        raise ValueError(
+            f"topk_peel: k={k} > MAX_PEEL_K={MAX_PEEL_K}; the k-pass "
+            "argmax peel is O(k*M) and loses to lax.top_k's sort at "
+            "large k — use jax.lax.top_k for this call")
     if k == 0:
         empty = x.shape[:-1] + (0,)
         return (jnp.zeros(empty, x.dtype), jnp.zeros(empty, jnp.int32))

@@ -79,7 +79,135 @@ def _parse_mesh_devices(raw: str) -> int:
     return n
 
 
+def build_stream_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m traceweaver_tpu.runtime.cli stream",
+        description="Online windowed reconstruction over a span stream "
+                    "(docs/STREAMING.md).")
+    p.add_argument("--source", required=True,
+                   help="source spec, e.g. replay:<corpus-dir>"
+                        "[?fix=2&max_traces=200&ooo_ms=50&seed=0]")
+    p.add_argument("--fix", type=int, default=0,
+                   help="dataset FIX mode for replay sources (overridden "
+                        "by a ?fix= query in --source)")
+    p.add_argument("--max_traces", type=int, default=1000,
+                   help="replay trace cap (reference executor hardcap)")
+    p.add_argument("--ooo_ms", type=float, default=0.0,
+                   help="replay out-of-order arrival jitter (ms)")
+    p.add_argument("--window_s", type=float, default=60.0,
+                   help="event-time window size (seconds)")
+    p.add_argument("--overlap_s", type=float, default=5.0,
+                   help="window overlap (seconds)")
+    p.add_argument("--watermark_s", type=float, default=2.0,
+                   help="watermark out-of-order bound (seconds)")
+    p.add_argument("--grace_s", type=float, default=0.0,
+                   help="allowed lateness past the watermark (seconds)")
+    p.add_argument("--max_pending", type=int, default=4,
+                   help="in-flight sealed-window bound (backpressure)")
+    p.add_argument("--spill_max", type=int, default=64,
+                   help="spill queue bound before windows are dropped")
+    p.add_argument("--out", default=None,
+                   help="JSONL sink for stitched traces (one window per "
+                        "line); omit to only print live stats")
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint file; pass with --resume to continue "
+                        "a killed run without reprocessing/double-emit")
+    p.add_argument("--checkpoint_every", type=int, default=8,
+                   help="emitted windows between checkpoints")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from --checkpoint instead of starting over")
+    p.add_argument("--no_warm", action="store_true",
+                   help="disable carried-state warm start (two-pass EM "
+                        "per window, the batch executor's shape)")
+    p.add_argument("--no_grade", action="store_true",
+                   help="disable ground-truth grading")
+    p.add_argument("--compare_batch", action="store_true",
+                   help="after the stream drains, run the batch executor "
+                        "on the same corpus and print the accuracy delta")
+    return p
+
+
+def stream_main(argv) -> int:
+    from traceweaver_tpu.stream import (
+        StreamConfig,
+        StreamingReconstructor,
+        TraceSink,
+        parse_source_spec,
+    )
+
+    args = build_stream_parser().parse_args(argv)
+    if args.resume and not (args.checkpoint
+                            and os.path.exists(args.checkpoint)):
+        print(f"--resume: no checkpoint at {args.checkpoint!r}",
+              file=sys.stderr)
+        return 2
+    source = parse_source_spec(
+        args.source, fix=args.fix, max_traces=args.max_traces,
+        ooo_us=args.ooo_ms * 1000.0)
+    cfg = StreamConfig(
+        window_us=args.window_s * 1e6,
+        overlap_us=args.overlap_s * 1e6,
+        ooo_bound_us=args.watermark_s * 1e6,
+        grace_us=args.grace_s * 1e6,
+        max_pending=args.max_pending,
+        spill_max=args.spill_max,
+        warm_start=not args.no_warm,
+        grade=not args.no_grade,
+        checkpoint_path=args.checkpoint,
+        checkpoint_every=args.checkpoint_every,
+    )
+    sink = TraceSink(args.out) if args.out else None
+    if args.resume:
+        service = StreamingReconstructor.resume(args.checkpoint, source,
+                                                sink=sink)
+    else:
+        service = StreamingReconstructor(source, cfg, sink=sink)
+    summary = service.run()
+
+    print("[stream] done: %d events -> %d windows, %d spans emitted, "
+          "late %d rerouted / %d dropped, shed %d spilled / %d dropped"
+          % (summary["consumed"], summary["emitted_windows"],
+             summary["stats"].get("spans_emitted", 0),
+             summary["late_rerouted"], summary["late_dropped"],
+             summary["shed_spilled"], summary["shed_dropped_windows"]))
+    streamed_acc = None
+    if "accuracy" in summary:
+        streamed_acc = summary["accuracy"]["e2e"]
+        print("[stream] streamed end-to-end accuracy: %.3f%%" % streamed_acc)
+    if args.compare_batch and streamed_acc is not None:
+        from traceweaver_tpu.runtime.executor import (
+            ExecutorConfig,
+            run_experiment,
+        )
+
+        cfg_b = ExecutorConfig(
+            data_path="", results_directory="", fix=args.fix,
+            cache_rate=0.0, test_name="streamcmp",
+            predictor_indices=[10])
+        res = run_experiment(cfg_b, store=source.store)
+        batch_acc = res.accuracy_overall["MaxScoreBatchSubsetWithSkips"]
+        print("[stream] batch executor on identical input: %.3f%% "
+              "(streamed delta %+.3f pts)"
+              % (batch_acc, streamed_acc - batch_acc))
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "stream":
+        # online mode rides its own subcommand; the bare flag surface
+        # below stays byte-compatible with the reference executor CLI
+        import jax
+
+        if os.environ.get("TW_BACKEND", "cpu") == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        from traceweaver_tpu.runtime.jax_cache import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
+        return stream_main(argv[1:])
     # Backend selection. The sandbox's sitecustomize force-selects the
     # remote "axon" TPU backend whose init can stall for minutes; the env
     # var alone cannot override it, only a config update can. Experiment

@@ -1,0 +1,58 @@
+"""Streaming reconstruction: the online execution mode.
+
+Everything else in the repo is batch — ``runtime/executor.py`` loads a
+fixed corpus, solves each service once, and writes pickles (the same
+offline shape as the reference artifact, which hard-caps a run at 1000
+traces). A deployed reconstructor instead receives spans as an unbounded,
+out-of-order stream from collectors. This package is that missing
+subsystem:
+
+- :mod:`sources` — span event streams (replay of a recorded corpus with
+  deterministic out-of-order arrival, or any iterator of
+  :class:`~traceweaver_tpu.stream.sources.SpanEvent`);
+- :mod:`watermark` — event-time watermark tracking (bounded
+  out-of-orderness, lateness accounting);
+- :mod:`window` — overlapping event-time windows with single-owner
+  emission semantics and late-span routing;
+- :mod:`scheduler` — micro-batch scheduling of sealed windows onto the
+  existing fleet solve path (shared shape classes across windows so XLA
+  recompiles amortize) with bounded in-flight work and a spill queue for
+  backpressure;
+- :mod:`state` — the incremental trace store, per-service carried
+  GMM/score statistics (warm-start EM between windows), and the
+  streamed-vs-batch accuracy grader;
+- :mod:`checkpoint` — atomic checkpoints of source offset + carried
+  state so a killed service resumes without reprocessing or
+  double-emitting;
+- :mod:`service` — the driver that wires all of the above and emits
+  stitched traces incrementally with a live stats surface.
+
+CLI: ``python -m traceweaver_tpu.runtime.cli stream --source
+replay:<corpus-dir> ...`` (see docs/STREAMING.md).
+"""
+
+from traceweaver_tpu.stream.sources import (  # noqa: F401
+    ReplaySource,
+    SpanEvent,
+    parse_source_spec,
+)
+from traceweaver_tpu.stream.watermark import WatermarkTracker  # noqa: F401
+from traceweaver_tpu.stream.window import (  # noqa: F401
+    WindowBuffer,
+    WindowingEngine,
+)
+from traceweaver_tpu.stream.scheduler import MicroBatchScheduler  # noqa: F401
+from traceweaver_tpu.stream.state import (  # noqa: F401
+    CarriedState,
+    LiveTraceStore,
+    StreamGrader,
+)
+from traceweaver_tpu.stream.checkpoint import (  # noqa: F401
+    load_checkpoint,
+    save_checkpoint,
+)
+from traceweaver_tpu.stream.service import (  # noqa: F401
+    StreamConfig,
+    StreamingReconstructor,
+    TraceSink,
+)

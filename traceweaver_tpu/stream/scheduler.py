@@ -1,0 +1,99 @@
+"""Micro-batch scheduling of sealed windows onto the fleet solve path.
+
+Sealed windows queue here and are solved in micro-batches: every window
+in a batch contributes one :class:`~traceweaver_tpu.algorithms.fleet.FleetItem`
+per solvable service, and the whole batch rides ONE
+:func:`~traceweaver_tpu.algorithms.fleet.solve_fleet` call — windows with
+similar geometry land in the same padded shape class (power-of-two
+bucketing), so the XLA programs compiled for the first few windows are
+reused for the rest of the stream and device dispatches stay O(shape
+classes), not O(windows x services).
+
+Backpressure is explicit and quantified:
+
+- at most ``max_pending`` sealed windows may be queued for the next
+  micro-batch (the bound on in-flight device buffers);
+- when the producer outruns the solver, excess windows shed to a spill
+  queue of at most ``spill_max`` (counted in ``shed_spilled``); spilled
+  windows are solved later, oldest first — shed, not lost;
+- when even the spill queue is full, the offered window is dropped and
+  its spans counted (``shed_dropped_windows`` / ``shed_dropped_spans``)
+  — the only lossy outcome, and it is the operator-visible signal that
+  the deployment is under-provisioned.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Deque, List, Optional
+
+from traceweaver_tpu.stream.window import WindowBuffer
+
+
+class MicroBatchScheduler:
+    """Bounded queue + spill in front of a window-batch solve function.
+
+    ``solve_fn(batch: List[WindowBuffer]) -> List[result]`` solves a
+    micro-batch of sealed windows and returns one result per window, in
+    order. The scheduler owns no solver state itself, so checkpointing
+    only needs its two queues.
+    """
+
+    def __init__(self, solve_fn: Callable[[List[WindowBuffer]], List],
+                 max_pending: int = 4, spill_max: int = 64) -> None:
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        self.solve_fn = solve_fn
+        self.max_pending = int(max_pending)
+        self.spill_max = int(spill_max)
+        self.pending: Deque[WindowBuffer] = deque()
+        self.spill: Deque[WindowBuffer] = deque()
+        self.shed_spilled = 0
+        self.shed_dropped_windows = 0
+        self.shed_dropped_spans = 0
+        self.solved_windows = 0
+
+    # -- producer side ----------------------------------------------------
+    def offer(self, buf: WindowBuffer) -> str:
+        """Enqueue one sealed window. Returns "queued", "spilled", or
+        "dropped"."""
+        if len(self.pending) < self.max_pending:
+            self.pending.append(buf)
+            return "queued"
+        if len(self.spill) < self.spill_max:
+            self.spill.append(buf)
+            self.shed_spilled += 1
+            return "spilled"
+        self.shed_dropped_windows += 1
+        self.shed_dropped_spans += buf.n_spans
+        return "dropped"
+
+    @property
+    def backlog(self) -> int:
+        return len(self.pending) + len(self.spill)
+
+    # -- consumer side ----------------------------------------------------
+    def pump(self, max_batches: Optional[int] = None) -> List:
+        """Solve queued windows in micro-batches of ``max_pending``,
+        refilling from the spill queue between batches, until the backlog
+        is empty (or ``max_batches`` batches have run — the throttle used
+        to model a slow consumer). Returns the solved results in
+        submission order."""
+        results: List = []
+        batches = 0
+        while self.pending or self.spill:
+            if max_batches is not None and batches >= max_batches:
+                break
+            while self.spill and len(self.pending) < self.max_pending:
+                self.pending.append(self.spill.popleft())
+            batch = list(self.pending)
+            self.pending.clear()
+            out = self.solve_fn(batch)
+            if len(out) != len(batch):
+                raise RuntimeError(
+                    f"solve_fn returned {len(out)} results for a "
+                    f"{len(batch)}-window batch")
+            results.extend(out)
+            self.solved_windows += len(batch)
+            batches += 1
+        return results

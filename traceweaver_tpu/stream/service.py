@@ -1,0 +1,477 @@
+"""The streaming reconstruction service.
+
+Wires source -> watermark -> windowing -> micro-batch scheduler (fleet
+solve) -> incremental stitching/emission, with carried per-service state,
+periodic checkpoints, and a live stats surface. See the package docstring
+and docs/STREAMING.md for the model; tests/test_stream.py for the
+contracts.
+
+The inner loop is the existing warm fleet path: each sealed window
+contributes one FleetItem per solvable service and a micro-batch of
+windows rides one :func:`~traceweaver_tpu.algorithms.fleet.solve_fleet`
+call, so padded shape classes (and the XLA programs compiled for them)
+are shared across the whole stream.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from traceweaver_tpu.spans import NA, SKIP, Span
+from traceweaver_tpu.stream.checkpoint import load_checkpoint, save_checkpoint
+from traceweaver_tpu.stream.scheduler import MicroBatchScheduler
+from traceweaver_tpu.stream.state import (
+    CarriedState,
+    LiveTraceStore,
+    StreamGrader,
+)
+from traceweaver_tpu.stream.watermark import WatermarkTracker
+from traceweaver_tpu.stream.window import WindowBuffer, WindowingEngine
+
+
+@dataclass
+class StreamConfig:
+    """Streaming knobs (all event-time values in microseconds)."""
+
+    window_us: float = 60e6        # event-time window size
+    overlap_us: float = 5e6        # shared margin between windows
+    ooo_bound_us: float = 2e6      # watermark out-of-order allowance
+    grace_us: float = 0.0          # allowed lateness past the watermark
+    max_pending: int = 4           # in-flight sealed-window bound
+    spill_max: int = 64            # spill queue bound (backpressure)
+    solve_min_batch: int = 1       # pump once this many windows are sealed
+    warm_start: bool = True        # carry per-service dists between windows
+    grade: bool = True             # ground-truth grading (replay only)
+    prune: bool = True             # retention-prune the live store
+    checkpoint_path: Optional[str] = None
+    checkpoint_every: int = 8      # emitted windows between checkpoints
+    verbose: bool = True
+
+
+class TraceSink:
+    """Append-only JSONL sink with a byte offset the checkpoints record.
+
+    ``truncate(offset)`` rewinds to a checkpointed offset on resume so
+    re-solved windows re-emit over their previous bytes — the no-loss,
+    no-double-emit half of the resume contract.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a+b")
+        self._f.seek(0, os.SEEK_END)
+        self.offset = self._f.tell()
+
+    def write_line(self, line: str) -> None:
+        data = (line + "\n").encode("utf-8")
+        self._f.write(data)
+        self._f.flush()
+        self.offset += len(data)
+
+    def truncate(self, offset: int) -> None:
+        self._f.truncate(offset)
+        self._f.seek(offset)
+        self.offset = offset
+
+    def close(self) -> None:
+        self._f.close()
+
+
+@dataclass
+class _WindowProblem:
+    """One (window, service) solve request plus its decode context."""
+
+    service: str
+    in_ep: str
+    in_spans: List[Span]
+    out_parts: Dict[str, List[Span]]
+    truth: Dict[str, Dict]
+    dag: object
+
+
+@dataclass
+class WindowResult:
+    """One solved window, ready for emission."""
+
+    buf: WindowBuffer
+    assignments: Dict[str, Dict[str, Dict]]  # svc -> ep -> {in: out}
+    problems: List[_WindowProblem]
+    traces: Dict[str, List]
+    accuracy: Optional[float]
+    n_rows: int = 0
+    solve_share_s: float = 0.0
+
+
+def _sid(span_id) -> List[str]:
+    return [span_id[0], span_id[1]]
+
+
+class StreamingReconstructor:
+    """Consume an unbounded span stream, emit stitched traces per window."""
+
+    def __init__(self, source, cfg: Optional[StreamConfig] = None,
+                 sink: Optional[TraceSink] = None) -> None:
+        self.source = source
+        self.cfg = cfg or StreamConfig()
+        self.sink = sink
+        c = self.cfg
+        self.watermark = WatermarkTracker(bound_us=c.ooo_bound_us)
+        self.windower = WindowingEngine(
+            c.window_us, overlap_us=c.overlap_us, grace_us=c.grace_us)
+        self.scheduler = MicroBatchScheduler(
+            self._solve_batch, max_pending=c.max_pending,
+            spill_max=c.spill_max)
+        self.live = LiveTraceStore()
+        self.carried = CarriedState()
+        self.grader = StreamGrader() if c.grade else None
+        self.consumed = 0
+        self.emitted_windows = 0
+        self.stats: Dict[str, float] = {}
+        self.fleet_stats: Dict[str, float] = {}
+        self._since_checkpoint = 0
+
+    # -- per-window problem construction ----------------------------------
+    def _window_problems(self, buf: WindowBuffer) -> List[_WindowProblem]:
+        from traceweaver_tpu.ingest.order import infer_dag_from_predictions
+        from traceweaver_tpu.metrics import get_ground_truth
+
+        by_service: Dict[str, Tuple[List[Span], List[Span]]] = {}
+        for span in buf.spans:
+            svc = self.live.service_of(span)
+            if svc is None or span.span_kind not in ("server", "client"):
+                self._bump("unresolved_spans")
+                continue
+            ins, outs = by_service.setdefault(svc, ([], []))
+            (ins if span.span_kind == "server" else outs).append(span)
+
+        problems = []
+        for svc in sorted(by_service):
+            ins, outs = by_service[svc]
+            if not outs:
+                continue  # leaf service: nothing to reconstruct
+            in_parts: Dict[str, List[Span]] = {}
+            for s in ins:
+                ep = self.live.parent_service_of(s)
+                if ep is None:
+                    self._bump("unresolved_spans")
+                    continue
+                in_parts.setdefault(ep, []).append(s)
+            out_parts: Dict[str, List[Span]] = {}
+            for s in outs:
+                ep = self.live.child_service_of(s)
+                if ep is None:
+                    self._bump("unresolved_spans")
+                    continue
+                out_parts.setdefault(ep, []).append(s)
+            if len(in_parts) != 1 or not out_parts:
+                # same skip rule as the batch executor's service problems
+                self._bump("skipped_service_windows")
+                continue
+            for part in (*in_parts.values(), *out_parts.values()):
+                part.sort(key=lambda s: (s.start_mus, s.end_mus))
+            (in_ep, in_spans), = in_parts.items()
+            truth = get_ground_truth(in_parts, out_parts)
+            # strict (tol=0) prediction-shaped pruning over the window's
+            # truth reproduces the batch GT DAG inference exactly while
+            # tolerating split traces (missing truth entries)
+            dag = infer_dag_from_predictions(
+                in_parts, out_parts, truth, self.live, tol=0.0)
+            problems.append(_WindowProblem(
+                service=svc, in_ep=in_ep, in_spans=in_spans,
+                out_parts=out_parts, truth=truth, dag=dag))
+        return problems
+
+    # -- solve ------------------------------------------------------------
+    def _solve_batch(self, bufs: List[WindowBuffer]) -> List[WindowResult]:
+        from traceweaver_tpu.algorithms import timing
+        from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+
+        t0 = time.perf_counter()
+        per_buf: List[List[_WindowProblem]] = []
+        items, owners = [], []
+        for b, buf in enumerate(bufs):
+            probs = self._window_problems(buf)
+            per_buf.append(probs)
+            for wp in probs:
+                warm = (self.carried.get(wp.service)
+                        if self.cfg.warm_start else None)
+                items.append(FleetItem(
+                    wp.service, {wp.in_ep: wp.in_spans}, wp.out_parts,
+                    wp.truth, wp.dag, store=self.live, warm_dists=warm))
+                owners.append(b)
+        outs = []
+        if items:
+            outs = solve_fleet(items, all_spans=self.live.all_spans,
+                               all_processes=self.live.all_processes,
+                               stats=self.fleet_stats)
+        solve_s = time.perf_counter() - t0
+        self.stats["solve_s"] = self.stats.get("solve_s", 0.0) + solve_s
+
+        results: List[WindowResult] = []
+        by_buf_outs: List[List] = [[] for _ in bufs]
+        for b, out in zip(owners, outs):
+            by_buf_outs[b].append(out)
+        total_rows = max(1, sum(len(wp.in_spans)
+                                for probs in per_buf for wp in probs))
+        for buf, probs, buf_outs in zip(bufs, per_buf, by_buf_outs):
+            assignments: Dict[str, Dict[str, Dict]] = {}
+            n_rows = 0
+            for wp, out in zip(probs, buf_outs):
+                amap = out[0]
+                assignments[wp.service] = amap
+                n_rows += len(wp.in_spans)
+                if self.cfg.warm_start:
+                    self.carried.update(wp.service, timing.refit_from_assignments(
+                        {wp.in_ep: wp.in_spans}, wp.out_parts, wp.dag,
+                        amap, self.live.all_spans))
+                if self.grader is not None:
+                    owned = [s for s in wp.in_spans
+                             if s.GetId() in buf.owned_ids]
+                    self.grader.accumulate(wp.service, wp.in_ep, owned,
+                                           wp.out_parts, amap)
+            acc = (self._window_accuracy(buf, probs, assignments)
+                   if self.cfg.grade else None)
+            results.append(WindowResult(
+                buf=buf, assignments=assignments, problems=probs,
+                traces=self._stitch(buf, assignments),
+                accuracy=acc, n_rows=n_rows,
+                solve_share_s=solve_s * n_rows / total_rows))
+        return results
+
+    def _window_accuracy(self, buf: WindowBuffer,
+                         probs: List[_WindowProblem],
+                         assignments) -> Optional[float]:
+        """Fraction of this window's OWNED incoming spans whose service
+        got every endpoint right (window-local exact-match grading)."""
+        total = correct = 0
+        for wp in probs:
+            amap = assignments.get(wp.service, {})
+            for s in wp.in_spans:
+                if s.GetId() not in buf.owned_ids:
+                    continue
+                total += 1
+                ok = True
+                for ep in wp.out_parts:
+                    truth = wp.truth.get(ep, {}).get(s.GetId(), SKIP)
+                    if amap.get(ep, {}).get(s.GetId(), NA) != truth:
+                        ok = False
+                        break
+                correct += int(ok)
+        return correct / total if total else None
+
+    # -- stitching --------------------------------------------------------
+    def _stitch(self, buf: WindowBuffer, assignments) -> Dict[str, List]:
+        """Assemble predicted traces from this window's owned roots:
+        follow each service's predicted outgoing span to its server half
+        downstream and recurse through the window's assignments."""
+        traces: Dict[str, List] = {}
+        for span in buf.spans:
+            if (span.GetId() not in buf.owned_ids
+                    or span.span_kind != "server" or not span.IsRoot()):
+                continue
+            collected = {span.GetId()}
+            stack, visited = [span], set()
+            while stack:
+                cur = stack.pop()
+                if cur.GetId() in visited:
+                    continue
+                visited.add(cur.GetId())
+                svc = self.live.service_of(cur)
+                by_ep = assignments.get(svc)
+                if not by_ep:
+                    continue
+                for ep in sorted(by_ep):
+                    out_id = by_ep[ep].get(cur.GetId())
+                    if (not isinstance(out_id, tuple)
+                            or out_id in (NA, SKIP)):
+                        continue
+                    collected.add(out_id)
+                    out_span = self.live.all_spans.get(out_id)
+                    if out_span is None:
+                        continue
+                    for child_id in out_span.children_spans:
+                        child = self.live.all_spans.get(child_id)
+                        if child is not None and child.span_kind == "server":
+                            collected.add(child.GetId())
+                            stack.append(child)
+            traces[span.trace_id] = sorted(collected)
+        return traces
+
+    # -- emission ---------------------------------------------------------
+    def _emit(self, res: WindowResult) -> None:
+        buf = res.buf
+        if self.sink is not None:
+            services = {}
+            for wp in res.problems:
+                amap = res.assignments.get(wp.service, {})
+                eps = {}
+                for ep in sorted(wp.out_parts):
+                    rows = []
+                    for s in wp.in_spans:
+                        if s.GetId() not in buf.owned_ids:
+                            continue
+                        out_id = amap.get(ep, {}).get(s.GetId(), NA)
+                        rows.append([_sid(s.GetId()), _sid(out_id)])
+                    rows.sort()
+                    eps[ep] = rows
+                services[wp.service] = eps
+            rec = dict(
+                window=buf.k, start_us=buf.start_us, end_us=buf.end_us,
+                services=services,
+                traces={tid: [_sid(x) for x in ids]
+                        for tid, ids in sorted(res.traces.items())},
+            )
+            self.sink.write_line(json.dumps(rec, sort_keys=True))
+        self.emitted_windows += 1
+        self._since_checkpoint += 1
+        self._bump("spans_emitted", buf.n_owned)
+        self._bump("traces_emitted", len(res.traces))
+        if res.accuracy is not None:
+            self.stats["last_window_acc"] = res.accuracy
+        if self.cfg.verbose:
+            acc = ("%.3f" % res.accuracy) if res.accuracy is not None \
+                else "n/a"
+            rate = (res.n_rows / res.solve_share_s
+                    if res.solve_share_s > 0 else 0.0)
+            print(
+                "[stream] win=%d spans=%d owned=%d traces=%d svc=%d "
+                "acc=%s wm_delay=%.2fs late=%d/%d shed=%d backlog=%d "
+                "%.1f spans/s"
+                % (buf.k, buf.n_spans, buf.n_owned, len(res.traces),
+                   len(res.problems), acc, buf.seal_delay_us / 1e6,
+                   self.windower.late_rerouted, self.windower.late_dropped,
+                   self.scheduler.shed_spilled
+                   + self.scheduler.shed_dropped_windows,
+                   self.scheduler.backlog, rate))
+
+    def _bump(self, key: str, n: float = 1) -> None:
+        self.stats[key] = self.stats.get(key, 0) + n
+
+    # -- checkpointing ----------------------------------------------------
+    def _checkpoint(self) -> None:
+        if not self.cfg.checkpoint_path:
+            return
+        save_checkpoint(self.cfg.checkpoint_path, dict(
+            cfg=self.cfg,
+            consumed=self.consumed,
+            emitted_windows=self.emitted_windows,
+            emit_offset=self.sink.offset if self.sink else 0,
+            sink_path=self.sink.path if self.sink else None,
+            watermark=self.watermark,
+            windower=self.windower,
+            live=self.live,
+            carried=self.carried,
+            grader=self.grader,
+            stats=self.stats,
+            fleet_stats=self.fleet_stats,
+            pending=list(self.scheduler.pending),
+            spill=list(self.scheduler.spill),
+            scheduler_counters=(self.scheduler.shed_spilled,
+                                self.scheduler.shed_dropped_windows,
+                                self.scheduler.shed_dropped_spans,
+                                self.scheduler.solved_windows),
+        ))
+        self._since_checkpoint = 0
+
+    @classmethod
+    def resume(cls, checkpoint_path: str, source,
+               sink: Optional[TraceSink] = None) -> "StreamingReconstructor":
+        """Rebuild a service from its last checkpoint. ``source`` must be
+        the same deterministic source the killed run used; the sink (if
+        any) is truncated back to the checkpointed offset so the resumed
+        run's bytes splice exactly where the checkpoint left off."""
+        state = load_checkpoint(checkpoint_path)
+        cfg: StreamConfig = state["cfg"]
+        cfg.checkpoint_path = checkpoint_path
+        if sink is None and state.get("sink_path"):
+            sink = TraceSink(state["sink_path"])
+        svc = cls(source, cfg, sink=sink)
+        svc.consumed = state["consumed"]
+        svc.emitted_windows = state["emitted_windows"]
+        svc.watermark = state["watermark"]
+        svc.windower = state["windower"]
+        svc.live = state["live"]
+        svc.carried = state["carried"]
+        svc.grader = state["grader"]
+        svc.stats = state["stats"]
+        svc.fleet_stats = state["fleet_stats"]
+        svc.scheduler.pending.extend(state["pending"])
+        svc.scheduler.spill.extend(state["spill"])
+        (svc.scheduler.shed_spilled, svc.scheduler.shed_dropped_windows,
+         svc.scheduler.shed_dropped_spans,
+         svc.scheduler.solved_windows) = state["scheduler_counters"]
+        if svc.sink is not None:
+            svc.sink.truncate(state["emit_offset"])
+        return svc
+
+    # -- main loop --------------------------------------------------------
+    def run(self, max_windows: Optional[int] = None) -> Dict:
+        """Consume the source to exhaustion (or until ``max_windows``
+        windows have been emitted — the kill/test hook) and return the
+        final summary. Safe to call on a resumed service: it continues
+        from the checkpointed offset."""
+        c = self.cfg
+        for ev in self.source.events(skip=self.consumed):
+            self.consumed += 1
+            self.watermark.observe(ev.event_us)
+            span = self.live.add(ev)
+            self.windower.add(span, ev.event_us)
+            sealed = self.windower.poll(self.watermark.value)
+            for buf in sealed:
+                self.scheduler.offer(buf)
+            if self.scheduler.backlog >= c.solve_min_batch:
+                for res in self.scheduler.pump():
+                    self._emit(res)
+            if sealed and c.prune:
+                # retention horizon: two windows behind the watermark,
+                # never ahead of the oldest window still waiting in the
+                # backlog (a long spill backlog must not lose its spans'
+                # parent/child context before it gets solved)
+                backlog = list(self.scheduler.pending) \
+                    + list(self.scheduler.spill)
+                oldest = min((b.start_us for b in backlog),
+                             default=self.watermark.value)
+                horizon = min(self.watermark.value - 2 * c.window_us,
+                              oldest - c.window_us) - c.grace_us
+                self.live.prune(horizon)
+            if self._since_checkpoint >= c.checkpoint_every:
+                self._checkpoint()
+            if max_windows is not None \
+                    and self.emitted_windows >= max_windows:
+                return self._summary(final=False)
+        return self.finish()
+
+    def finish(self) -> Dict:
+        """End of stream: seal and solve everything left, emit, final
+        checkpoint, and (in grading mode) compute the end-to-end streamed
+        accuracy with the batch metrics."""
+        for buf in self.windower.flush():
+            self.scheduler.offer(buf)
+        for res in self.scheduler.pump():
+            self._emit(res)
+        self._checkpoint()
+        return self._summary(final=True)
+
+    def _summary(self, final: bool) -> Dict:
+        out = dict(
+            final=final,
+            consumed=self.consumed,
+            emitted_windows=self.emitted_windows,
+            late_rerouted=self.windower.late_rerouted,
+            late_dropped=self.windower.late_dropped,
+            shed_spilled=self.scheduler.shed_spilled,
+            shed_dropped_windows=self.scheduler.shed_dropped_windows,
+            shed_dropped_spans=self.scheduler.shed_dropped_spans,
+            pruned_spans=self.live.n_pruned,
+            watermark_max_skew_us=self.watermark.max_skew_us,
+            stats=dict(self.stats),
+            fleet=dict(self.fleet_stats),
+        )
+        if final and self.grader is not None:
+            out["accuracy"] = self.grader.finish()
+        return out

@@ -1,0 +1,159 @@
+"""Span event sources for the streaming reconstructor.
+
+A source is anything that yields :class:`SpanEvent` in *arrival* order.
+The production ingress would be a collector subscription; for testing and
+benchmarking, :class:`ReplaySource` turns a recorded corpus (the exp1/exp5
+datasets, or any directory :func:`~traceweaver_tpu.ingest.load_corpus`
+understands) into a timestamped stream, optionally with deterministic
+out-of-order arrival jitter so the watermark/late-span machinery is
+exercised the way a real collector fan-in would.
+
+Replay is deterministic for a given ``(corpus, ooo_us, seed)``: the same
+spec always yields the same events in the same order. The checkpoint
+machinery relies on this — resuming skips the first ``consumed`` events
+instead of persisting raw spans that were already folded into windows.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from traceweaver_tpu.spans import Span, TraceStore
+
+
+@dataclass
+class SpanEvent:
+    """One span arriving at the reconstructor.
+
+    ``event_us`` is event time (the span's start timestamp — the time the
+    instrumented call happened); ``arrival_us`` is when the collector
+    delivered it. The gap between the two is what watermarks bound.
+    ``processes`` is the owning trace's ``process_id -> service`` table
+    (Jaeger ships it per trace; collectors forward it with each span).
+    """
+
+    span: Span
+    event_us: float
+    arrival_us: float
+    trace_id: str
+    processes: Dict[str, str]
+
+
+class ReplaySource:
+    """Replay a loaded :class:`TraceStore` as an arrival-ordered stream.
+
+    ``ooo_us > 0`` delays each span by a deterministic uniform jitter in
+    ``[0, ooo_us)`` (seeded RNG), then re-sorts by arrival — spans reach
+    the service out of event-time order, bounded by ``ooo_us``, which is
+    exactly the contract a watermark with ``bound_us >= ooo_us`` covers.
+    """
+
+    def __init__(self, store: TraceStore, ooo_us: float = 0.0,
+                 seed: int = 0) -> None:
+        self.store = store
+        self.ooo_us = float(ooo_us)
+        self.seed = int(seed)
+        self._events: List[SpanEvent] = self._build()
+
+    def _build(self) -> List[SpanEvent]:
+        spans = sorted(
+            self.store.all_spans.values(),
+            key=lambda s: (float(s.start_mus), s.trace_id, s.sid),
+        )
+        rng = np.random.default_rng(self.seed)
+        jitter = (rng.uniform(0.0, self.ooo_us, size=len(spans))
+                  if self.ooo_us > 0 else np.zeros(len(spans)))
+        events = [
+            SpanEvent(
+                span=s,
+                event_us=float(s.start_mus),
+                arrival_us=float(s.start_mus) + float(j),
+                trace_id=s.trace_id,
+                processes=self.store.all_processes.get(s.trace_id, {}),
+            )
+            for s, j in zip(spans, jitter)
+        ]
+        events.sort(key=lambda e: (e.arrival_us, e.trace_id, e.span.sid))
+        return events
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def events(self, skip: int = 0) -> Iterator[SpanEvent]:
+        """Yield events in arrival order, skipping the first ``skip``
+        (checkpoint resume fast-forwards through already-consumed
+        events)."""
+        return iter(self._events[skip:])
+
+    @classmethod
+    def from_directory(cls, path: str, fix: int, max_traces: int = 1000,
+                       ooo_us: float = 0.0, seed: int = 0) -> "ReplaySource":
+        import random
+
+        from traceweaver_tpu.ingest import load_corpus
+
+        # corpus loading must be reproducible ACROSS PROCESSES: Alibaba
+        # self-loop remapping mints synthetic "<random>-loop" service
+        # names from the global RNG, and a resumed run re-loads the
+        # corpus in a fresh process whose names must match the
+        # checkpointed state byte-for-byte. Same convention as the batch
+        # executor (run_experiment seeds 10 before its load).
+        random.seed(10)
+        store = load_corpus(path, fix=fix, max_traces=max_traces,
+                            cache=False)
+        return cls(store, ooo_us=ooo_us, seed=seed)
+
+
+class IterableSource:
+    """Adapter for tests / external ingress: any iterable of SpanEvents,
+    already in arrival order. ``events(skip=n)`` consumes and discards
+    the first n (resume support for deterministic iterables)."""
+
+    def __init__(self, events: Iterable[SpanEvent]) -> None:
+        self._events = list(events)
+        self.store: Optional[TraceStore] = None
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def events(self, skip: int = 0) -> Iterator[SpanEvent]:
+        return iter(self._events[skip:])
+
+
+def parse_source_spec(spec: str, fix: int = 0, max_traces: int = 1000,
+                      ooo_us: float = 0.0, seed: int = 0) -> ReplaySource:
+    """Parse a ``--source`` spec into a source.
+
+    ``replay:<dir>`` with optional query parameters overriding the
+    defaults, e.g.::
+
+        replay:data/hotel_reservation/hotel_load25?fix=2&max_traces=200
+        replay:/abs/path?fix=5&ooo_ms=50&seed=3
+
+    Recognized query keys: ``fix``, ``max_traces``, ``ooo_ms`` /
+    ``ooo_us``, ``seed``.
+    """
+    if not spec.startswith("replay:"):
+        raise ValueError(
+            f"unknown source spec {spec!r}: only 'replay:<corpus-dir>' "
+            "sources are available (live collector ingress plugs in via "
+            "stream.sources.IterableSource)")
+    rest = spec[len("replay:"):]
+    path, _, query = rest.partition("?")
+    params = dict(urllib.parse.parse_qsl(query))
+    if "fix" in params:
+        fix = int(params["fix"])
+    if "max_traces" in params:
+        max_traces = int(params["max_traces"])
+    if "ooo_us" in params:
+        ooo_us = float(params["ooo_us"])
+    elif "ooo_ms" in params:
+        ooo_us = float(params["ooo_ms"]) * 1000.0
+    if "seed" in params:
+        seed = int(params["seed"])
+    return ReplaySource.from_directory(path, fix=fix, max_traces=max_traces,
+                                      ooo_us=ooo_us, seed=seed)
