@@ -147,3 +147,14 @@ def test_baseline_child_skips_recorded_dnf_without_ample_budget(
     assert sub["hotel/frontend"]["finished"] is False
     assert sub["hotel/search"]["measured"] is True
     assert report["n_fresh"] == 1
+
+
+def test_backend_label_flags_cpu_fallback(bench):
+    """A CPU-solver report must surface as backend=cpu_fallback in the
+    final JSON line so fallback numbers can never be mistaken for
+    on-chip results (this bit the round-5 driver bench); real chip
+    backends pass through unrelabeled."""
+    assert bench.backend_label("cpu") == ("cpu_fallback", False)
+    assert bench.backend_label(None) == ("cpu_fallback", False)
+    assert bench.backend_label("tpu") == ("tpu", True)
+    assert bench.backend_label("axon") == ("axon", True)

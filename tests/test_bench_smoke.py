@@ -1,0 +1,108 @@
+"""Tier-1 bench smoke: repeated solves must not recompile.
+
+The dispatch planner buckets every shape to a power of two precisely so
+repeated solves reuse one compiled program per shape class; a regression
+that lets shapes leak through unbucketed multiplies compiled variants,
+silently turning every bench/stream dispatch into a fresh XLA compile
+(the round-3 bench died of exactly this class of slowdown). This smoke
+test runs the packed solve twice on a tiny problem and asserts the
+second call costs ZERO backend compiles — measured by the process-wide
+compile counters in runtime/jax_cache, the same counters the bench
+report and the stream CLI now surface — and stays under a generous
+wall-clock bound.
+"""
+
+import time
+
+import numpy as np
+
+import jax
+
+from traceweaver_tpu.runtime.jax_cache import compile_counters, counters_delta
+
+jax.config.update("jax_platforms", "cpu")
+
+# generous: the tiny warm solve takes milliseconds; this only exists to
+# catch a catastrophic regression (e.g. retracing per call) without being
+# flaky on a loaded CI host
+WARM_SOLVE_BOUND_S = 60.0
+
+
+def _tiny_args(seed=0):
+    rng = np.random.default_rng(seed)
+    B, E, W, M, K = 2, 2, 8, 8, 3
+    in_start = np.sort(rng.uniform(0, 500, (B, W)), axis=1).astype(np.float32)
+    out_start = np.zeros((B, E, M), np.float32)
+    for b in range(B):
+        for e in range(E):
+            out_start[b, e] = np.sort(
+                in_start[b] + 10 * (e + 1) + rng.normal(0, 2, W))
+    pred = np.zeros((E, E), bool); pred[1, 0] = True
+    root = np.array([True, False]); last = np.array([False, True])
+    wt = np.zeros((E, E, K), np.float32); wt[..., 0] = 1
+    mu = np.full((E, E, K), 10.0, np.float32)
+    sd = np.full((E, E, K), 5.0, np.float32)
+    iwt = np.zeros((E, K), np.float32); iwt[:, 0] = 1
+    imu = np.full((E, K), 10.0, np.float32)
+    isd = np.full((E, K), 5.0, np.float32)
+    # numpy inputs on purpose: the packed entry point donates its window
+    # tensors, so reusing device arrays across calls would be an error —
+    # numpy rows are copied to fresh device buffers per call, exactly how
+    # the pack/dispatch pipeline feeds the solver
+    return (in_start, in_start + 300, np.ones((B, W), bool),
+            out_start, out_start + 5, np.ones((B, E, M), bool),
+            np.zeros((B, E), np.float32), np.zeros((B, E, W), bool),
+            pred, root, last, wt, mu, sd, iwt, imu, isd,
+            iwt.copy(), imu.copy(), isd.copy())
+
+
+def test_second_solve_is_compile_free_and_fast():
+    from traceweaver_tpu.algorithms.weaver_tpu import solve_windows_packed
+
+    args = _tiny_args()
+    kwargs = dict(n_sinkhorn=10, n_sweeps=3, sinkhorn_tol=1e-3)
+
+    # first call: may compile (counters just have to be installed before
+    # it so the second call's delta is trustworthy)
+    compile_counters()
+    out1 = np.asarray(solve_windows_packed(*args, **kwargs))
+
+    before = compile_counters()
+    t0 = time.perf_counter()
+    out2 = np.asarray(solve_windows_packed(*args, **kwargs))
+    warm_s = time.perf_counter() - t0
+    delta = counters_delta(before)
+
+    assert delta["backend_compiles"] == 0, (
+        "identical second solve recompiled — a shape-class or static-arg "
+        f"leak is multiplying program variants: {delta}")
+    assert warm_s < WARM_SOLVE_BOUND_S
+    assert np.array_equal(out1, out2)
+
+
+def test_compaction_redispatch_shapes_stay_bucketed():
+    """The compaction redispatch solves a gathered sub-batch; its batch
+    size must be power-of-two bucketed so straggler counts (which vary
+    run to run) cannot mint unbounded compiled variants. Two compacted
+    runs with different straggler counts may compile at most the
+    bucketed shapes once; an immediate repeat must be compile-free."""
+    import traceweaver_tpu.algorithms.fleet as fleet_mod
+
+    import traceweaver_tpu.algorithms.fleet as fleet_mod
+
+    (in_start, in_end, in_valid, out_start, out_end, out_valid,
+     skip_cap, force_skip, *tables) = _tiny_args(seed=1)
+    batch = dict(in_start=in_start, in_end=in_end, in_valid=in_valid,
+                 out_start=out_start, out_end=out_end, out_valid=out_valid,
+                 skip_cap=skip_cap, force_skip=force_skip)
+    pidx = np.zeros((in_start.shape[0],), np.int32)
+    tables = tuple(t[None] for t in tables)  # [P=1, ...] fleet tables
+    hypers = dict(epsilon=1.0, n_sinkhorn=10, sinkhorn_tol=1e-3,
+                  max_preds=1, max_succs=1)
+    fleet_mod._compacted_pass(batch, pidx, tables, 4, 2, hypers, {})
+    before = compile_counters()
+    out_a = fleet_mod._compacted_pass(batch, pidx, tables, 4, 2, hypers, {})
+    delta = counters_delta(before)
+    assert delta["backend_compiles"] == 0, delta
+    out_b = fleet_mod._compacted_pass(batch, pidx, tables, 4, 2, hypers, {})
+    assert np.array_equal(out_a, out_b)

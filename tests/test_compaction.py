@@ -1,0 +1,186 @@
+"""Convergence compaction must be output-identical to the uncompacted path.
+
+The fleet dispatcher runs each solve pass as a warm dispatch (capped at
+TW_SWEEP_WARM sweeps) plus a full-sweep redispatch of only the windows
+whose Gauss-Seidel assignments were not yet a fixed point
+(fleet._compacted_pass). Converged windows keep their warm output — a
+reproducing sweep is a fixed point, so extra sweep budget cannot change
+it — and stragglers rerun from sweep 0; both halves are therefore
+bit-identical to one full-budget dispatch, and the two-pass EM flow's
+refit (its own dispatch, weaver_tpu.refit_fleet_params) must match the
+refit solve_em_fleet fuses in-graph. These tests pin all of that down on
+synthetic fleet tensors (no dataset dependency) and at the solve_fleet
+level on synthetic span problems.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import traceweaver_tpu.algorithms.fleet as fleet_mod
+from traceweaver_tpu.algorithms.weaver_tpu import (
+    solve_em_fleet,
+    solve_windows_fleet,
+)
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _fleet_tensors(B=6, E=3, W=8, M=8, P=1, K=3, seed=0, n_easy=3):
+    """Synthetic [B, E, W, M] fleet batch: the first ``n_easy`` windows
+    hold well-separated spans (forced assignments — the sweep loop hits
+    its fixed point within two sweeps), the rest heavily-overlapping
+    noisy spans (stragglers that need the full sweep budget)."""
+    rng = np.random.default_rng(seed)
+    in_start = np.zeros((B, W), np.float32)
+    in_end = np.zeros((B, W), np.float32)
+    out_start = np.zeros((B, E, M), np.float32)
+    for b in range(B):
+        if b < n_easy:
+            # sequential, disjoint in-spans; one obvious candidate each
+            starts = np.arange(W, dtype=np.float32) * 1000.0
+            in_start[b] = starts
+            in_end[b] = starts + 800.0
+            for e in range(E):
+                out_start[b, e] = starts + 10.0 * (e + 1) + rng.normal(
+                    0, 0.5, W)
+        else:
+            starts = np.sort(rng.uniform(0, 200, W)).astype(np.float32)
+            in_start[b] = starts
+            in_end[b] = starts + 400.0
+            for e in range(E):
+                out_start[b, e] = np.sort(
+                    starts + 10.0 * (e + 1) + rng.normal(0, 30, W))
+    out_end = out_start + 8.0
+    batch = dict(
+        in_start=in_start, in_end=in_end, in_valid=np.ones((B, W), bool),
+        out_start=out_start, out_end=out_end,
+        out_valid=np.ones((B, E, M), bool),
+        skip_cap=np.zeros((B, E), np.float32),
+        force_skip=np.zeros((B, E, W), bool),
+    )
+    pidx = np.zeros((B,), np.int32)
+    pred = np.zeros((P, E, E), bool)
+    for e in range(1, E):
+        pred[:, e, e - 1] = True
+    root = np.zeros((P, E), bool); root[:, 0] = True
+    last = np.zeros((P, E), bool); last[:, E - 1] = True
+    ew = np.zeros((P, E, E, K), np.float32); ew[..., 0] = 1
+    emu = np.full((P, E, E, K), 10.0, np.float32)
+    esd = np.full((P, E, E, K), 5.0, np.float32)
+    iw = np.zeros((P, E, K), np.float32); iw[..., 0] = 1
+    imu = np.full((P, E, K), 10.0, np.float32)
+    isd = np.full((P, E, K), 5.0, np.float32)
+    params = dict(pred_mask=pred, root_mask=root, is_last=last,
+                  edge_wt=ew, edge_mu=emu, edge_sd=esd,
+                  in_wt=iw, in_mu=imu, in_sd=isd,
+                  ret_wt=iw.copy(), ret_mu=imu.copy(), ret_sd=isd.copy())
+    tables = tuple(params[k] for k in (
+        "pred_mask", "root_mask", "is_last",
+        "edge_wt", "edge_mu", "edge_sd",
+        "in_wt", "in_mu", "in_sd", "ret_wt", "ret_mu", "ret_sd"))
+    window_rows = np.arange(B, dtype=np.int32)[None, :]
+    window_valid = np.ones((1, B), bool)
+    return batch, params, tables, pidx, window_rows, window_valid
+
+
+HYPERS = dict(epsilon=1.0, n_sinkhorn=20, sinkhorn_tol=1e-3,
+              max_preds=1, max_succs=1)
+
+
+@pytest.mark.parametrize("warm", [1, 2, 3])
+def test_compacted_pass_bit_identical(warm):
+    batch, _, tables, pidx, _, _ = _fleet_tensors()
+    args = tuple(batch[k] for k in fleet_mod._BATCH_KEYS) + (pidx,)
+    full = np.asarray(solve_windows_fleet(
+        *args, *tables, n_sweeps=5, **HYPERS))
+    stats = {}
+    compacted = fleet_mod._compacted_pass(
+        batch, pidx, tables, 5, warm, HYPERS, stats)
+    assert np.array_equal(full, compacted)
+    assert stats["compact_windows_total"] == batch["in_start"].shape[0]
+    # warm=1 can never certify (sweep 0 always reports changed), so the
+    # counter must show a full redispatch there
+    if warm == 1:
+        assert (stats["compact_windows_redispatched"]
+                == stats["compact_windows_total"])
+
+
+def test_compaction_actually_compacts_easy_windows():
+    """The easy windows' assignments are a fixed point within the warm
+    budget, so the redispatch batch must be a strict subset — otherwise
+    compaction never saves the VPU cycles it exists to save (a vacuous
+    bit-identity test would hide that regression)."""
+    batch, _, tables, pidx, _, _ = _fleet_tensors()
+    stats = {}
+    fleet_mod._compacted_pass(batch, pidx, tables, 5, 3, HYPERS, stats)
+    assert stats["compact_windows_redispatched"] < stats[
+        "compact_windows_total"]
+
+
+def test_compacted_two_pass_em_bit_identical():
+    """warm/full pass0 -> standalone refit dispatch -> warm/full pass1
+    must reproduce the single fused solve_em_fleet program bitwise."""
+    batch, params, tables, pidx, wr, wv = _fleet_tensors()
+    args = tuple(batch[k] for k in fleet_mod._BATCH_KEYS) + (pidx,)
+    fused = np.asarray(solve_em_fleet(
+        *args, wr, wv, *tables, n_sweeps=5, **HYPERS))
+    stats = {}
+    compacted = fleet_mod._solve_group_compacted(
+        batch, pidx, params, tables, wr, wv, n_passes=2, n_sweeps=5,
+        warm=2, hypers=HYPERS, stats=stats)
+    assert np.array_equal(fused, compacted)
+
+
+def _synthetic_items(n_traces=60, seed=0):
+    """FleetItems over synthetic span streams: one service, a 2-endpoint
+    chain DAG, bursts of overlapping requests so perfect cuts yield
+    several multi-span windows."""
+    import networkx as nx
+
+    from traceweaver_tpu.algorithms.fleet import FleetItem
+    from traceweaver_tpu.spans import Span
+
+    rng = np.random.default_rng(seed)
+    in_spans, a_spans, b_spans = [], [], []
+    ta = {"A": {}, "B": {}}
+    t = 0.0
+    for i in range(n_traces):
+        # bursts of 4: overlapping arrivals, then a gap (window boundary)
+        t += 30.0 if i % 4 else 5000.0
+        start = t
+        dur = 400.0
+        s_in = Span(f"t{i}", "in", start, dur, "op", [], "svc", "server")
+        a_start = start + 10 + rng.normal(0, 2)
+        s_a = Span(f"t{i}", "a", a_start, 50.0, "opA", [], "svc", "client")
+        b_start = a_start + 50 + 15 + rng.normal(0, 2)
+        s_b = Span(f"t{i}", "b", b_start, 50.0, "opB", [], "svc", "client")
+        in_spans.append(s_in)
+        a_spans.append(s_a)
+        b_spans.append(s_b)
+        ta["A"][s_in.GetId()] = s_a.GetId()
+        ta["B"][s_in.GetId()] = s_b.GetId()
+    dag = nx.DiGraph()
+    dag.add_edge("A", "B")
+    return [FleetItem("svc", {"IN": in_spans}, {"A": a_spans, "B": b_spans},
+                      ta, dag)]
+
+
+def test_solve_fleet_compaction_toggle_identical(monkeypatch):
+    items = _synthetic_items()
+
+    monkeypatch.setenv("TW_COMPACT", "0")
+    base = fleet_mod.solve_fleet(items, stats={})
+
+    monkeypatch.setenv("TW_COMPACT", "1")
+    monkeypatch.setenv("TW_SWEEP_WARM", "2")
+    stats = {}
+    compacted = fleet_mod.solve_fleet(items, stats=stats)
+
+    # compaction must actually have run on this workload
+    assert stats.get("compact_windows_total", 0) > 0
+    for b, c in zip(base, compacted):
+        assert b[0] == c[0]   # assignments
+        assert b[1] == c[1]   # top-k
+        assert b[2:] == c[2:]  # counters

@@ -38,11 +38,18 @@ window axis is the sharding axis for multi-device runs
 from __future__ import annotations
 
 import math
+import warnings
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+# the packed entry points donate their window tensors (HBM-peak buffers);
+# backends without aliasing support fall back to a copy and warn per call —
+# pure noise at per-chunk dispatch rates on the CPU stand-in
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
 
 import jax
 import jax.numpy as jnp
@@ -52,8 +59,7 @@ from traceweaver_tpu.algorithms import timing
 from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
 from traceweaver_tpu.algorithms.timing import MAX_COMPONENTS, EdgeDist
 from traceweaver_tpu.metrics.accuracy import get_out_eps_in_order
-from traceweaver_tpu.ops.pallas_sinkhorn import sinkhorn
-from traceweaver_tpu.ops.rounding import greedy_round, topk_peel
+from traceweaver_tpu.ops.pallas_sinkhorn import assign_topk
 from traceweaver_tpu.ops.scores import mixture_logpdf, pair_scores
 from traceweaver_tpu.spans import NA, SKIP, Span
 
@@ -231,25 +237,21 @@ def _solve_windows_impl(
                 [Sfull, jnp.zeros((1, M + 1), dtype=S.dtype)], axis=0
             )
 
-            plan = sinkhorn(S_ot, row_marg, col_marg,
-                            epsilon=epsilon, n_iters=n_sinkhorn,
-                            tol=sinkhorn_tol)
-            plan = plan[:W, :]
-
+            # fused persistent-sweep block: Sinkhorn + greedy rounding +
+            # small-k peel in ONE Pallas kernel on TPU — the [W, M] plan
+            # never leaves VMEM between the three stages (off-TPU: the
+            # same composition as separate jitted stages, including the
+            # topk_peel that replaced lax.top_k's lane sort — sort.47 /
+            # wrapped_reduce-window in the r05 profiles). Candidate
+            # columns with negligible plan mass (timing-infeasible:
+            # score NEG -> plan ~ 0) come back as -1 so cross-window
+            # duplicate resolution can never fall back onto an
+            # infeasible out-span.
             col_valid = jnp.concatenate([o_v[e], (cap_e > 0)[None]])
-            assign = greedy_round(plan, in_v, col_valid,
-                                  cap_e.astype(jnp.int32), n_steps=W)
-
-            # per-endpoint top-K candidate columns by plan mass; columns
-            # with negligible mass (timing-infeasible: score NEG -> plan
-            # ~ 0) are dropped to -1 so cross-window duplicate resolution
-            # can never fall back onto an infeasible out-span
-            # exact top_k via k argmax+mask passes: lax.top_k lowers to a
-            # full lane sort on TPU (~20 % of device busy, sort.47 in
-            # PROFILE_r05_tpu.json); identical outputs incl. tie order
-            tk_mass, tk = topk_peel(
-                jnp.where(col_valid[None, :], plan, NEG), topk)
-            tk = jnp.where(tk_mass > MIN_TOPK_MASS, tk, -1)
+            assign, tk = assign_topk(
+                S_ot, row_marg, col_marg, in_v, col_valid, cap_e, W,
+                epsilon=epsilon, n_iters=n_sinkhorn, tol=sinkhorn_tol,
+                topk=topk, min_topk_mass=MIN_TOPK_MASS)
 
             # chosen completion: skip passes the predecessor time through
             real = (assign >= 0) & (assign < M)
@@ -297,11 +299,19 @@ def _solve_windows_impl(
             jnp.zeros((E, W), dtype=jnp.int32),
         )
         # one traced sweep body (compile surface independent of n_sweeps)
-        _, outs, _, _ = jax.lax.while_loop(
+        _, outs, _, changed = jax.lax.while_loop(
             sweep_cond, sweep_body,
             (init_state, init_outs, jnp.asarray(0, jnp.int32),
              jnp.asarray(True)))
-        return outs
+        # converged <=> the last executed sweep reproduced its predecessor's
+        # assignments, i.e. the outputs are a Gauss-Seidel fixed point that
+        # no further sweep budget could change. Exported per window so the
+        # host can redispatch ONLY unconverged windows with the remaining
+        # sweeps (convergence compaction, algorithms/fleet.py) — under vmap
+        # this whole loop runs until the SLOWEST window converges, with
+        # converged windows' updates select-masked into no-ops but still
+        # burning VPU cycles.
+        return outs + (~changed,)
 
     return jax.vmap(solve_one)(
         in_start, in_end, in_valid, out_start, out_end, out_valid,
@@ -345,7 +355,7 @@ def solve_windows(
       feas_count [B, E, W] int32 — feasible candidates per row
     """
     B = in_start.shape[0]
-    return _solve_windows_impl(
+    assign, tk, not_best, feas, _ = _solve_windows_impl(
         in_start, in_end, in_valid, out_start, out_end, out_valid,
         skip_cap, force_skip,
         jnp.zeros((B,), dtype=jnp.int32),
@@ -357,26 +367,44 @@ def solve_windows(
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs,
     )
+    return assign, tk, not_best, feas
+
+
+def _pack_solver_outputs(assign, tk, not_best, feas, converged):
+    """The single-transfer int32 layout ``[B, E, W, 4 + topk]``:
+    channel 0 = assign, 1 = not_best, 2 = feas_count, 3 = converged (the
+    per-window sweep-fixed-point flag broadcast over [E, W] — read by the
+    convergence-compaction redispatch), 4.. = topk columns."""
+    conv = jnp.broadcast_to(
+        converged[:, None, None], assign.shape).astype(jnp.int32)
+    return jnp.concatenate(
+        [assign[..., None], not_best[..., None].astype(jnp.int32),
+         feas[..., None], conv[..., None], tk], axis=-1,
+    )
 
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
-                                   "sinkhorn_tol", "max_preds", "max_succs"))
+                                   "sinkhorn_tol", "max_preds", "max_succs"),
+         donate_argnums=tuple(range(8)))
 def solve_windows_packed(*args, epsilon: float = 1.0, n_sinkhorn: int = 40,
                          topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
                          sinkhorn_tol: float = 0.0,
                          max_preds: int = 0, max_succs: int = 0):
-    """:func:`solve_windows` with the four outputs packed into one int32
-    tensor ``[B, E, W, 3+topk]`` (assign, not_best, feas_count, topk...) so a
-    solve costs a single device->host transfer instead of four."""
-    assign, tk, not_best, feas = solve_windows(
-        *args, epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
+    """:func:`solve_windows` with the outputs packed into one int32 tensor
+    ``[B, E, W, 4+topk]`` (see :func:`_pack_solver_outputs`) so a solve
+    costs a single device->host transfer instead of four. The window
+    tensors (args 0-7) are donated: the dense [B, E, W, M] blocks are the
+    solve's HBM peak and the caller always rebuilds them per dispatch."""
+    B = args[0].shape[0]
+    outs = _solve_windows_impl(
+        *args[:8],
+        jnp.zeros((B,), dtype=jnp.int32),
+        *(a[None] for a in args[8:]),
+        epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs,
     )
-    return jnp.concatenate(
-        [assign[..., None], not_best[..., None].astype(jnp.int32),
-         feas[..., None], tk], axis=-1,
-    )
+    return _pack_solver_outputs(*outs)
 
 
 def em_family_samples(assign, in_start, in_end, in_valid,
@@ -431,7 +459,8 @@ def em_family_samples(assign, in_start, in_end, in_valid,
 
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
-                                   "sinkhorn_tol", "max_preds", "max_succs"))
+                                   "sinkhorn_tol", "max_preds", "max_succs"),
+         donate_argnums=tuple(range(8)))
 def solve_em_packed(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
     skip_cap, force_skip, pred_mask, root_mask, is_last,
@@ -499,7 +528,8 @@ def solve_em_packed(
 
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
-                                   "sinkhorn_tol", "max_preds", "max_succs"))
+                                   "sinkhorn_tol", "max_preds", "max_succs"),
+         donate_argnums=tuple(range(8)))
 def solve_windows_fleet(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
     skip_cap, force_skip, param_idx,
@@ -511,14 +541,15 @@ def solve_windows_fleet(
     sinkhorn_tol: float = 0.0,
     max_preds: int = 0, max_succs: int = 0,
 ):
-    """Multi-service :func:`solve_windows` with the packed int32 output.
+    """Multi-service :func:`solve_windows` with the packed int32 output
+    (window tensors donated — see :func:`solve_windows_packed`).
 
     ``param_idx[b]`` selects the window's problem tables from the stacked
     ``[P, ...]`` arrays; windows of every service in a fleet ride one
     device dispatch (endpoint axes padded to the fleet max — padded
     endpoints have no valid columns, assign nothing, and pass predecessor
     times through, so they cannot disturb real endpoints)."""
-    assign, tk, not_best, feas = _solve_windows_impl(
+    outs = _solve_windows_impl(
         in_start, in_end, in_valid, out_start, out_end, out_valid,
         skip_cap, force_skip, param_idx,
         pred_masks, root_masks, is_lasts,
@@ -528,57 +559,24 @@ def solve_windows_fleet(
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs,
     )
-    return jnp.concatenate(
-        [assign[..., None], not_best[..., None].astype(jnp.int32),
-         feas[..., None], tk], axis=-1,
-    )
+    return _pack_solver_outputs(*outs)
 
 
-@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
-                                   "sinkhorn_tol", "max_preds", "max_succs"))
-def solve_em_fleet(
-    in_start, in_end, in_valid, out_start, out_end, out_valid,
-    skip_cap, force_skip, param_idx, window_rows, window_valid,
-    pred_masks, root_masks, is_lasts,
-    edge_wts, edge_mus, edge_sds, in_wts, in_mus, in_sds,
-    ret_wts, ret_mus, ret_sds,
-    epsilon: float = 1.0, n_sinkhorn: int = 40,
-    topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
-    sinkhorn_tol: float = 0.0,
-    max_preds: int = 0, max_succs: int = 0,
-):
-    """Both EM iterations for a whole service fleet in ONE dispatch.
-
-    The fleet analogue of :func:`solve_em_packed`: pass 0 over every
-    service's windows, per-service three-family delay extraction, one
-    batched BIC-GMM refit over the ``P*Ne`` family rows, then pass 1 —
-    the whole bench workload's EM never leaves the device and costs a
-    single round trip through the tunnel.
-
-    ``window_rows``/``window_valid`` ([P, Bmax] int32/bool) list each
-    service's window rows in the fleet batch (the packer emits services as
-    contiguous row blocks). The per-service refit matrix is built by
-    GATHERING those rows — ``[P*Ne, Bmax*W]`` — rather than broadcasting
-    the full sample matrix per service (``[P*Ne, B*W]``): the window axis
-    a service's EM sees shrinks from the whole fleet's to its own, so the
-    refit block stays ~P× smaller and scales to exp5-size fleets.
-    """
-    B, E, M = out_start.shape
-    W = in_start.shape[1]
+def _fleet_refit_tables(assign0, in_start, in_end, in_valid,
+                        out_start, out_end, param_idx,
+                        window_rows, window_valid, pred_masks, root_masks,
+                        edge_wts, edge_mus, edge_sds,
+                        in_wts, in_mus, in_sds, ret_wts, ret_mus, ret_sds):
+    """Per-service three-family BIC-GMM refit from pass-0 assignments —
+    the middle stage of :func:`solve_em_fleet`, shared with the
+    standalone :func:`refit_fleet_params` dispatch the convergence-
+    compacted flow uses (one definition, so the fused single program and
+    the compacted multi-dispatch flow cannot drift). Returns the nine
+    refit param tables reshaped to ``[P, ...]`` table layout."""
+    B, E, W = assign0.shape
     P, _, K = in_wts.shape
     Ne = E + E * E + E
     Bmax = window_rows.shape[1]
-
-    assign0, _, _, _ = _solve_windows_impl(
-        in_start, in_end, in_valid, out_start, out_end, out_valid,
-        skip_cap, force_skip, param_idx,
-        pred_masks, root_masks, is_lasts,
-        edge_wts, edge_mus, edge_sds, in_wts, in_mus, in_sds,
-        ret_wts, ret_mus, ret_sds,
-        epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
-        n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
-        max_preds=max_preds, max_succs=max_succs,
-    )
 
     # family samples over the padded endpoint axis; per-window structure
     # masks so a window only feeds its own service's family rows
@@ -607,15 +605,84 @@ def solve_em_fleet(
                                  prior_w, prior_mu, prior_sd, max_k=K)
 
     w, mu, sd = (a.reshape(P, Ne, K) for a in (w, mu, sd))
-    return solve_windows_fleet(
-        in_start, in_end, in_valid, out_start, out_end, out_valid,
-        skip_cap, force_skip, param_idx,
-        pred_masks, root_masks, is_lasts,
+    return (
         w[:, E:E + E * E].reshape(P, E, E, K),
         mu[:, E:E + E * E].reshape(P, E, E, K),
         sd[:, E:E + E * E].reshape(P, E, E, K),
         w[:, :E], mu[:, :E], sd[:, :E],
         w[:, E + E * E:], mu[:, E + E * E:], sd[:, E + E * E:],
+    )
+
+
+@jax.jit
+def refit_fleet_params(assign0, in_start, in_end, in_valid,
+                       out_start, out_end, param_idx,
+                       window_rows, window_valid, pred_masks, root_masks,
+                       edge_wts, edge_mus, edge_sds,
+                       in_wts, in_mus, in_sds, ret_wts, ret_mus, ret_sds):
+    """Standalone refit dispatch for the convergence-compacted fleet flow
+    (:mod:`traceweaver_tpu.algorithms.fleet`): the host merges pass-0
+    assignments from the warm + compacted dispatches, then this single
+    program produces the pass-1 tables — same nine-tuple, same math as
+    the refit inside :func:`solve_em_fleet`."""
+    return _fleet_refit_tables(
+        assign0, in_start, in_end, in_valid, out_start, out_end,
+        param_idx, window_rows, window_valid, pred_masks, root_masks,
+        edge_wts, edge_mus, edge_sds,
+        in_wts, in_mus, in_sds, ret_wts, ret_mus, ret_sds)
+
+
+@partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
+                                   "sinkhorn_tol", "max_preds", "max_succs"),
+         donate_argnums=tuple(range(8)))
+def solve_em_fleet(
+    in_start, in_end, in_valid, out_start, out_end, out_valid,
+    skip_cap, force_skip, param_idx, window_rows, window_valid,
+    pred_masks, root_masks, is_lasts,
+    edge_wts, edge_mus, edge_sds, in_wts, in_mus, in_sds,
+    ret_wts, ret_mus, ret_sds,
+    epsilon: float = 1.0, n_sinkhorn: int = 40,
+    topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
+    sinkhorn_tol: float = 0.0,
+    max_preds: int = 0, max_succs: int = 0,
+):
+    """Both EM iterations for a whole service fleet in ONE dispatch.
+
+    The fleet analogue of :func:`solve_em_packed`: pass 0 over every
+    service's windows, per-service three-family delay extraction, one
+    batched BIC-GMM refit over the ``P*Ne`` family rows, then pass 1 —
+    the whole bench workload's EM never leaves the device and costs a
+    single round trip through the tunnel.
+
+    ``window_rows``/``window_valid`` ([P, Bmax] int32/bool) list each
+    service's window rows in the fleet batch (the packer emits services as
+    contiguous row blocks). The per-service refit matrix is built by
+    GATHERING those rows — ``[P*Ne, Bmax*W]`` — rather than broadcasting
+    the full sample matrix per service (``[P*Ne, B*W]``): the window axis
+    a service's EM sees shrinks from the whole fleet's to its own, so the
+    refit block stays ~P× smaller and scales to exp5-size fleets.
+    """
+    assign0, _, _, _, _ = _solve_windows_impl(
+        in_start, in_end, in_valid, out_start, out_end, out_valid,
+        skip_cap, force_skip, param_idx,
+        pred_masks, root_masks, is_lasts,
+        edge_wts, edge_mus, edge_sds, in_wts, in_mus, in_sds,
+        ret_wts, ret_mus, ret_sds,
+        epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
+        n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
+        max_preds=max_preds, max_succs=max_succs,
+    )
+
+    tables = _fleet_refit_tables(
+        assign0, in_start, in_end, in_valid, out_start, out_end,
+        param_idx, window_rows, window_valid, pred_masks, root_masks,
+        edge_wts, edge_mus, edge_sds,
+        in_wts, in_mus, in_sds, ret_wts, ret_mus, ret_sds)
+    return solve_windows_fleet(
+        in_start, in_end, in_valid, out_start, out_end, out_valid,
+        skip_cap, force_skip, param_idx,
+        pred_masks, root_masks, is_lasts,
+        *tables,
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs,
@@ -1139,7 +1206,9 @@ class WeaverTPU:
             assign = o[..., 0]
             not_best = o[..., 1].astype(bool)
             feas = o[..., 2]
-            topk_cols = o[..., 3:]
+            # o[..., 3] is the per-window convergence flag (consumed by
+            # the fleet path's compaction redispatch; unused here)
+            topk_cols = o[..., 4:]
             results.append((packed, (assign, topk_cols, not_best, feas)))
         stats["wait_s"] = stats.get("wait_s", 0.0) + (
             _time.perf_counter() - t0)

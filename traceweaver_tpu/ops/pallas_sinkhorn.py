@@ -176,6 +176,232 @@ def sinkhorn_log_pallas(
     return plan[:n, :m].astype(scores.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Fused persistent-sweep kernel: Sinkhorn + greedy rounding + top-k peel in
+# ONE pallas_call per (window, endpoint) block. The plain kernel above keeps
+# the score block resident for the Sinkhorn loop but still round-trips the
+# [N, M] plan through HBM to the rounding and top-k programs (the while.*
+# and copy-start ops in PROFILE_r05_tpu.json); here the plan never leaves
+# VMEM — the block's entire device lifetime is one kernel whose only HBM
+# traffic is one score read and one [N, 128] int32 result write.
+# ---------------------------------------------------------------------------
+
+# lane width of the packed int32 result block: col 0 = assignment,
+# cols 1..topk = top-k candidate columns, rest padding (a full 128-lane
+# tile is the natural store unit; the padding lanes are dead weight but
+# ~64x smaller than the plan block the fusion stops writing)
+_FUSED_OUT_LANES = 128
+
+
+def _fused_kernel(s_ref, r_ref, c_ref, cap_ref, out_ref, *, n_iters: int,
+                  inv_eps: float, tol_phi: float, n_rows: int, skip_col: int,
+                  topk: int, min_topk_mass: float):
+    """Sinkhorn solve + greedy rounding + top-k peel, VMEM-resident.
+
+    The rounding and peel bodies are the SAME code the XLA path runs
+    (:func:`traceweaver_tpu.ops.rounding.greedy_round_core` /
+    :func:`topk_peel_core` — written against the Mosaic-lowerable jnp
+    subset), so kernel-vs-jnp equivalence reduces to the Sinkhorn plan
+    agreeing, which the existing plan-level property tests pin down.
+    """
+    from traceweaver_tpu.ops.rounding import greedy_round_core, topk_peel_core
+
+    logK = s_ref[:] * inv_eps      # [Rp, Cp], VMEM-resident throughout
+    log_r = r_ref[:]               # [Rp, 1] log row marginals (NEG = disabled)
+    log_c = c_ref[:]               # [1, Cp]
+
+    def lse_rows(x):
+        m = jnp.max(x, axis=1, keepdims=True)
+        return m + jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True))
+
+    def lse_cols(x):
+        m = jnp.max(x, axis=0, keepdims=True)
+        return m + jnp.log(jnp.sum(jnp.exp(x - m), axis=0, keepdims=True))
+
+    def update(f, g):
+        f = log_r - lse_rows(logK + g)
+        f = jnp.where(log_r > NEG / 2, f, NEG)
+        g = log_c - lse_cols(logK + f)
+        g = jnp.where(log_c > NEG / 2, g, NEG)
+        return f, g
+
+    f = jnp.zeros_like(log_r)
+    g = jnp.zeros_like(log_c)
+    if tol_phi == 0.0:
+        f, g = jax.lax.fori_loop(
+            0, n_iters, lambda _, fg: update(*fg), (f, g))
+    else:
+        def body(state):
+            f, g, it, _ = state
+            f_new, g_new = update(f, g)
+            live = log_r > NEG / 2
+            delta = jnp.max(jnp.where(live, jnp.abs(f_new - f), 0.0))
+            return f_new, g_new, it + 1, delta
+
+        def cond(state):
+            _, _, it, delta = state
+            return (it < n_iters) & (delta > tol_phi)
+
+        init = (f, g, jnp.asarray(0, jnp.int32),
+                jnp.asarray(jnp.inf, jnp.float32))
+        f, g, _, _ = jax.lax.while_loop(cond, body, init)
+
+    plan = jnp.exp(jnp.clip(logK + f + g, -80.0, 80.0))  # [Rp, Cp]
+
+    rp, cp = plan.shape
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (rp, cp), 0)
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (rp, cp), 1)
+    # rounding sees only the window's real rows (the dummy surplus row at
+    # n_rows and the sublane padding never take a hard assignment) and
+    # the real + skip columns (lane padding carries NEG)
+    row_valid = (row_iota < n_rows) & (log_r > NEG / 2)
+    col_valid = (log_c > NEG / 2) & (col_iota <= skip_col)
+    cap = cap_ref[0, 0]
+    mass0 = jnp.where(row_valid & col_valid, plan, NEG)
+    assign = greedy_round_core(mass0, cap.astype(jnp.int32),
+                               n_steps=n_rows, skip_col=skip_col)
+
+    tk_mass, tk = topk_peel_core(jnp.where(col_valid, plan, NEG), topk)
+    tk = jnp.where(tk_mass > min_topk_mass, tk, -1)
+
+    oc = jax.lax.broadcasted_iota(jnp.int32, (rp, _FUSED_OUT_LANES), 1)
+    out = jnp.where(oc == 0, assign[:, None], -1)
+    for s in range(topk):
+        out = jnp.where(oc == 1 + s, tk[:, s:s + 1], out)
+    out_ref[:] = out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_rows", "epsilon", "n_iters", "tol", "topk",
+                              "min_topk_mass", "interpret"))
+def fused_assign_pallas(
+    scores: jnp.ndarray,         # [R, C] OT block incl. dummy row + skip col
+    row_marginals: jnp.ndarray,  # [R] target row masses (0 disables)
+    col_marginals: jnp.ndarray,  # [C]; col_marginals[C-1] = skip capacity
+    skip_cap: jnp.ndarray,       # scalar f32 skip capacity (rounding budget)
+    n_rows: int,                 # real (non-dummy) row count W; static
+    epsilon: float = 1.0,
+    n_iters: int = 50,
+    tol: float = 0.0,
+    topk: int = 5,
+    min_topk_mass: float = 1e-3,
+    interpret: bool = False,
+):
+    """Fused drop-in for ``sinkhorn -> greedy_round -> topk_peel``.
+
+    Returns ``(assign [n_rows] int32, topk_cols [n_rows, topk] int32)``
+    with the jnp composition's exact semantics: ``assign`` indexes the
+    chosen column (``C-1`` = skip, -1 = none) and ``topk_cols`` holds the
+    plan-mass ranking already filtered by ``min_topk_mass`` (-1 below it).
+    The last column of ``scores`` must be the skip column (its rounding
+    capacity is ``skip_cap``; its marginal rides ``col_marginals[-1]``).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r_dim, c_dim = scores.shape
+    rp, cp = _round_up(r_dim, 8), _round_up(c_dim, 128)
+
+    s = jnp.full((rp, cp), NEG, dtype=jnp.float32)
+    s = jax.lax.dynamic_update_slice(s, scores.astype(jnp.float32), (0, 0))
+    log_r = jnp.where(row_marginals > 0,
+                      jnp.log(jnp.maximum(row_marginals, 1e-30)), NEG)
+    log_c = jnp.where(col_marginals > 0,
+                      jnp.log(jnp.maximum(col_marginals, 1e-30)), NEG)
+    r = jnp.full((rp, 1), NEG, dtype=jnp.float32)
+    r = jax.lax.dynamic_update_slice(
+        r, log_r.astype(jnp.float32)[:, None], (0, 0))
+    c = jnp.full((1, cp), NEG, dtype=jnp.float32)
+    c = jax.lax.dynamic_update_slice(
+        c, log_c.astype(jnp.float32)[None, :], (0, 0))
+    cap = jnp.asarray(skip_cap, jnp.float32).reshape(1, 1)
+
+    kernel = functools.partial(
+        _fused_kernel, n_iters=n_iters, inv_eps=1.0 / epsilon,
+        tol_phi=tol / epsilon, n_rows=n_rows, skip_col=c_dim - 1,
+        topk=topk, min_topk_mass=min_topk_mass)
+    vmem_budget = min(_vmem_cap_bytes(),
+                      max(_VMEM_FLOOR_BYTES, 6 * rp * cp * 4))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rp, _FUSED_OUT_LANES), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_budget),
+    )(s, r, c, cap)
+    return out[:n_rows, 0], out[:n_rows, 1:1 + topk]
+
+
+def assign_topk_jnp(S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap,
+                    n_rows: int, *, epsilon: float, n_iters: int, tol: float,
+                    topk: int, min_topk_mass: float):
+    """Pure-XLA reference for the fused kernel: the exact
+    ``sinkhorn -> greedy_round -> topk_peel`` composition the solver ran
+    before fusion (and still runs off-TPU). The interpret-mode kernel is
+    property-tested against this path."""
+    from traceweaver_tpu.ops.rounding import greedy_round, topk_peel
+
+    plan = sinkhorn(S_ot, row_marg, col_marg,
+                    epsilon=epsilon, n_iters=n_iters, tol=tol)
+    plan = plan[:n_rows, :]
+    assign = greedy_round(plan, in_valid, col_valid,
+                          skip_cap.astype(jnp.int32), n_steps=n_rows)
+    tk_mass, tk = topk_peel(
+        jnp.where(col_valid[None, :], plan, NEG), topk)
+    tk = jnp.where(tk_mass > min_topk_mass, tk, -1).astype(jnp.int32)
+    return assign, tk
+
+
+def assign_topk(S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap,
+                n_rows: int, *, epsilon: float, n_iters: int, tol: float,
+                topk: int, min_topk_mass: float):
+    """Backend-dispatching fused assignment: one persistent-sweep kernel on
+    TPU (score block, potentials, plan, and the rounding state all
+    VMEM-resident for the block's whole device lifetime), the jnp
+    composition elsewhere. Same gating policy as :func:`sinkhorn` — small
+    blocks and over-VMEM blocks stay on the XLA path, ``TW_PALLAS``
+    forces, platform selection happens at lowering time. ``TW_PALLAS_FUSED=0``
+    keeps the plain per-stage Pallas dispatch (kill switch: the Sinkhorn
+    kernel still runs fused-per-stage, only the cross-stage fusion is off).
+    """
+    n, m = S_ot.shape
+    fused_ok = os.environ.get("TW_PALLAS_FUSED", "1") not in ("0", "false", "")
+    if (not fused_ok or not use_pallas() or n * m < 64 * 128
+            or not fits_pallas_vmem(n, m)):
+        return assign_topk_jnp(
+            S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap, n_rows,
+            epsilon=epsilon, n_iters=n_iters, tol=tol, topk=topk,
+            min_topk_mass=min_topk_mass)
+    if os.environ.get("TW_PALLAS_INTERPRET") == "1":
+        return fused_assign_pallas(
+            S_ot, row_marg, col_marg, skip_cap, n_rows,
+            epsilon=epsilon, n_iters=n_iters, tol=tol, topk=topk,
+            min_topk_mass=min_topk_mass, interpret=True)
+
+    def _tpu_path(s, rm, cm, iv, cv, cap):
+        return fused_assign_pallas(
+            s, rm, cm, cap, n_rows,
+            epsilon=epsilon, n_iters=n_iters, tol=tol, topk=topk,
+            min_topk_mass=min_topk_mass, interpret=False)
+
+    def _other_path(s, rm, cm, iv, cv, cap):
+        return assign_topk_jnp(
+            s, rm, cm, iv, cv, cap, n_rows,
+            epsilon=epsilon, n_iters=n_iters, tol=tol, topk=topk,
+            min_topk_mass=min_topk_mass)
+
+    return jax.lax.platform_dependent(
+        S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap,
+        tpu=_tpu_path, axon=_tpu_path, default=_other_path)
+
+
 def _tpu_backend() -> bool:
     try:
         return jax.default_backend() in ("tpu", "axon")

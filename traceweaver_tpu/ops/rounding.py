@@ -44,6 +44,87 @@ NEG = -1.0e9
 MAX_PEEL_K = 16
 
 
+def greedy_round_core(
+    mass0: jnp.ndarray,          # [N, C] pre-masked plan (NEG = unavailable)
+    skip_capacity: jnp.ndarray,  # scalar int32
+    n_steps: int,
+    skip_col: int,
+) -> jnp.ndarray:
+    """Shared peel body: returns assignment [N] int32 (-1 = none).
+
+    ``skip_col`` is the static index of the capacity-``skip_capacity``
+    column; columns past it (lane padding when this runs inside the fused
+    Pallas kernel) must carry NEG everywhere so they can never be picked.
+    Written against the Mosaic-lowerable subset of jnp — 2D
+    ``broadcasted_iota`` instead of 1D ``arange``, broadcast-compare
+    one-hots instead of scatter/gather — so ONE definition serves both the
+    jitted XLA path (:func:`greedy_round`) and the fused TPU kernel
+    (:func:`traceweaver_tpu.ops.pallas_sinkhorn.fused_assign_pallas`);
+    the jnp path doubles as the kernel's interpret-mode reference.
+    """
+    n, c = mass0.shape
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)[:, 0]   # [N]
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (n, c), 1)     # [N, C]
+    real_cols = col_iota < skip_col
+
+    def cond(state):
+        _, _, _, t, progressed = state
+        return progressed & (t < n_steps)
+
+    def body(state):
+        mass, assign, skip_used, t, _ = state
+        live = jnp.where(real_cols, mass, NEG)         # [N, C] real columns
+
+        row_arg = jnp.argmax(mass, axis=1).astype(jnp.int32)  # [N]
+        row_val = jnp.max(mass, axis=1)
+        active = (assign == -1) & (row_val > NEG / 2)
+
+        # mutual-best commits on real columns: row i's best column also
+        # ranks i as its best remaining row
+        col_best_row = jnp.argmax(live, axis=0).astype(jnp.int32)  # [C]
+        picks_real = active & (row_arg < skip_col)
+        pick_onehot = col_iota == row_arg[:, None]                 # [N, C]
+        mutual = pick_onehot & (col_best_row[None, :] == rows[:, None])
+        commit_real = picks_real & jnp.any(mutual, axis=1)
+
+        # skip commits: a row wanting skip commits only when its skip mass
+        # ranks inside the remaining capacity among ALL active rows (rows
+        # still contesting real columns may fall back to skip later, and the
+        # serial peel serves skip cells in decreasing mass order)
+        wants_skip = active & (row_arg == skip_col)
+        skip_mass_col = mass[:, skip_col]
+        contender = active & (skip_mass_col > NEG / 2)
+        skip_mass = jnp.where(contender, skip_mass_col, NEG)
+        beats = (skip_mass[None, :] > skip_mass[:, None]) | (
+            (skip_mass[None, :] == skip_mass[:, None])
+            & (rows[None, :] < rows[:, None])
+        )
+        rank = jnp.sum((beats & contender[None, :]).astype(jnp.int32), axis=1)
+        room = jnp.maximum(skip_capacity - skip_used, 0)
+        commit_skip = wants_skip & (rank < room)
+
+        commit = commit_real | commit_skip
+        assign = jnp.where(commit, row_arg, assign)
+        skip_used = skip_used + jnp.sum(commit_skip.astype(jnp.int32))
+
+        # eliminate committed rows and real columns (one-hot reduction —
+        # the scatter formulation does not lower under Mosaic)
+        mass = jnp.where(commit[:, None], NEG, mass)
+        col_taken = jnp.any(commit_real[:, None] & pick_onehot, axis=0)
+        mass = jnp.where(col_taken[None, :], NEG, mass)
+        mass = jnp.where(
+            (skip_used >= skip_capacity) & (col_iota == skip_col),
+            NEG, mass,
+        )
+        return mass, assign, skip_used, t + 1, jnp.any(commit)
+
+    init = (mass0, jnp.full((n,), -1, dtype=jnp.int32),
+            jnp.asarray(0, dtype=jnp.int32), jnp.asarray(0, dtype=jnp.int32),
+            jnp.asarray(True))
+    _, assign, _, _, _ = jax.lax.while_loop(cond, body, init)
+    return assign
+
+
 @partial(jax.jit, static_argnames=("n_steps",))
 def greedy_round(
     plan: jnp.ndarray,          # [N, M+1]: last column = skip
@@ -54,70 +135,8 @@ def greedy_round(
 ) -> jnp.ndarray:
     """Returns assignment [N] int32: column index per row, M = skip, -1 = none."""
     n, m1 = plan.shape
-    skip_col = m1 - 1
-
     mass0 = jnp.where(row_valid[:, None] & col_valid[None, :], plan, NEG)
-    rows = jnp.arange(n)
-
-    def cond(state):
-        _, _, _, t, progressed = state
-        return progressed & (t < n_steps)
-
-    def body(state):
-        mass, assign, skip_used, t, _ = state
-        live = mass[:, :skip_col]                      # [N, M] real columns
-
-        row_arg = jnp.argmax(mass, axis=1)             # [N]
-        row_val = jnp.max(mass, axis=1)
-        active = (assign == -1) & (row_val > NEG / 2)
-
-        # mutual-best commits on real columns: row i's best column also
-        # ranks i as its best remaining row
-        col_best_row = jnp.argmax(live, axis=0)        # [M]
-        picks_real = active & (row_arg < skip_col)
-        commit_real = picks_real & (
-            col_best_row[jnp.minimum(row_arg, skip_col - 1)] == rows
-        )
-
-        # skip commits: a row wanting skip commits only when its skip mass
-        # ranks inside the remaining capacity among ALL active rows (rows
-        # still contesting real columns may fall back to skip later, and the
-        # serial peel serves skip cells in decreasing mass order)
-        wants_skip = active & (row_arg == skip_col)
-        contender = active & (mass[:, skip_col] > NEG / 2)
-        skip_mass = jnp.where(contender, mass[:, skip_col], NEG)
-        beats = (skip_mass[None, :] > skip_mass[:, None]) | (
-            (skip_mass[None, :] == skip_mass[:, None])
-            & (rows[None, :] < rows[:, None])
-        )
-        rank = jnp.sum(beats & contender[None, :], axis=1)
-        room = jnp.maximum(skip_capacity - skip_used, 0)
-        commit_skip = wants_skip & (rank < room)
-
-        commit = commit_real | commit_skip
-        assign = jnp.where(commit, row_arg.astype(jnp.int32), assign)
-        skip_used = skip_used + jnp.sum(commit_skip).astype(jnp.int32)
-
-        # eliminate committed rows and real columns
-        mass = jnp.where(commit[:, None], NEG, mass)
-        col_taken = (
-            jnp.zeros((m1,), dtype=bool)
-            .at[jnp.where(commit_real, row_arg, m1)]
-            .set(True, mode="drop")
-        )
-        mass = jnp.where(col_taken[None, :], NEG, mass)
-        mass = jnp.where(
-            (skip_used >= skip_capacity)
-            & (jnp.arange(m1) == skip_col)[None, :],
-            NEG, mass,
-        )
-        return mass, assign, skip_used, t + 1, jnp.any(commit)
-
-    init = (mass0, jnp.full((n,), -1, dtype=jnp.int32),
-            jnp.asarray(0, dtype=jnp.int32), jnp.asarray(0, dtype=jnp.int32),
-            jnp.asarray(True))
-    _, assign, _, _, _ = jax.lax.while_loop(cond, body, init)
-    return assign
+    return greedy_round_core(mass0, skip_capacity, n_steps, skip_col=m1 - 1)
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -174,21 +193,39 @@ def topk_peel(x: jnp.ndarray, k: int):
     if k == 0:
         empty = x.shape[:-1] + (0,)
         return (jnp.zeros(empty, x.dtype), jnp.zeros(empty, jnp.int32))
+    return topk_peel_core(x, k)
+
+
+def topk_peel_core(x: jnp.ndarray, k: int):
+    """Guard-free body of :func:`topk_peel` (k >= 1 argmax+mask passes).
+
+    Value extraction uses a one-hot masked sum instead of
+    ``take_along_axis`` and index vectors come from 2D
+    ``broadcasted_iota`` — the Mosaic-lowerable subset — so this one
+    definition runs both under plain XLA (via :func:`topk_peel`) and
+    inside the fused Pallas kernel
+    (:func:`traceweaver_tpu.ops.pallas_sinkhorn.fused_assign_pallas`).
+    The masked sum maps ``-0.0`` picks to ``+0.0`` (one more signed-zero
+    caveat on top of :func:`topk_peel`'s documented tie behaviour —
+    irrelevant for the solver's non-negative plan blocks).
+    """
     vals, idxs = [], []
-    iota = jnp.arange(x.shape[-1])
+    iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
     picked = jnp.zeros(x.shape, bool)
     for step in range(k):
         masked = jnp.where(picked, -jnp.inf, x)
-        i = jnp.argmax(masked, axis=-1)
+        i = jnp.argmax(masked, axis=-1).astype(jnp.int32)
         if step > 0:
             # pass 0 needs no fallback: nothing is picked yet, so an
             # all--inf row's argmax is already index 0 — top_k's answer.
             # (Also keeps XLA from constant-folding an argmax over the
             # constant all-False mask, ~12 s of compile time at W=1024.)
-            mv = jnp.take_along_axis(masked, i[..., None], -1)[..., 0]
-            first_unpicked = jnp.argmax(~picked, axis=-1)
+            mv = jnp.max(masked, axis=-1)  # == masked at i (i is argmax)
+            first_unpicked = jnp.argmax(
+                (~picked).astype(jnp.int32), axis=-1).astype(jnp.int32)
             i = jnp.where(jnp.isneginf(mv), first_unpicked, i)
-        vals.append(jnp.take_along_axis(x, i[..., None], -1)[..., 0])
+        sel = iota == i[..., None]
+        vals.append(jnp.sum(jnp.where(sel, x, jnp.zeros_like(x)), axis=-1))
         idxs.append(i)
-        picked = picked | (iota == i[..., None])
+        picked = picked | sel
     return jnp.stack(vals, -1), jnp.stack(idxs, -1).astype(jnp.int32)

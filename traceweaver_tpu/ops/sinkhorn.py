@@ -42,6 +42,18 @@ def sinkhorn_log(
     score matrices converge in well under half the iteration budget, and
     the loop is the solver's dominant sequential cost. ``tol=0`` runs the
     full fixed count (bitwise-identical to the pre-tolerance behaviour).
+
+    Batch (``vmap``) semantics of the tolerance: a batched ``while_loop``
+    iterates until the SLOWEST problem's delta clears ``tol``, so one
+    hard window pins the whole batch at its iteration count. Each
+    problem carries its own ``done`` flag and freezes its potentials the
+    iteration after its delta converges — later iterations are explicit
+    no-ops for it, which makes every problem's result identical to a
+    solo (unbatched) run with the same ``tol`` regardless of its
+    batchmates. The frozen problems still occupy VPU lanes until the
+    slowest finishes; reclaiming those cycles is the caller's job
+    (convergence compaction in :mod:`traceweaver_tpu.algorithms.fleet`
+    redispatches only unconverged windows).
     """
     log_r = jnp.where(row_marginals > 0, jnp.log(jnp.maximum(row_marginals, 1e-30)), NEG)
     log_c = jnp.where(col_marginals > 0, jnp.log(jnp.maximum(col_marginals, 1e-30)), NEG)
@@ -65,19 +77,25 @@ def sinkhorn_log(
             0, n_iters, lambda _, fg: update(*fg), (f0, g0))
     else:
         def body(state):
-            f, g, it, _ = state
+            f, g, it, done = state
             f_new, g_new = update(f, g)
             # delta over live rows (disabled rows sit at NEG on both sides)
             live = row_marginals > 0
             delta = jnp.max(jnp.where(live, jnp.abs(f_new - f), 0.0))
-            return f_new, g_new, it + 1, delta
+            # per-problem live mask: the converging iteration's update is
+            # still ACCEPTED (matching the unbatched exit, which keeps
+            # f_new), then the problem freezes — under vmap its updates
+            # are no-ops while slower batchmates keep iterating, so the
+            # result cannot depend on who it was batched with
+            f = jnp.where(done, f, f_new)
+            g = jnp.where(done, g, g_new)
+            return f, g, it + 1, done | (delta <= tol)
 
         def cond(state):
-            _, _, it, delta = state
-            return (it < n_iters) & (delta > tol)
+            _, _, it, done = state
+            return (it < n_iters) & ~done
 
-        init = (f0, g0, jnp.asarray(0, jnp.int32),
-                jnp.asarray(jnp.inf, scores.dtype))
+        init = (f0, g0, jnp.asarray(0, jnp.int32), jnp.asarray(False))
         f, g, _, _ = jax.lax.while_loop(cond, body, init)
 
     log_plan = logK + (f[:, None] + g[None, :]) / epsilon

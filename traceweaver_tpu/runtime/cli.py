@@ -170,6 +170,15 @@ def stream_main(argv) -> int:
              summary["stats"].get("spans_emitted", 0),
              summary["late_rerouted"], summary["late_dropped"],
              summary["shed_spilled"], summary["shed_dropped_windows"]))
+    # compile/cache accounting (persistent cache is enabled above for this
+    # subcommand, same as the batch entry points): a warm stream should
+    # show zero compiles after the first micro-batch — nonzero recompiles
+    # here mean shape classes multiplied mid-stream
+    fleet = summary.get("fleet", {})
+    print("[stream] xla compiles: %d (%d persistent-cache hits, %d misses)"
+          % (int(fleet.get("backend_compiles", 0)),
+             int(fleet.get("persistent_cache_hits", 0)),
+             int(fleet.get("persistent_cache_misses", 0))))
     streamed_acc = None
     if "accuracy" in summary:
         streamed_acc = summary["accuracy"]["e2e"]

@@ -253,13 +253,30 @@ def _solve_fleet_method(cfg: ExecutorConfig, store: TraceStore, method: str,
     ]
     start = time.time()
     cells: List[float] = [1.0] * len(items)
+    fleet_stats: Dict[str, float] = {}
     outs = solve_fleet(
         items, max_window=predictor.max_window, epsilon=predictor.epsilon,
         n_sinkhorn=predictor.n_sinkhorn, n_sweeps=predictor.n_sweeps,
         sinkhorn_tol=predictor.sinkhorn_tol, mesh=predictor.mesh,
-        item_cells=cells,
+        item_cells=cells, stats=fleet_stats,
     )
     elapsed = time.time() - start
+    # dispatch observability: recompiles are the shape-class regression
+    # signal (a warm steady state runs at zero), and the compaction line
+    # says how much sweep work the convergence redispatch reclaimed
+    n_compiles = int(fleet_stats.get("backend_compiles", 0))
+    n_hits = int(fleet_stats.get("persistent_cache_hits", 0))
+    if n_compiles or n_hits:
+        print("[fleet] %s: %d dispatches, %d XLA compiles "
+              "(%d persistent-cache hits)"
+              % (method, int(fleet_stats.get("fleet_dispatches", 0)),
+                 n_compiles, n_hits))
+    total_w = fleet_stats.get("compact_windows_total", 0)
+    if total_w:
+        print("[fleet] %s: compaction redispatched %d/%d windows "
+              "past the warm sweeps"
+              % (method, int(fleet_stats.get(
+                  "compact_windows_redispatched", 0)), int(total_w)))
     # per-service seconds = share of the dispatch wall-clock proportional
     # to each service's padded compute cells at its own shape class — the
     # quantity the device spends time on (the same attribution model the

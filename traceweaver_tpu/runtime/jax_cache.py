@@ -19,6 +19,61 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_cache")
 
+# process-wide compile/cache counters, fed by JAX's monitoring events:
+#   backend_compiles        — XLA backend compilations (every one of these
+#                             is a real compile: a shape-class regression
+#                             that silently multiplies program variants
+#                             shows up here first)
+#   persistent_cache_hits   — programs deserialized from the on-disk cache
+#   persistent_cache_misses — programs that had to compile despite the
+#                             cache being enabled (cold entry)
+_COUNTERS = {"backend_compiles": 0,
+             "persistent_cache_hits": 0,
+             "persistent_cache_misses": 0}
+_COUNTERS_INSTALLED = False
+
+
+def install_compile_counters() -> None:
+    """Register (idempotent) monitoring listeners that maintain the
+    process-wide compile/cache counters. Called automatically by
+    :func:`enable_persistent_compilation_cache` and lazily by
+    :func:`compile_counters`, so callers that only want recompile counts
+    (e.g. the bench smoke test) need no cache directory."""
+    global _COUNTERS_INSTALLED
+    if _COUNTERS_INSTALLED:
+        return
+    from jax._src import monitoring
+
+    def _on_event(name, **kw):
+        if name == "/jax/compilation_cache/cache_hits":
+            _COUNTERS["persistent_cache_hits"] += 1
+        elif name == "/jax/compilation_cache/cache_misses":
+            _COUNTERS["persistent_cache_misses"] += 1
+
+    def _on_duration(name, secs, **kw):
+        if name == "/jax/core/compile/backend_compile_duration":
+            _COUNTERS["backend_compiles"] += 1
+
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _COUNTERS_INSTALLED = True
+
+
+def compile_counters() -> dict:
+    """Snapshot of the process-wide compile/cache counters (installs the
+    listeners on first use). Take one before and one after a dispatch and
+    diff with :func:`counters_delta` to see whether it recompiled."""
+    install_compile_counters()
+    return dict(_COUNTERS)
+
+
+def counters_delta(before: dict, after: dict | None = None) -> dict:
+    """Per-dispatch counter delta: ``after`` (default: now) minus
+    ``before``, key-wise."""
+    if after is None:
+        after = compile_counters()
+    return {k: after[k] - before.get(k, 0) for k in after}
+
 
 def host_cache_key() -> str:
     """Backend+host fingerprint namespacing the compile cache.
@@ -63,6 +118,7 @@ def enable_persistent_compilation_cache(cache_dir: str | None = None) -> str:
     directory is always namespaced per backend+host (:func:`host_cache_key`)
     so entries compiled elsewhere can never be deserialized here.
     """
+    install_compile_counters()
     if os.environ.get("TW_JAX_CACHE", "1") in ("0", "false", ""):
         return ""
     base_dir = (cache_dir or os.environ.get("TW_JAX_CACHE_DIR")

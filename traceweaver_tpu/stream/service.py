@@ -206,9 +206,30 @@ class StreamingReconstructor:
                 owners.append(b)
         outs = []
         if items:
+            from traceweaver_tpu.runtime.jax_cache import (
+                compile_counters,
+                counters_delta,
+            )
+
+            counters_before = compile_counters()
             outs = solve_fleet(items, all_spans=self.live.all_spans,
                                all_processes=self.live.all_processes,
                                stats=self.fleet_stats)
+            delta = counters_delta(counters_before)
+            self.stats["micro_batches"] = self.stats.get(
+                "micro_batches", 0) + 1
+            # per-dispatch compile/cache visibility: a warm stream runs at
+            # zero compiles per micro-batch; any nonzero line here is a new
+            # shape class (or a cold persistent cache) — exactly the
+            # regression the batch bench's recompile counter watches for
+            if self.cfg.verbose and (delta["backend_compiles"]
+                                     or delta["persistent_cache_hits"]):
+                print("[stream] micro-batch %d: %d windows, %d XLA "
+                      "compiles (%d persistent-cache hits, %d misses)"
+                      % (self.stats["micro_batches"], len(bufs),
+                         delta["backend_compiles"],
+                         delta["persistent_cache_hits"],
+                         delta["persistent_cache_misses"]))
         solve_s = time.perf_counter() - t0
         self.stats["solve_s"] = self.stats.get("solve_s", 0.0) + solve_s
 
