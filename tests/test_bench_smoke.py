@@ -15,6 +15,7 @@ wall-clock bound.
 import time
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -88,8 +89,6 @@ def test_compaction_redispatch_shapes_stay_bucketed():
     bucketed shapes once; an immediate repeat must be compile-free."""
     import traceweaver_tpu.algorithms.fleet as fleet_mod
 
-    import traceweaver_tpu.algorithms.fleet as fleet_mod
-
     (in_start, in_end, in_valid, out_start, out_end, out_valid,
      skip_cap, force_skip, *tables) = _tiny_args(seed=1)
     batch = dict(in_start=in_start, in_end=in_end, in_valid=in_valid,
@@ -106,3 +105,33 @@ def test_compaction_redispatch_shapes_stay_bucketed():
     assert delta["backend_compiles"] == 0, delta
     out_b = fleet_mod._compacted_pass(batch, pidx, tables, 4, 2, hypers, {})
     assert np.array_equal(out_a, out_b)
+
+
+@pytest.mark.pipeline
+def test_pipelined_fleet_runs_and_second_solve_is_compile_free():
+    """Tier-1 pipeline smoke: under JAX_PLATFORMS=cpu the fleet solve
+    must take the PIPELINED dispatch path (no silent fallback to the
+    serial flow — the kill switch is TW_PIPELINE=0, nothing else), and a
+    second identical pipelined solve must cost zero backend compiles
+    (the pipeline cannot be allowed to multiply program variants)."""
+    from test_pipeline import _mixed_items
+
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+    items = _mixed_items()
+    stats = {}
+    out1 = solve_fleet(items, stats=stats)
+    assert stats.get("pipeline_groups", 0) > 0, (
+        "fleet solve silently fell back to the serial dispatcher: "
+        f"{stats}")
+    assert stats.get("pipeline_depth", 0) >= 1
+    assert stats.get("d2h_bytes_fetched", 0) > 0
+
+    before = compile_counters()
+    out2 = solve_fleet(items, stats={})
+    delta = counters_delta(before)
+    assert delta["backend_compiles"] == 0, (
+        "identical second pipelined solve recompiled — a shape-class or "
+        f"static-arg leak is multiplying program variants: {delta}")
+    for a, b in zip(out1, out2):
+        assert a[0] == b[0] and a[1] == b[1] and a[2:] == b[2:]

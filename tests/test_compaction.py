@@ -93,8 +93,8 @@ HYPERS = dict(epsilon=1.0, n_sinkhorn=20, sinkhorn_tol=1e-3,
 def test_compacted_pass_bit_identical(warm):
     batch, _, tables, pidx, _, _ = _fleet_tensors()
     args = tuple(batch[k] for k in fleet_mod._BATCH_KEYS) + (pidx,)
-    full = np.asarray(solve_windows_fleet(
-        *args, *tables, n_sweeps=5, **HYPERS))
+    full, _flags = solve_windows_fleet(*args, *tables, n_sweeps=5, **HYPERS)
+    full = np.asarray(full)
     stats = {}
     compacted = fleet_mod._compacted_pass(
         batch, pidx, tables, 5, warm, HYPERS, stats)
@@ -124,8 +124,9 @@ def test_compacted_two_pass_em_bit_identical():
     must reproduce the single fused solve_em_fleet program bitwise."""
     batch, params, tables, pidx, wr, wv = _fleet_tensors()
     args = tuple(batch[k] for k in fleet_mod._BATCH_KEYS) + (pidx,)
-    fused = np.asarray(solve_em_fleet(
-        *args, wr, wv, *tables, n_sweeps=5, **HYPERS))
+    fused, _flags = solve_em_fleet(*args, wr, wv, *tables, n_sweeps=5,
+                                   **HYPERS)
+    fused = np.asarray(fused)
     stats = {}
     compacted = fleet_mod._solve_group_compacted(
         batch, pidx, params, tables, wr, wv, n_passes=2, n_sweeps=5,

@@ -101,7 +101,8 @@ def test_fleet_budget_fallback_is_equivalent(hotel_problems, monkeypatch):
     monkeypatch.setattr(fleet_mod, "FLEET_BUDGET_ELEMS", 1)
     stats = {}
     fell_back = solve_fleet(items, stats=stats)
-    assert stats.get("fleet_fallback_budget") == 1.0
+    # a COUNT of over-budget groups (>= 1), not a flag
+    assert stats.get("fleet_fallback_budget", 0) >= 1.0
     for f, s in zip(fused, fell_back):
         assert f[0] == s[0]
 
@@ -129,7 +130,7 @@ def test_fleet_budget_bounds_refit_matrix_at_scale(hotel_problems,
     stats = {}
     monkeypatch.setattr(fleet_mod, "FLEET_BUDGET_ELEMS", 1 << 18)
     out = solve_fleet(items, stats=stats)
-    assert stats.get("fleet_fallback_budget") == 1.0
+    assert stats.get("fleet_fallback_budget", 0) >= 1.0
     assert stats.get("pack_s") is not None  # fallback stats merged
     by_svc = {it.svc: s for it, s in zip(base, singles)}
     for it, o in zip(items, out):

@@ -121,6 +121,11 @@ def test_fleet_identical_on_1_vs_8_devices(mesh8, hotel_store):
     stats = {}
     sharded = solve_fleet(items, mesh=mesh8, stats=stats)
     assert stats.get("fleet_dispatches", 0) >= 1
+    # convergence compaction covers the sharded path too: the flag-only
+    # fetch (O(B) bytes) and the per-shard-bucketed redispatch must have
+    # engaged on this recorded workload
+    assert stats.get("compact_windows_total", 0) > 0
+    assert stats.get("d2h_bytes_flags", 0) > 0
     for it, s, m in zip(items, single, sharded):
         assert m[0] == s[0], f"mesh fleet diverged on {it.svc}"
         assert m[2] == s[2] and m[4] == s[4] and m[5] == s[5]

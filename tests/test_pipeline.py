@@ -1,0 +1,222 @@
+"""Pipelined fleet dispatch must be bit-identical to the serial flow.
+
+The dispatcher overlaps pack/transfer/compute/decode across shape-class
+groups (fleet._solve_groups_pipelined): a pack thread builds group N+1
+while group N executes, each group's dispatch/compaction/decode flow runs
+on a worker pool, and FLEET_BUDGET_ELEMS bounds the live in-flight
+elements. The pipeline reorders WORK only — these tests pin down that the
+6-tuple outputs are byte-for-byte the TW_PIPELINE=0 serial flow's, across
+the compacted two-pass EM path, the single-pass dynamism path, and the
+budget-drain path, and that the compaction flag fetch moves O(B) bytes
+(its own [B] bool array) instead of the whole packed block. The mesh leg
+checks that compaction now engages on sharded dispatches too, with the
+redispatch bucketed per shard, identically on 1 vs 8 devices.
+
+Everything here is synthetic (no dataset dependency) and interpret-safe
+under JAX_PLATFORMS=cpu — tier-1.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import traceweaver_tpu.algorithms.fleet as fleet_mod
+from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+from traceweaver_tpu.algorithms.weaver_tpu import solve_windows_fleet
+from traceweaver_tpu.spans import SKIP, Span
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.pipeline
+
+
+def _service_items(svc="svc", n_traces=48, burst=4, eps=("A", "B"),
+                   gap=5000.0, seed=0, drop_every=0):
+    """One FleetItem over a synthetic span stream: bursts of ``burst``
+    overlapping requests then a gap (window boundary), a chain DAG over
+    ``eps``. ``drop_every`` > 0 drops every k-th trace's outgoing spans
+    (skip budget > 0 -> the single-pass dynamism group)."""
+    import networkx as nx
+
+    rng = np.random.default_rng(seed)
+    in_spans = []
+    out_spans = {ep: [] for ep in eps}
+    ta = {ep: {} for ep in eps}
+    t = 0.0
+    for i in range(n_traces):
+        t += 30.0 if i % burst else gap
+        s_in = Span(f"{svc}-t{i}", "in", t, 400.0 + 40.0 * len(eps), "op",
+                    [], svc, "server")
+        in_spans.append(s_in)
+        dropped = drop_every and (i % drop_every == 0)
+        prev_end = t + 10.0
+        for ep in eps:
+            if dropped:
+                ta[ep][s_in.GetId()] = SKIP
+                continue
+            start = prev_end + 15.0 + rng.normal(0, 2)
+            s_out = Span(f"{svc}-t{i}", f"out-{ep}", start, 50.0,
+                         f"op{ep}", [], svc, "client")
+            out_spans[ep].append(s_out)
+            ta[ep][s_in.GetId()] = s_out.GetId()
+            prev_end = start + 50.0
+    dag = nx.DiGraph()
+    for a, b in zip(eps, eps[1:]):
+        dag.add_edge(a, b)
+    if len(eps) == 1:
+        dag.add_node(eps[0])
+    return FleetItem(svc, {"IN": in_spans}, out_spans, ta, dag)
+
+
+def _mixed_items():
+    """Three services in three distinct shape classes (different window
+    widths / endpoint counts / pass counts), so the dispatcher builds
+    several groups and the pipeline genuinely interleaves them."""
+    return [
+        _service_items("alpha", n_traces=48, burst=4, eps=("A", "B"),
+                       seed=0),
+        _service_items("beta", n_traces=60, burst=12, eps=("A", "B", "C"),
+                       seed=1),
+        _service_items("gamma", n_traces=40, burst=4, eps=("A", "B"),
+                       seed=2, drop_every=5),
+    ]
+
+
+def _assert_identical(a, b):
+    for x, y in zip(a, b):
+        assert x[0] == y[0]   # assignments
+        assert x[1] == y[1]   # top-k
+        assert x[2:] == y[2:]  # not_best / n / candidates / unassigned
+
+
+def test_pipelined_identical_to_serial(monkeypatch):
+    monkeypatch.setenv("TW_FLEET_MERGE", "0")  # keep the classes separate
+    items = _mixed_items()
+
+    monkeypatch.setenv("TW_PIPELINE", "0")
+    serial_stats = {}
+    serial = solve_fleet(items, stats=serial_stats)
+    assert serial_stats.get("pipeline_groups") is None  # kill switch works
+    assert serial_stats.get("fleet_dispatches", 0) >= 3
+
+    monkeypatch.setenv("TW_PIPELINE", "1")
+    stats = {}
+    piped = solve_fleet(items, stats=stats)
+    # the pipeline path actually ran, over every group, and engaged the
+    # compacted two-pass EM flow on the way (default TW_COMPACT=1)
+    assert stats.get("pipeline_groups", 0) >= 3
+    assert stats.get("pipeline_depth", 0) >= 1
+    assert stats.get("compact_windows_total", 0) > 0
+    _assert_identical(serial, piped)
+
+
+def test_pipelined_budget_drain_identical(monkeypatch):
+    """A live-element budget smaller than the workload total (but large
+    enough that no group falls back per-service) forces the serial drain
+    / pipeline admission gate; outputs must not change."""
+    monkeypatch.setenv("TW_FLEET_MERGE", "0")
+    items = _mixed_items()
+
+    probe_stats = {}
+    reference = solve_fleet(items, stats=probe_stats)
+    cost_max = probe_stats["fleet_group_cost_max"]
+    cost_total = probe_stats["fleet_group_cost_total"]
+    assert cost_total > cost_max  # several groups: the budget can bind
+
+    monkeypatch.setattr(fleet_mod, "FLEET_BUDGET_ELEMS", int(cost_max))
+    for pipeline in ("0", "1"):
+        monkeypatch.setenv("TW_PIPELINE", pipeline)
+        stats = {}
+        out = solve_fleet(items, stats=stats)
+        # the budget bound admissions but never tripped the per-group
+        # fallback (every group fits the budget alone)
+        assert stats.get("fleet_fallback_budget") is None
+        _assert_identical(reference, out)
+
+
+def test_flag_only_fetch_matches_full_fetch_and_is_tiny():
+    """The warm dispatch's convergence flags ride their own [B] bool
+    array: fetching it ALONE must (a) yield the same convergence set as
+    reading it after a full-tensor fetch and (b) move exactly B bytes,
+    not the packed block (the d2h_bytes_flags ledger proves it)."""
+    items = _mixed_items()[:1]
+    stats = {}
+    solve_fleet(items, stats=stats)
+    total = stats.get("compact_windows_total", 0)
+    assert total > 0  # compaction engaged
+    # bool flags: exactly one byte per window per compacted warm pass
+    assert stats["d2h_bytes_flags"] == total
+    # and the flag traffic is a vanishing share of all D2H traffic
+    assert stats["d2h_bytes_flags"] < 0.01 * stats["d2h_bytes_fetched"]
+
+    # same convergence set whether the flags are fetched alone or after
+    # the packed block has been pulled to the host (donation/aliasing of
+    # the big block must not disturb the separate flag array)
+    item = items[0]
+    prep = fleet_mod._prepare(
+        item, fleet_mod.WeaverTPU(None, None))
+    from traceweaver_tpu.algorithms.weaver_tpu import (
+        pack_problem, perfect_cut_windows)
+
+    windows = perfect_cut_windows(prep["in_spans"], 1024)
+    packed = pack_problem(prep["in_spans"], item.out_span_partitions,
+                          prep["out_eps"], prep["dists"], prep["in_ep"],
+                          item.dag, windows=windows)
+    a = packed.arrays
+    args = tuple(a[k] for k in fleet_mod._BATCH_KEYS) + (
+        np.zeros(a["in_start"].shape[0], np.int32),)
+    tables = tuple(a[k][None] for k in fleet_mod._TABLE_KEYS)
+    out1, flags1 = solve_windows_fleet(*args, *tables, n_sweeps=2)
+    flags_alone = np.asarray(flags1)              # flag-only fetch
+    out2, flags2 = solve_windows_fleet(*args, *tables, n_sweeps=2)
+    _full = np.asarray(out2)                      # full-tensor fetch first
+    flags_after_full = np.asarray(flags2)
+    assert flags_alone.dtype == np.bool_ and flags_alone.ndim == 1
+    assert np.array_equal(flags_alone, flags_after_full)
+
+
+def test_mesh_compaction_identical_on_1_vs_8_devices(monkeypatch):
+    """Convergence compaction now covers sharded dispatches: the mesh
+    path must redispatch only unconverged windows (per-shard-bucketed
+    batch) and stay identical to the single-device fleet AND to the
+    uncompacted mesh flow."""
+    from traceweaver_tpu.parallel.mesh import bucket_rows_per_shard, make_mesh
+
+    # the helper itself: per-shard power-of-two rows, divisible total
+    assert bucket_rows_per_shard(5, 1) == 8
+    assert bucket_rows_per_shard(5, 8) == 8
+    assert bucket_rows_per_shard(9, 8) == 16
+    assert bucket_rows_per_shard(17, 4) == 32
+
+    monkeypatch.setenv("TW_FLEET_MERGE", "0")
+    items = _mixed_items()
+    mesh = make_mesh(8)
+
+    single = solve_fleet(items)
+    stats_m = {}
+    sharded = solve_fleet(items, mesh=mesh, stats=stats_m)
+    # compaction engaged on the sharded dispatches
+    assert stats_m.get("compact_windows_total", 0) > 0
+    assert stats_m["d2h_bytes_flags"] > 0
+    _assert_identical(single, sharded)
+
+    monkeypatch.setenv("TW_COMPACT", "0")
+    stats_u = {}
+    uncompacted = solve_fleet(items, mesh=mesh, stats=stats_u)
+    assert stats_u.get("compact_windows_total") is None
+    _assert_identical(sharded, uncompacted)
+
+
+def test_stats_are_counts_not_flags(monkeypatch):
+    """Budget fallbacks accumulate a COUNT (one per over-budget group),
+    not an overwritten 1.0 flag — a mixed workload's ledger must say how
+    many groups degraded."""
+    monkeypatch.setenv("TW_FLEET_MERGE", "0")
+    items = _mixed_items()
+    monkeypatch.setattr(fleet_mod, "FLEET_BUDGET_ELEMS", 1)
+    stats = {}
+    out = solve_fleet(items, stats=stats)
+    # every group fell back per-service, and the counter says so
+    assert stats["fleet_fallback_budget"] >= 3.0
+    assert all(o is not None and len(o) == 6 for o in out)

@@ -34,7 +34,9 @@ path.
 from __future__ import annotations
 
 import os
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -49,6 +51,7 @@ from traceweaver_tpu.algorithms.weaver_tpu import (
     perfect_cut_windows,
     plan_find_assignments,
     refit_fleet_params,
+    scatter_window_span_stats,
     solve_em_fleet,
     solve_windows_fleet,
 )
@@ -62,6 +65,12 @@ FLEET_BUDGET_ELEMS = int(os.environ.get("TW_FLEET_BUDGET", 1 << 28))
 # window-axis keys of a packed fleet batch, dispatch argument order
 _BATCH_KEYS = ("in_start", "in_end", "in_valid", "out_start", "out_end",
                "out_valid", "skip_cap", "force_skip")
+
+# per-problem param tables, dispatch argument order (after the batch keys)
+_TABLE_KEYS = ("pred_mask", "root_mask", "is_last",
+               "edge_wt", "edge_mu", "edge_sd",
+               "in_wt", "in_mu", "in_sd",
+               "ret_wt", "ret_mu", "ret_sd")
 
 
 def _compaction_warm() -> int:
@@ -79,6 +88,94 @@ def _compaction_on() -> bool:
     """``TW_COMPACT=0`` kills convergence compaction (single fused
     dispatch per group, the pre-compaction shape)."""
     return os.environ.get("TW_COMPACT", "1") not in ("0", "false", "")
+
+
+def _pipeline_on() -> bool:
+    """``TW_PIPELINE=0`` kills the pipelined dispatcher: groups pack,
+    dispatch, and decode strictly sequentially on the calling thread
+    (the pre-pipeline flow, kept as the bit-identical reference path and
+    as the kill switch)."""
+    return os.environ.get("TW_PIPELINE", "1") not in ("0", "false", "")
+
+
+def _decode_workers() -> int:
+    """Worker count of the pipeline's flow pool (``TW_DECODE_WORKERS``,
+    default 2). Each worker drives one group's dispatch -> compaction
+    round trips -> output fetch -> decode, so this bounds how many
+    groups can overlap their host-side work with other groups' device
+    execution (the live-element budget bounds depth independently)."""
+    try:
+        return max(1, int(os.environ.get("TW_DECODE_WORKERS", "2")))
+    except ValueError:
+        return 2
+
+
+class _Stats:
+    """Lock-guarded accumulator over the caller's stats dict.
+
+    Under the pipelined dispatcher the pack thread, the dispatch/decode
+    flow workers, and the per-service fallback pool all mutate the same
+    dict; a bare ``stats[k] = stats.get(k, 0) + v`` read-modify-write
+    would race and silently drop counts, so every update goes through
+    one locked helper. ``d is None`` (caller passed no stats) makes every
+    method a no-op."""
+
+    def __init__(self, d: Optional[Dict[str, float]]):
+        self.d = d
+        self._lock = threading.Lock()
+
+    def add(self, key: str, val: float = 1.0) -> None:
+        if self.d is None:
+            return
+        with self._lock:
+            self.d[key] = self.d.get(key, 0.0) + val
+
+    def record_max(self, key: str, val: float) -> None:
+        if self.d is None:
+            return
+        with self._lock:
+            self.d[key] = max(self.d.get(key, 0.0), val)
+
+    def merge(self, other: Dict[str, float]) -> None:
+        if self.d is None:
+            return
+        with self._lock:
+            for k, v in other.items():
+                self.d[k] = self.d.get(k, 0.0) + v
+
+
+def _as_stats(stats) -> _Stats:
+    return stats if isinstance(stats, _Stats) else _Stats(stats)
+
+
+def _copy_async(out) -> None:
+    """Start an async D2H transfer of a device handle (no-op for host
+    arrays and backends without the hook)."""
+    try:
+        out.copy_to_host_async()
+    except AttributeError:  # plain np.ndarray under some backends/flows
+        pass
+
+
+def _fetch(handle, st: _Stats, flow_wait=None, flag_fetch: bool = False):
+    """Blocking device fetch: billed to ``wait_s`` (the device-execution
+    proxy stage) and to the D2H byte ledger ``d2h_bytes_fetched``. Flag
+    fetches additionally land in ``d2h_bytes_flags``, making the
+    compaction contract — O(B) bytes to learn the convergence set, not
+    the whole packed block — auditable from stats alone. ``flow_wait``
+    (a 1-element list) accumulates this flow's blocking time so the
+    dispatcher can subtract it from its launch-time accounting without
+    reading the shared dict back."""
+    t0 = time.perf_counter()
+    out = np.asarray(handle)
+    dt = time.perf_counter() - t0
+    st.add("wait_s", dt)
+    if flow_wait is not None:
+        flow_wait[0] += dt
+    st.add("d2h_bytes_fetched", float(out.nbytes))
+    if flag_fetch:
+        st.add("d2h_bytes_flags", float(out.nbytes))
+    return out
 
 
 class FleetItem:
@@ -182,7 +279,7 @@ def _run_fallback(entries, results, all_spans, all_processes,
     solver's stage stats merge into the caller's dict — a mixed workload
     keeps both the overlap and the accounting it had on the pre-fleet
     bench path."""
-    from concurrent.futures import ThreadPoolExecutor
+    st = _as_stats(stats)
 
     def run(entry):
         i, item = entry
@@ -207,9 +304,7 @@ def _run_fallback(entries, results, all_spans, all_processes,
     with ThreadPoolExecutor(max_workers=max(1, len(entries))) as pool:
         for i, out, solver_stats in pool.map(run, entries):
             results[i] = out
-            if stats is not None:
-                for k, v in solver_stats.items():
-                    stats[k] = stats.get(k, 0.0) + v
+            st.merge(solver_stats)
 
 
 def solve_fleet(
@@ -227,12 +322,23 @@ def solve_fleet(
 ) -> List[Tuple]:
     """Solve every item, fusing eligible ones into one device dispatch.
 
+    Dispatch groups ride a bounded multi-stage pipeline by default
+    (:func:`_solve_groups_pipelined`): a pack thread builds group N+1's
+    tensors while group N executes on the device, each group's
+    dispatch/compaction/decode flow runs on a small worker pool
+    (``TW_DECODE_WORKERS``), and ``FLEET_BUDGET_ELEMS`` bounds the live
+    in-flight elements (the pipeline depth limit). The pipeline reorders
+    WORK only, never output — results are bit-identical and in input
+    order; ``TW_PIPELINE=0`` restores the strictly serial flow.
+
     ``mesh`` (a ``jax.sharding.Mesh``) shards each dispatch group's
     window-batch axis across the mesh devices under XLA SPMD — the
     multi-chip form of the production path (the same window-axis
     sharding :class:`WeaverTPU` uses per service, applied to the fused
     program; the refit's cross-shard window gather lowers to XLA
-    collectives automatically).
+    collectives automatically). Convergence compaction applies there
+    too, with the redispatch bucketed per shard
+    (:func:`traceweaver_tpu.parallel.mesh.bucket_rows_per_shard`).
 
     ``item_cells`` (when given, a list the caller sized to ``len(items)``)
     receives each item's padded-compute-cell count at its own shape class
@@ -255,6 +361,7 @@ def solve_fleet(
                          sinkhorn_tol=sinkhorn_tol, mesh=fallback_mesh)
     solver = WeaverTPU(all_spans, all_processes, **solver_kwargs)
     results: List[Optional[Tuple]] = [None] * len(items)
+    st = _as_stats(stats)
 
     prepared = []
     fallback_entries = []
@@ -269,7 +376,7 @@ def solve_fleet(
             prepared.append((i, item, prep))
     if fallback_entries:
         _run_fallback(fallback_entries, results, all_spans, all_processes,
-                      solver_kwargs, stats)
+                      solver_kwargs, st)
     if not prepared:
         return results  # type: ignore[return-value]
 
@@ -294,8 +401,7 @@ def solve_fleet(
             item_cells[i] = (len(windows) * w_b * m_b
                              * max(1, len(out_eps)) * prep["n_passes"])
         plans.append((i, item, prep, windows, ranges, skip_caps, w_b, m_b))
-    if stats is not None:
-        stats["pack_s"] = stats.get("pack_s", 0.0) + time.perf_counter() - t0
+    st.add("pack_s", time.perf_counter() - t0)
 
     # --- group services into dispatch shape classes ----------------------
     # One fused program per class. Services with very different window
@@ -352,8 +458,9 @@ def solve_fleet(
         groups.append(carry)
 
     # --- budget + dispatch per group -------------------------------------
-    pending = []
-    total_live = 0
+    hypers_common = dict(epsilon=epsilon, n_sinkhorn=n_sinkhorn,
+                         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol)
+    specs: List[_GroupSpec] = []
     for group in groups:
         W_pad = max(p[6] for p in group)
         M_pad = max(p[7] for p in group)
@@ -369,43 +476,155 @@ def solve_fleet(
         # (single-pass dynamism groups never refit)
         refit_elems = P * Ne * bmax * W_pad if n_passes == 2 else 0
         if score_elems + refit_elems > FLEET_BUDGET_ELEMS:
-            # padded group block would stress HBM: per-service dispatches
+            # padded group block would stress HBM: per-service dispatches.
+            # The counter accumulates — a mixed workload can trip the
+            # budget on several groups and the ledger must say how many.
             _run_fallback([(p[0], p[1]) for p in group], results,
-                          all_spans, all_processes, solver_kwargs, stats)
-            if stats is not None:
-                stats["fleet_fallback_budget"] = 1.0
+                          all_spans, all_processes, solver_kwargs, st)
+            st.add("fleet_fallback_budget", 1.0)
             continue
-        if total_live + score_elems + refit_elems > FLEET_BUDGET_ELEMS:
-            # keep every live dispatch under one budget: drain first
-            for pend in pending:
-                _decode_group(solver, pend, results, stats)
-            pending = []
-            total_live = 0
-        total_live += score_elems + refit_elems
-        pending.append(_dispatch_group(
-            group, solver, stats, W_pad, M_pad, E_pad, bmax,
-            epsilon=epsilon, n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
-            sinkhorn_tol=sinkhorn_tol, mesh=mesh, n_passes=n_passes))
-    for pend in pending:
-        _decode_group(solver, pend, results, stats)
+        cost = score_elems + refit_elems
+        # depth-limit observability: the largest single admission and the
+        # total the budget must amortize (budget < total => the pipeline
+        # gate/serial drain actually engaged on this workload)
+        st.record_max("fleet_group_cost_max", float(cost))
+        st.add("fleet_group_cost_total", float(cost))
+        specs.append(_GroupSpec(group, W_pad, M_pad, E_pad, bmax, n_passes,
+                                cost))
+    if not specs:
+        return results  # type: ignore[return-value]
+
+    from traceweaver_tpu.runtime.jax_cache import compile_counters, counters_delta
+
+    # recompiles are the shape-class regression signal: a warm steady
+    # state dispatches with zero compiles, so any nonzero delta here is a
+    # new program variant (bench surfaces these per run). Snapshotted
+    # around the WHOLE dispatch phase — per-dispatch deltas would double
+    # count under the pipeline's concurrent flows.
+    counters_before = compile_counters()
+    if _pipeline_on():
+        _solve_groups_pipelined(specs, solver, results, st, hypers_common,
+                                mesh)
+    else:
+        _solve_groups_serial(specs, solver, results, st, hypers_common,
+                             mesh)
+    for key, val in counters_delta(counters_before).items():
+        if val:
+            st.add(key, val)
     return results  # type: ignore[return-value]
 
 
-def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
-                    epsilon, n_sinkhorn, n_sweeps, sinkhorn_tol,
-                    mesh=None, n_passes=2):
-    """Pack one shape-class group and launch its fused program
-    (asynchronous — the returned handle is fetched by _decode_group):
-    the two-pass EM program for static groups, the single-pass solve for
-    dynamism groups (``n_passes=1``). With ``mesh``, the window-batch
-    axis is padded to the mesh size and sharded (XLA SPMD); padded rows
-    are invalid everywhere and decoded by nobody."""
+class _GroupSpec:
+    """One shape-class dispatch group plus its padded geometry and budget
+    cost (live f32 elements while its blocks are in flight — the unit
+    the pipeline depth limit is denominated in)."""
+
+    __slots__ = ("group", "W_pad", "M_pad", "E_pad", "bmax", "n_passes",
+                 "cost")
+
+    def __init__(self, group, W_pad, M_pad, E_pad, bmax, n_passes, cost):
+        self.group = group
+        self.W_pad = W_pad
+        self.M_pad = M_pad
+        self.E_pad = E_pad
+        self.bmax = bmax
+        self.n_passes = n_passes
+        self.cost = cost
+
+
+def _solve_groups_serial(specs, solver, results, st, hypers_common, mesh):
+    """The ``TW_PIPELINE=0`` reference flow: pack -> dispatch strictly in
+    order on the calling thread, decoding (and draining the live-element
+    budget) exactly as the pre-pipeline dispatcher did."""
+    pending = []
+    total_live = 0
+    for spec in specs:
+        if total_live + spec.cost > FLEET_BUDGET_ELEMS:
+            # keep every live dispatch under one budget: drain first
+            for pend in pending:
+                _decode_group(solver, pend, results, st)
+            pending = []
+            total_live = 0
+        total_live += spec.cost
+        pg = _pack_group(spec, hypers_common, st)
+        pending.append(_dispatch_packed(pg, spec, st, hypers_common, mesh))
+    for pend in pending:
+        _decode_group(solver, pend, results, st)
+
+
+def _solve_groups_pipelined(specs, solver, results, st, hypers_common,
+                            mesh):
+    """Bounded multi-stage pipeline over the dispatch groups.
+
+    - a single pack thread builds group N+1's host tensors while group N
+      executes on the device (``pack_s`` no longer serializes against
+      ``wait_s`` on the main thread);
+    - each group's dispatch -> compaction round trips -> output fetch ->
+      decode flow runs on a small worker pool (``TW_DECODE_WORKERS``),
+      so one group's host-side flag gather or decode never idles the
+      device: other flows' dispatches keep it fed (the event-driven
+      warm->gather->redispatch requirement);
+    - ``FLEET_BUDGET_ELEMS`` — the existing live-dispatch bound — is the
+      pipeline depth limit: the gate blocks before admitting a group
+      that would push the in-flight element total past one budget.
+
+    Only WORK is reordered, never output: every flow writes its items'
+    input-order ``results`` slots and runs byte-for-byte the serial
+    path's math (tests/test_pipeline.py pins pipelined == TW_PIPELINE=0,
+    compacted two-pass EM and budget-drain paths included).
+    """
+    gate = threading.Condition()
+    live = {"elems": 0, "flows": 0}
+    st.add("pipeline_groups", float(len(specs)))
+
+    def flow(pg, spec):
+        try:
+            pend = _dispatch_packed(pg, spec, st, hypers_common, mesh)
+            _decode_group(solver, pend, results, st)
+        finally:
+            with gate:
+                live["elems"] -= spec.cost
+                live["flows"] -= 1
+                gate.notify_all()
+
+    pack_pool = ThreadPoolExecutor(max_workers=1,
+                                   thread_name_prefix="tw-fleet-pack")
+    flow_pool = ThreadPoolExecutor(max_workers=_decode_workers(),
+                                   thread_name_prefix="tw-fleet-flow")
+    try:
+        pack_futs = [pack_pool.submit(_pack_group, spec, hypers_common, st)
+                     for spec in specs]
+        flow_futs = []
+        for spec, fut in zip(specs, pack_futs):
+            pg = fut.result()
+            with gate:
+                # depth limit: admit the group only when its blocks fit
+                # the live-element budget (a lone over-budget group was
+                # already routed to the per-service fallback upstream)
+                while live["elems"] > 0 and \
+                        live["elems"] + spec.cost > FLEET_BUDGET_ELEMS:
+                    gate.wait()
+                live["elems"] += spec.cost
+                live["flows"] += 1
+                st.record_max("pipeline_depth", float(live["flows"]))
+            flow_futs.append(flow_pool.submit(flow, pg, spec))
+        for fut in flow_futs:
+            fut.result()  # propagate flow errors to the caller
+    finally:
+        pack_pool.shutdown(wait=True)
+        flow_pool.shutdown(wait=True)
+
+
+def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
+    """Host packing of one shape-class group (pure NumPy — safe on the
+    pipeline's pack thread): concatenated window tensors, stacked param
+    tables, the refit row maps, and the analytic op accounting."""
+    group = spec.group
+    W_pad, M_pad, E_pad, bmax = spec.W_pad, spec.M_pad, spec.E_pad, spec.bmax
+    n_passes = spec.n_passes
     t0 = time.perf_counter()
     arrays_cat: Dict[str, List[np.ndarray]] = {}
-    param_rows = {k: [] for k in (
-        "pred_mask", "root_mask", "is_last",
-        "edge_wt", "edge_mu", "edge_sd",
-        "in_wt", "in_mu", "in_sd", "ret_wt", "ret_mu", "ret_sd")}
+    param_rows: Dict[str, List[np.ndarray]] = {k: [] for k in _TABLE_KEYS}
     per_item_pack = []
     param_idx = []
     for p, (i, item, prep, windows, ranges, skip_caps, _, _) in enumerate(group):
@@ -419,8 +638,7 @@ def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
         )
         a = packed.arrays
         n_w = len(windows)
-        for key in ("in_start", "in_end", "in_valid", "out_start",
-                    "out_end", "out_valid", "skip_cap", "force_skip"):
+        for key in _BATCH_KEYS:
             # drop pack_problem's power-of-two B padding: the fleet batch
             # is exact, and decode indexes out_ids by original row b which
             # is preserved under row slicing
@@ -452,191 +670,236 @@ def _dispatch_group(group, solver, stats, W_pad, M_pad, E_pad, bmax,
         window_rows[p, :n_w] = np.arange(row0, row0 + n_w, dtype=np.int32)
         window_valid[p, :n_w] = True
         row0 += n_w
-    if stats is not None:
-        stats["pack_s"] = stats.get("pack_s", 0.0) + time.perf_counter() - t0
-        stats["fleet_dispatches"] = stats.get("fleet_dispatches", 0.0) + 1
-        stats["fleet_services"] = (stats.get("fleet_services", 0.0)
-                                   + float(len(per_item_pack)))
+    st.add("pack_s", time.perf_counter() - t0)
+    st.add("fleet_dispatches", 1.0)
+    st.add("fleet_services", float(len(per_item_pack)))
+    if st.d is not None:
         # analytic op accounting (UPPER BOUND — sweep and Sinkhorn loops
         # exit early on convergence), same model as WeaverTPU._solve_once
+        n_sweeps = hypers_common["n_sweeps"]
+        n_sinkhorn = hypers_common["n_sinkhorn"]
         K = params["in_wt"].shape[2]
         cells = (n_windows_total * E_pad * W_pad * M_pad
                  * n_sweeps * n_passes)
-        stats["flops_est"] = stats.get("flops_est", 0.0) + cells * (
+        st.add("flops_est", cells * (
             8.0 * K * (min(_mp, E_pad) + min(_ms, E_pad) + 2)
             + 6.0 * 2 * n_sinkhorn
             + 8.0 * max(1, W_pad.bit_length())
-        )
-        stats["bytes_est_xla"] = stats.get("bytes_est_xla", 0.0) + (
-            cells * 4.0 * 2 * n_sinkhorn)
-        stats["bytes_est_pallas"] = stats.get(
-            "bytes_est_pallas", 0.0) + cells * 4.0 * 3
+        ))
+        st.add("bytes_est_xla", cells * 4.0 * 2 * n_sinkhorn)
+        st.add("bytes_est_pallas", cells * 4.0 * 3)
         if n_passes == 2:
             # counts fused EM dispatches (the grouping may produce several)
-            stats["fused_em_applied"] = stats.get("fused_em_applied", 0.0) + 1.0
+            st.add("fused_em_applied", 1.0)
         else:
-            stats["fleet_dynamism_dispatches"] = stats.get(
-                "fleet_dynamism_dispatches", 0.0) + 1.0
+            st.add("fleet_dynamism_dispatches", 1.0)
+    return dict(batch=batch, params=params, pidx=pidx,
+                window_rows=window_rows, window_valid=window_valid,
+                per_item_pack=per_item_pack, max_preds=_mp, max_succs=_ms)
 
-    # --- device program(s) -----------------------------------------------
-    # Convergence compaction (host in the loop, mesh-less path only): the
-    # vmapped sweep while_loop runs EVERY window until the slowest one's
-    # Gauss-Seidel assignments stabilize — converged windows' updates are
-    # select-masked into no-ops but still burn VPU cycles. So each solve
-    # pass runs as (1) a warm dispatch capped at TW_SWEEP_WARM sweeps,
-    # (2) a host-side gather of the windows whose convergence flag
-    # (packed channel 3) is still false, bucketed to a power-of-two batch
-    # (the existing shape-class discipline, so redispatch batch sizes
-    # cannot multiply compiled variants), (3) a full-sweep redispatch of
-    # only those rows, scattered back over the warm output. Converged
-    # windows keep their warm output — the sweep loop's exactness
-    # argument (a reproducing sweep is a fixed point) makes that output
-    # bit-identical to what the full-budget run would have produced, and
-    # the redispatch reruns stragglers from sweep 0, so compaction is
-    # output-identical to the uncompacted dispatch by construction
-    # (tests/test_compaction.py pins this down). Two-pass (fused EM)
-    # groups split into warm/full pass 0 -> one refit dispatch
-    # (weaver_tpu.refit_fleet_params — the same refit solve_em_fleet runs
-    # in-graph) -> warm/full pass 1.
+
+def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
+                     mesh=None):
+    """Launch one packed group's device program(s) and return its pending
+    ``(per_item_pack, out)`` decode ticket.
+
+    ``out`` is an async device handle for the single-dispatch flows and
+    an already-merged host array for the compacted multi-dispatch flow.
+    Convergence compaction (host in the loop): the vmapped sweep
+    while_loop runs EVERY window until the slowest one's Gauss-Seidel
+    assignments stabilize — converged windows' updates are select-masked
+    into no-ops but still burn VPU cycles. So each solve pass runs as
+    (1) a warm dispatch capped at TW_SWEEP_WARM sweeps, (2) a host-side
+    gather of the windows whose convergence flag (its own [B] bool
+    output, fetched ALONE — O(B) bytes) is still false, bucketed
+    per shard to a power of two (the existing shape-class discipline, so
+    redispatch batch sizes cannot multiply compiled variants), (3) a
+    full-sweep redispatch of only those rows, scattered back over the
+    warm output. Converged windows keep their warm output — the sweep
+    loop's exactness argument (a reproducing sweep is a fixed point)
+    makes that output bit-identical to what the full-budget run would
+    have produced, and the redispatch reruns stragglers from sweep 0, so
+    compaction is output-identical to the uncompacted dispatch by
+    construction (tests/test_compaction.py pins this down). Two-pass
+    (fused EM) groups split into warm/full pass 0 -> one refit dispatch
+    (weaver_tpu.refit_fleet_params — the same refit solve_em_fleet runs
+    in-graph) -> warm/full pass 1. With ``mesh``, the window-batch axis
+    is padded to the mesh size and sharded (XLA SPMD); padded rows are
+    invalid everywhere and decoded by nobody, and the compacted
+    redispatch buckets its rows PER SHARD (mesh.bucket_rows_per_shard).
+    """
+    batch, params, pidx = pg["batch"], pg["params"], pg["pidx"]
+    window_rows, window_valid = pg["window_rows"], pg["window_valid"]
+    n_passes = spec.n_passes
+    n_sweeps = hypers_common["n_sweeps"]
+    hypers = dict(epsilon=hypers_common["epsilon"],
+                  n_sinkhorn=hypers_common["n_sinkhorn"],
+                  sinkhorn_tol=hypers_common["sinkhorn_tol"],
+                  max_preds=pg["max_preds"], max_succs=pg["max_succs"])
     warm = _compaction_warm()
-    use_compact = (_compaction_on() and mesh is None
-                   and warm < n_sweeps and len(param_idx) > 1)
+    use_compact = (_compaction_on() and warm < n_sweeps
+                   and batch["in_start"].shape[0] > 1)
     if mesh is not None:
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
+        # batch rows pad to the mesh size ON THE HOST and stay numpy here:
+        # the compacted flow gathers redispatch rows from these host
+        # tensors and places fresh sharded copies per dispatch (the
+        # donated device buffers of an earlier dispatch cannot be reused)
+        from traceweaver_tpu.parallel.mesh import _pad_batch
 
-        from traceweaver_tpu.parallel.mesh import _pad_batch, put_sharded
-
-        # padded rows are all-invalid windows of service 0: they assign
-        # nothing, contribute no refit samples (window_rows/window_valid
-        # index only real rows), and the per-item decode never reads them
         n_dev = int(mesh.devices.size)
         batch, true_b = _pad_batch(batch, n_dev)
         pidx = np.concatenate(
             [pidx, np.zeros(batch["in_start"].shape[0] - true_b,
                             dtype=pidx.dtype)])
-        # put_sharded: window-axis keys sharded, everything else
-        # (param tables, window_rows/valid) replicated
-        placed = put_sharded(
-            {**batch, **params,
-             "window_rows": window_rows, "window_valid": window_valid},
-            mesh)
-        batch = {k: placed[k] for k in batch}
-        params = {k: placed[k] for k in params}
-        window_rows = placed["window_rows"]
-        window_valid = placed["window_valid"]
-        pidx = jax.device_put(
-            pidx, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
     t0 = time.perf_counter()
-    from traceweaver_tpu.runtime.jax_cache import compile_counters, counters_delta
-
-    counters_before = compile_counters()
-    common = (
-        batch["in_start"], batch["in_end"], batch["in_valid"],
-        batch["out_start"], batch["out_end"], batch["out_valid"],
-        batch["skip_cap"], batch["force_skip"], pidx,
-    )
-    tables = (
-        params["pred_mask"], params["root_mask"], params["is_last"],
-        params["edge_wt"], params["edge_mu"], params["edge_sd"],
-        params["in_wt"], params["in_mu"], params["in_sd"],
-        params["ret_wt"], params["ret_mu"], params["ret_sd"],
-    )
-    hypers = dict(epsilon=epsilon, n_sinkhorn=n_sinkhorn,
-                  sinkhorn_tol=sinkhorn_tol, max_preds=_mp, max_succs=_ms)
-    wait_before = stats.get("wait_s", 0.0) if stats is not None else 0.0
+    # this flow's blocking time (compacted intermediate fetches), so
+    # dispatch_s below stays pure launch/host time even when several
+    # flows bill wait_s to the shared dict concurrently
+    flow_wait = [0.0]
     if use_compact:
         out = _solve_group_compacted(
-            batch, pidx, params, tables, window_rows, window_valid,
-            n_passes, n_sweeps, warm, hypers, stats)
-    elif n_passes == 2:
-        out = solve_em_fleet(
-            *common, window_rows, window_valid, *tables,
-            n_sweeps=n_sweeps, **hypers,
-        )
+            batch, pidx, params, _tables_of(params), window_rows,
+            window_valid, n_passes, n_sweeps, warm, hypers, st,
+            mesh=mesh, flow_wait=flow_wait)
     else:
-        out = solve_windows_fleet(
-            *common, *tables, n_sweeps=n_sweeps, **hypers,
-        )
-    if stats is not None:
-        # the compacted flow blocks on its intermediate fetches, billed to
-        # wait_s inside _compacted_pass — dispatch_s stays launch/host time
-        flow_wait = stats.get("wait_s", 0.0) - wait_before
-        stats["dispatch_s"] = (stats.get("dispatch_s", 0.0)
-                               + time.perf_counter() - t0 - flow_wait)
-        # recompiles are the shape-class regression signal: a warm steady
-        # state dispatches with zero compiles, so any nonzero delta here
-        # is a new program variant (bench surfaces these per run)
-        for key, val in counters_delta(counters_before).items():
-            if val:
-                stats[key] = stats.get(key, 0.0) + val
-    try:
-        out.copy_to_host_async()
-    except AttributeError:  # plain np.ndarray under some backends
-        pass
-    return per_item_pack, out
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from traceweaver_tpu.parallel.mesh import put_sharded
+
+            # put_sharded: window-axis keys sharded, everything else
+            # (param tables, window_rows/valid) replicated
+            placed = put_sharded(
+                {**batch, **params,
+                 "window_rows": window_rows, "window_valid": window_valid},
+                mesh)
+            batch = {k: placed[k] for k in batch}
+            params = {k: placed[k] for k in params}
+            window_rows = placed["window_rows"]
+            window_valid = placed["window_valid"]
+            pidx = jax.device_put(
+                pidx, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
+        common = tuple(batch[k] for k in _BATCH_KEYS) + (pidx,)
+        if n_passes == 2:
+            out, _ = solve_em_fleet(
+                *common, window_rows, window_valid, *_tables_of(params),
+                n_sweeps=n_sweeps, **hypers,
+            )
+        else:
+            out, _ = solve_windows_fleet(
+                *common, *_tables_of(params), n_sweeps=n_sweeps, **hypers,
+            )
+    st.add("dispatch_s", time.perf_counter() - t0 - flow_wait[0])
+    _copy_async(out)
+    return pg["per_item_pack"], out
 
 
-def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats):
+def _tables_of(params: Dict) -> Tuple:
+    return tuple(params[k] for k in _TABLE_KEYS)
+
+
+def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
+                    mesh=None, flow_wait=None):
     """One solve pass as warm dispatch + compacted full redispatch.
 
-    Returns the packed [B, E, W, 4+topk] output, bit-identical to a
-    single ``n_sweeps`` dispatch of the same batch (see the compaction
-    comment in :func:`_dispatch_group`)."""
-    def _fetch(handle):
-        # blocking device fetch: accounted as wait_s (device-execution
-        # proxy), same stage the async single-dispatch flow bills it to
-        t0 = time.perf_counter()
-        out = np.asarray(handle)
-        if stats is not None:
-            stats["wait_s"] = (stats.get("wait_s", 0.0)
-                               + time.perf_counter() - t0)
-        return out
+    Returns the packed [B, E, W, 3+topk] output as a host array,
+    bit-identical to a single ``n_sweeps`` dispatch of the same batch
+    (see the compaction comment on :func:`_dispatch_packed`).
 
-    args = tuple(batch[k] for k in _BATCH_KEYS) + (pidx,)
-    out_warm = _fetch(solve_windows_fleet(
-        *args, *tables, n_sweeps=warm, **hypers))
-    converged = out_warm[:, 0, 0, 3].astype(bool)
+    The host never blocks on the packed warm block just to LEARN the
+    convergence set: the flags ride their own ``[B]`` bool device array
+    (the packed-output split, ``weaver_tpu._pack_solver_outputs``) and
+    are fetched alone — B bytes instead of the whole
+    ``[B, E, W, 3+topk]`` block — while the warm block streams D2H
+    asynchronously, overlapping the gather and the redispatch compute
+    (the ``copy-start`` D2H cost the r05 profile billed at parity with
+    the sweep loops themselves).
+
+    With ``mesh``, inputs stay host-side NumPy and every dispatch places
+    fresh sharded copies; the redispatch batch is bucketed PER SHARD
+    (:func:`traceweaver_tpu.parallel.mesh.bucket_rows_per_shard`) so
+    multi-chip runs compact too — each device receives a power-of-two
+    row count and the total divides evenly across the mesh. Per-window
+    outputs are sharding-independent (the solve is a vmap over windows),
+    so 1- and N-device compacted runs stay identical."""
+    st = _as_stats(stats)
+    n_shards = int(mesh.devices.size) if mesh is not None else 1
+
+    def place(arrs, pidx_np):
+        if mesh is None:
+            return tuple(arrs[k] for k in _BATCH_KEYS) + (pidx_np,)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from traceweaver_tpu.parallel.mesh import put_sharded
+
+        placed = put_sharded({k: arrs[k] for k in _BATCH_KEYS}, mesh)
+        pj = jax.device_put(
+            pidx_np, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
+        return tuple(placed[k] for k in _BATCH_KEYS) + (pj,)
+
+    tables_dev = tables
+    if mesh is not None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        tables_dev = tuple(jax.device_put(np.asarray(t), rep)
+                           for t in tables)
+
+    out_warm, flags = solve_windows_fleet(
+        *place(batch, pidx), *tables_dev, n_sweeps=warm, **hypers)
+    # the big warm block starts its D2H NOW — it overlaps the flag fetch,
+    # the host gather, and the redispatch's device execution below
+    _copy_async(out_warm)
+    converged = _fetch(flags, st, flow_wait, flag_fetch=True).astype(bool)
     active = np.flatnonzero(~converged)
-    if stats is not None:
-        stats["compact_windows_total"] = (
-            stats.get("compact_windows_total", 0.0) + out_warm.shape[0])
-        stats["compact_windows_redispatched"] = (
-            stats.get("compact_windows_redispatched", 0.0) + active.size)
+    st.add("compact_windows_total", float(converged.shape[0]))
+    st.add("compact_windows_redispatched", float(active.size))
     if active.size == 0:
-        return out_warm
-    b_pad = _bucket(int(active.size), minimum=1)
+        return _fetch(out_warm, st, flow_wait)
+
+    from traceweaver_tpu.parallel.mesh import bucket_rows_per_shard
+
+    b_pad = bucket_rows_per_shard(int(active.size), n_shards)
     pad = b_pad - int(active.size)
-    gathered = []
+    gathered = {}
     for k in _BATCH_KEYS:
-        a = batch[k][active]
+        a = np.asarray(batch[k])[active]
         if pad:
             # padding rows are all-invalid windows: no valid spans or
             # columns, so they assign nothing and are decoded by nobody
             # (same convention as pack_problem's pad_b rows)
             a = np.concatenate(
                 [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)])
-        gathered.append(a)
+        gathered[k] = a
     pidx_active = np.asarray(pidx)[active]
     if pad:
         pidx_active = np.concatenate(
             [pidx_active, np.zeros(pad, dtype=pidx_active.dtype)])
-    out_full = _fetch(solve_windows_fleet(
-        *gathered, pidx_active, *tables, n_sweeps=n_sweeps, **hypers))
-    out = out_warm.copy()
-    out[active] = out_full[:active.size]
+    out_full, _ = solve_windows_fleet(
+        *place(gathered, pidx_active), *tables_dev,
+        n_sweeps=n_sweeps, **hypers)
+    _copy_async(out_full)
+    out = _fetch(out_warm, st, flow_wait).copy()
+    out[active] = _fetch(out_full, st, flow_wait)[:active.size]
     return out
 
 
 def _solve_group_compacted(batch, pidx, params, tables, window_rows,
                            window_valid, n_passes, n_sweeps, warm, hypers,
-                           stats):
+                           stats, mesh=None, flow_wait=None):
     """Compacted replacement for one fused group dispatch: per-pass
     warm/redispatch compaction, with the two-pass EM's on-device refit as
     its own dispatch between the passes (same refit program
-    ``solve_em_fleet`` runs in-graph, so the flows cannot drift)."""
-    out0 = _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers,
-                           stats)
+    ``solve_em_fleet`` runs in-graph, so the flows cannot drift).
+    ``batch`` stays host-side NumPy throughout — each dispatch places
+    (and, mesh-less, uploads) fresh device copies, which is what makes
+    the donated window tensors safe to regather for the redispatch."""
+    st = _as_stats(stats)
+    out0 = _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, st,
+                           mesh=mesh, flow_wait=flow_wait)
     if n_passes == 1:
         return out0
     new_tables = refit_fleet_params(
@@ -648,17 +911,26 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
         params["edge_wt"], params["edge_mu"], params["edge_sd"],
         params["in_wt"], params["in_mu"], params["in_sd"],
         params["ret_wt"], params["ret_mu"], params["ret_sd"])
+    if mesh is not None:
+        # pass 1 re-places everything itself; hand it host tables so the
+        # replicated device_put starts from committed-free arrays
+        new_tables = tuple(np.asarray(t) for t in new_tables)
     return _compacted_pass(batch, pidx, tables[:3] + tuple(new_tables),
-                           n_sweeps, warm, hypers, stats)
+                           n_sweeps, warm, hypers, st, mesh=mesh,
+                           flow_wait=flow_wait)
 
 
 def _decode_group(solver, pend, results, stats):
-    """Fetch one group's packed output and decode it per service."""
+    """Fetch one group's packed output and decode it per service.
+
+    Safe on a pipeline decode worker: every write lands in that group's
+    own input-order ``results`` slots and all counter updates go through
+    the lock-guarded accumulator."""
+    st = _as_stats(stats)
     per_item_pack, out = pend
-    t0 = time.perf_counter()
-    o = np.asarray(out)
-    if stats is not None:
-        stats["wait_s"] = stats.get("wait_s", 0.0) + time.perf_counter() - t0
+    # the compacted flow already fetched + merged on the host; the
+    # single-dispatch flows hand over an async device handle
+    o = out if isinstance(out, np.ndarray) else _fetch(out, st)
 
     t0 = time.perf_counter()
     row = 0
@@ -668,9 +940,7 @@ def _decode_group(solver, pend, results, stats):
         assign = rows[..., 0]
         not_best = rows[..., 1].astype(bool)
         feas = rows[..., 2]
-        # rows[..., 3] is the sweep-convergence flag (already consumed by
-        # the compaction redispatch inside _dispatch_group)
-        topk_cols = rows[..., 4:]
+        topk_cols = rows[..., 3:]
         out_eps = prep["out_eps"]
         in_ids = [s.GetId() for s in prep["in_spans"]]
         n_in = prep["n_in"]
@@ -680,10 +950,8 @@ def _decode_group(solver, pend, results, stats):
         solver._decode(packed, assign, topk_cols, all_assignments, all_topk)
         span_not_best = np.zeros(n_in, dtype=bool)
         span_cands = np.ones(n_in, dtype=np.int64)
-        for b, (lo, hi) in enumerate(packed.windows):
-            for j in range(hi - lo):
-                span_not_best[lo + j] = bool(not_best[b, :, j].any())
-                span_cands[lo + j] = int(np.maximum(feas[b, :, j], 1).prod())
+        scatter_window_span_stats(packed.windows, not_best, feas,
+                                  span_not_best, span_cands)
         solver._resolve_cross_window_duplicates(
             all_assignments, all_topk, in_ids, prep["skip_budget"])
         cnt_unassigned = sum(
@@ -695,6 +963,4 @@ def _decode_group(solver, pend, results, stats):
             {in_ids[j]: int(span_cands[j]) for j in range(n_in)},
             cnt_unassigned,
         )
-    if stats is not None:
-        stats["decode_s"] = (stats.get("decode_s", 0.0)
-                             + time.perf_counter() - t0)
+    st.add("decode_s", time.perf_counter() - t0)

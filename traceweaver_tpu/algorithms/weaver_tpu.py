@@ -370,16 +370,19 @@ def solve_windows(
     return assign, tk, not_best, feas
 
 
-def _pack_solver_outputs(assign, tk, not_best, feas, converged):
-    """The single-transfer int32 layout ``[B, E, W, 4 + topk]``:
-    channel 0 = assign, 1 = not_best, 2 = feas_count, 3 = converged (the
-    per-window sweep-fixed-point flag broadcast over [E, W] — read by the
-    convergence-compaction redispatch), 4.. = topk columns."""
-    conv = jnp.broadcast_to(
-        converged[:, None, None], assign.shape).astype(jnp.int32)
+def _pack_solver_outputs(assign, tk, not_best, feas):
+    """The single-transfer int32 layout ``[B, E, W, 3 + topk]``:
+    channel 0 = assign, 1 = not_best, 2 = feas_count, 3.. = topk columns.
+
+    The per-window sweep-convergence flag is deliberately NOT a channel
+    of this block any more: the fleet entry points return it as a
+    separate ``[B]`` bool array so the convergence-compaction host step
+    can fetch B bytes instead of blocking on the whole packed block
+    (the ``copy-start`` D2H cost the r05 profile billed at parity with
+    the sweep loops themselves)."""
     return jnp.concatenate(
         [assign[..., None], not_best[..., None].astype(jnp.int32),
-         feas[..., None], conv[..., None], tk], axis=-1,
+         feas[..., None], tk], axis=-1,
     )
 
 
@@ -391,7 +394,7 @@ def solve_windows_packed(*args, epsilon: float = 1.0, n_sinkhorn: int = 40,
                          sinkhorn_tol: float = 0.0,
                          max_preds: int = 0, max_succs: int = 0):
     """:func:`solve_windows` with the outputs packed into one int32 tensor
-    ``[B, E, W, 4+topk]`` (see :func:`_pack_solver_outputs`) so a solve
+    ``[B, E, W, 3+topk]`` (see :func:`_pack_solver_outputs`) so a solve
     costs a single device->host transfer instead of four. The window
     tensors (args 0-7) are donated: the dense [B, E, W, M] blocks are the
     solve's HBM peak and the caller always rebuilds them per dispatch."""
@@ -404,7 +407,7 @@ def solve_windows_packed(*args, epsilon: float = 1.0, n_sinkhorn: int = 40,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs,
     )
-    return _pack_solver_outputs(*outs)
+    return _pack_solver_outputs(*outs[:4])
 
 
 def em_family_samples(assign, in_start, in_end, in_valid,
@@ -548,7 +551,12 @@ def solve_windows_fleet(
     ``[P, ...]`` arrays; windows of every service in a fleet ride one
     device dispatch (endpoint axes padded to the fleet max — padded
     endpoints have no valid columns, assign nothing, and pass predecessor
-    times through, so they cannot disturb real endpoints)."""
+    times through, so they cannot disturb real endpoints).
+
+    Returns ``(packed, converged)``: the ``[B, E, W, 3+topk]`` block plus
+    the per-window sweep-fixed-point flags as a SEPARATE ``[B]`` bool
+    array, so the convergence-compaction host step can fetch B bytes
+    alone while the packed block streams D2H asynchronously."""
     outs = _solve_windows_impl(
         in_start, in_end, in_valid, out_start, out_end, out_valid,
         skip_cap, force_skip, param_idx,
@@ -559,7 +567,7 @@ def solve_windows_fleet(
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs,
     )
-    return _pack_solver_outputs(*outs)
+    return _pack_solver_outputs(*outs[:4]), outs[4]
 
 
 def _fleet_refit_tables(assign0, in_start, in_end, in_valid,
@@ -652,7 +660,8 @@ def solve_em_fleet(
     service's windows, per-service three-family delay extraction, one
     batched BIC-GMM refit over the ``P*Ne`` family rows, then pass 1 —
     the whole bench workload's EM never leaves the device and costs a
-    single round trip through the tunnel.
+    single round trip through the tunnel. Returns ``(packed, converged)``
+    like :func:`solve_windows_fleet` (the flags are pass 1's).
 
     ``window_rows``/``window_valid`` ([P, Bmax] int32/bool) list each
     service's window rows in the fleet batch (the packer emits services as
@@ -716,6 +725,31 @@ def perfect_cut_windows(in_spans: List[Span], max_size: int) -> List[Tuple[int, 
     if seg_start < n:
         windows.append((seg_start, n))
     return windows
+
+
+def scatter_window_span_stats(windows, not_best, feas,
+                              span_not_best, span_cands) -> None:
+    """Per-span confidence reductions over a packed window batch, written
+    into the caller's ``[n_in]`` arrays in place: a span is "not best"
+    when any endpoint's OT choice overrode the row argmax, and its
+    candidate count is the product of per-endpoint feasible counts.
+
+    Vectorized over the packed window index (one fancy-gather per batch
+    instead of a Python loop per span) — decode sits on the dispatch
+    pipeline's critical path, so per-span Python work here would gate the
+    whole fleet solve.
+    """
+    if not windows:
+        return
+    w_of = np.concatenate(
+        [np.full(hi - lo, b) for b, (lo, hi) in enumerate(windows)])
+    i_of = np.concatenate([np.arange(hi - lo) for lo, hi in windows])
+    pos = np.concatenate([np.arange(lo, hi) for lo, hi in windows])
+    span_not_best[pos] = not_best[w_of, :, i_of].any(axis=1)
+    # int64 accumulator matches np.prod's platform-int promotion in the
+    # scalar form this replaces
+    span_cands[pos] = np.maximum(
+        feas[w_of, :, i_of], 1).astype(np.int64).prod(axis=1)
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -1206,9 +1240,7 @@ class WeaverTPU:
             assign = o[..., 0]
             not_best = o[..., 1].astype(bool)
             feas = o[..., 2]
-            # o[..., 3] is the per-window convergence flag (consumed by
-            # the fleet path's compaction redispatch; unused here)
-            topk_cols = o[..., 4:]
+            topk_cols = o[..., 3:]
             results.append((packed, (assign, topk_cols, not_best, feas)))
         stats["wait_s"] = stats.get("wait_s", 0.0) + (
             _time.perf_counter() - t0)
@@ -1372,11 +1404,8 @@ class WeaverTPU:
             for packed, (assign, topk_cols, not_best, feas) in batches:
                 self._decode(packed, assign, topk_cols,
                              all_assignments, all_topk)
-                for b, (lo, hi) in enumerate(packed.windows):
-                    for i in range(hi - lo):
-                        span_not_best[lo + i] = bool(not_best[b, :, i].any())
-                        span_cands[lo + i] = int(
-                            np.maximum(feas[b, :, i], 1).prod())
+                scatter_window_span_stats(packed.windows, not_best, feas,
+                                          span_not_best, span_cands)
             not_best_count = int(span_not_best.sum())
             per_span_candidates = {
                 in_ids[i]: int(span_cands[i]) for i in range(n_in)

@@ -27,8 +27,20 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# the replication-check kwarg was renamed check_rep -> check_vma across
+# jax releases; resolve the spelling this build understands once
+import inspect as _inspect
+
+_CHECK_KW = ("check_vma"
+             if "check_vma" in _inspect.signature(shard_map).parameters
+             else "check_rep")
 
 from traceweaver_tpu.algorithms.weaver_tpu import solve_windows
 
@@ -58,6 +70,20 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "data",
             )
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
+
+
+def bucket_rows_per_shard(n_rows: int, n_shards: int) -> int:
+    """Sharding-aware padded batch size for a gathered redispatch: each
+    shard's row count is rounded up to a power of two (so straggler
+    counts — which vary run to run — cannot mint unbounded compiled
+    variants, the same discipline as ``weaver_tpu._bucket``) and the
+    total divides evenly across the mesh. ``n_shards=1`` degenerates to
+    plain power-of-two bucketing (the single-device compaction path)."""
+    per_shard = -(-max(1, n_rows) // n_shards)  # ceil division
+    b = 1
+    while b < per_shard:
+        b *= 2
+    return b * n_shards
 
 
 def _pad_batch(arrays: Dict[str, np.ndarray], multiple: int) -> Tuple[Dict[str, np.ndarray], int]:
@@ -133,7 +159,7 @@ def _build_em_step(mesh: Mesh, epsilon: float, n_sinkhorn: int):
         in_specs=(tuple(P(axis) for _ in BATCHED),
                   tuple(P() for _ in REPLICATED)),
         out_specs=(P(axis), P(), P(), P()),
-        check_vma=False,
+        **{_CHECK_KW: False},
     )
     def step(batched, replicated):
         from traceweaver_tpu.ops.gmm import fit_gmm_sharded

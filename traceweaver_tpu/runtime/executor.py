@@ -274,9 +274,17 @@ def _solve_fleet_method(cfg: ExecutorConfig, store: TraceStore, method: str,
     total_w = fleet_stats.get("compact_windows_total", 0)
     if total_w:
         print("[fleet] %s: compaction redispatched %d/%d windows "
-              "past the warm sweeps"
+              "past the warm sweeps (%d B of flag fetches vs %.1f MB "
+              "total D2H)"
               % (method, int(fleet_stats.get(
-                  "compact_windows_redispatched", 0)), int(total_w)))
+                  "compact_windows_redispatched", 0)), int(total_w),
+                 int(fleet_stats.get("d2h_bytes_flags", 0)),
+                 fleet_stats.get("d2h_bytes_fetched", 0.0) / 1e6))
+    if fleet_stats.get("pipeline_groups"):
+        print("[fleet] %s: pipelined %d dispatch groups at depth %d "
+              "(TW_PIPELINE=0 restores the serial flow)"
+              % (method, int(fleet_stats["pipeline_groups"]),
+                 int(fleet_stats.get("pipeline_depth", 0))))
     # per-service seconds = share of the dispatch wall-clock proportional
     # to each service's padded compute cells at its own shape class — the
     # quantity the device spends time on (the same attribution model the

@@ -10,7 +10,12 @@ The inner loop is the existing warm fleet path: each sealed window
 contributes one FleetItem per solvable service and a micro-batch of
 windows rides one :func:`~traceweaver_tpu.algorithms.fleet.solve_fleet`
 call, so padded shape classes (and the XLA programs compiled for them)
-are shared across the whole stream.
+are shared across the whole stream. Micro-batches therefore also ride
+the fleet's pipelined dispatcher: shape-class groups within a
+micro-batch pack/dispatch/decode concurrently (``TW_PIPELINE=0`` for
+the serial flow), and the summary's ``pipeline`` block reports the
+observed depth plus the D2H byte ledger (flag-only compaction fetches
+vs total transfers).
 """
 
 from __future__ import annotations
@@ -492,6 +497,14 @@ class StreamingReconstructor:
             watermark_max_skew_us=self.watermark.max_skew_us,
             stats=dict(self.stats),
             fleet=dict(self.fleet_stats),
+            pipeline=dict(
+                groups=int(self.fleet_stats.get("pipeline_groups", 0)),
+                depth=int(self.fleet_stats.get("pipeline_depth", 0)),
+                d2h_bytes_fetched=float(
+                    self.fleet_stats.get("d2h_bytes_fetched", 0.0)),
+                d2h_bytes_flags=float(
+                    self.fleet_stats.get("d2h_bytes_flags", 0.0)),
+            ),
         )
         if final and self.grader is not None:
             out["accuracy"] = self.grader.finish()
