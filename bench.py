@@ -301,6 +301,14 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
 
     from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
     from traceweaver_tpu.metrics import accuracy_for_service
+    from traceweaver_tpu.ops.precision import precision_from_env, score_itemsize
+
+    # score-path precision (TW_PRECISION): the timed pass and every
+    # fused dispatch run at this precision; the subset leg additionally
+    # measures the bf16-vs-f32 accuracy delta on identical inputs below
+    precision = precision_from_env()
+    log(f"child: score-path precision = {precision} "
+        f"({score_itemsize(precision)} B/elem score blocks)")
 
     flat = [(label, svc, prob, ta, dag, store)
             for store, problems in bundles
@@ -379,6 +387,14 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     report = {
         "backend": backend,
         "backend_init_s": round(init_s, 2),
+        # mixed-precision ledger: the configured score-block precision,
+        # its bytes/element, and the analytic score-block HBM traffic at
+        # that itemsize (bf16 halves bytes_est_xla's score stream — the
+        # byte-ledger evidence the precision mode exists to produce)
+        "precision": precision,
+        "score_block_itemsize": score_itemsize(precision),
+        "bytes_est_xla": stage_stats.get("bytes_est_xla", 0.0),
+        "bytes_est_pallas": stage_stats.get("bytes_est_pallas", 0.0),
         "n_spans": n_spans,
         "n_services": len(flat),
         "solve_time_s": solve_time,
@@ -426,7 +442,12 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     log("child: report written (timed pass)")
 
     # --- same-input subset leg (identical spans + ground truth as the
-    # exact-path baseline child; one fused dispatch for all subsets) ------
+    # exact-path baseline child; one fused dispatch for all subsets).
+    # The subsets are solved under BOTH precisions regardless of the
+    # configured one (they are tiny — seconds each): the active
+    # precision's accuracies feed the vs-exact pairing, and the f32/bf16
+    # pair on identical inputs is the measured accuracy-delta-vs-f32 the
+    # acceptance bar (≤1 pt per dataset) is checked against. ----------
     t0 = time.perf_counter()
     sub_items, sub_meta = [], []
     for label, svc, prob, ta, dag, store in flat:
@@ -437,18 +458,32 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         n_actual = len(next(iter(sub_in.values())))
         sub_items.append(FleetItem(svc, sub_in, prob.out_span_partitions,
                                    sub_ta, dag, store=store))
-        sub_meta.append((f"{label}@{n_actual}", sub_in, sub_ta))
-    outs = solve_fleet(sub_items)
+        sub_meta.append((label, f"{label}@{n_actual}", sub_in, sub_ta))
+    accs_by_prec = {}
+    for prec_leg in ("f32", "bf16"):
+        outs = solve_fleet(sub_items, precision=prec_leg)
+        accs_by_prec[prec_leg] = {
+            label: accuracy_for_service(out[0], sub_ta, sub_in)
+            for (label, _, sub_in, sub_ta), out in zip(sub_meta, outs)
+        }
     subset_accs = {
-        key: accuracy_for_service(out[0], sub_ta, sub_in)
-        for (key, sub_in, sub_ta), out in zip(sub_meta, outs)
+        key: accs_by_prec[precision][label]
+        for label, key, _, _ in sub_meta
     }
     report["subset_spans_per_service"] = SUBSET_SPANS
     report["subset_accuracy_per_service"] = {
         k: round(v, 4) for k, v in subset_accs.items()}
+    report.update(bf16_delta_fields(accs_by_prec["f32"],
+                                    accs_by_prec["bf16"]))
     report["subset_solve_s"] = round(time.perf_counter() - t0, 2)
+    if report["bf16_delta_exceeds_1pt"]:
+        log("child: WARNING — bf16 accuracy delta exceeds 1 pt vs f32 on "
+            f"dataset(s) {report['bf16_delta_exceeds_1pt']} "
+            f"(per-dataset pts: {report['accuracy_delta_vs_f32_per_dataset']})")
     write_json_atomic(out_path, report)
-    log(f"child: subset pass {report['subset_solve_s']}s — report updated")
+    log(f"child: subset pass {report['subset_solve_s']}s "
+        f"(delta_vs_f32 {report['accuracy_delta_vs_f32']} pts) — "
+        "report updated")
 
     # --- enrichment ------------------------------------------------------
     # NOTE: the parent holds the baseline child until the marker below, so
@@ -543,6 +578,35 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
 # ---------------------------------------------------------------------------
 # Combinatorial baseline child (no JAX backend at all)
 # ---------------------------------------------------------------------------
+
+def _dataset_of(label: str) -> str:
+    """``hotel/frontend`` -> ``hotel`` (the bench's per-app grouping)."""
+    return label.split("/", 1)[0]
+
+
+def bf16_delta_fields(accs_f32: dict, accs_bf16: dict) -> dict:
+    """bf16-vs-f32 accuracy deltas on identical inputs -> report fields.
+
+    Input accuracies are fractions (0..1) keyed by service label; the
+    reported deltas are in POINTS (x100) to match the ≤1 pt acceptance
+    bar. ``bf16_delta_exceeds_1pt`` lists every dataset (app) whose mean
+    delta magnitude crosses 1 pt — the bench warns on any entry.
+    """
+    deltas = {k: (accs_bf16[k] - accs_f32[k]) * 100.0
+              for k in accs_f32 if k in accs_bf16}
+    by_ds: dict = {}
+    for k, d in deltas.items():
+        by_ds.setdefault(_dataset_of(k), []).append(d)
+    per_dataset = {ds: sum(v) / len(v) for ds, v in sorted(by_ds.items())}
+    return {
+        "accuracy_delta_vs_f32": (
+            round(sum(deltas.values()) / len(deltas), 4) if deltas else None),
+        "accuracy_delta_vs_f32_per_dataset": {
+            ds: round(d, 4) for ds, d in per_dataset.items()},
+        "bf16_delta_exceeds_1pt": sorted(
+            ds for ds, d in per_dataset.items() if abs(d) > 1.0),
+    }
+
 
 def backend_label(solver_backend) -> tuple:
     """Top-level backend field for the final JSON line.
@@ -1001,6 +1065,19 @@ def main() -> None:
         "warmup_compile_s": round(solver["warmup_time_s"], 2),
         "compile_cache_warm": solver.get("compile_cache_warm"),
         "accuracy_tpu": round(solver["accuracy_mean"], 4),
+        # mixed-precision ledger (tentpole PR 4): configured score-path
+        # precision, measured bf16-vs-f32 accuracy delta on identical
+        # subset inputs (points; must stay ≤1 pt per dataset), and the
+        # analytic score-block HBM byte estimates at the configured
+        # itemsize (bf16 halves the XLA-path score stream)
+        "precision": solver.get("precision"),
+        "score_block_itemsize": solver.get("score_block_itemsize"),
+        "accuracy_delta_vs_f32": solver.get("accuracy_delta_vs_f32"),
+        "accuracy_delta_vs_f32_per_dataset": solver.get(
+            "accuracy_delta_vs_f32_per_dataset"),
+        "bf16_delta_exceeds_1pt": solver.get("bf16_delta_exceeds_1pt"),
+        "bytes_est_xla": solver.get("bytes_est_xla"),
+        "bytes_est_pallas": solver.get("bytes_est_pallas"),
         "accuracy_delta_same_inputs": (round(delta_fresh, 4)
                                        if delta_fresh is not None else None),
         "accuracy_delta_incl_recorded": (round(delta_all, 4)

@@ -158,3 +158,25 @@ def test_backend_label_flags_cpu_fallback(bench):
     assert bench.backend_label(None) == ("cpu_fallback", False)
     assert bench.backend_label("tpu") == ("tpu", True)
     assert bench.backend_label("axon") == ("axon", True)
+
+
+@pytest.mark.precision
+def test_bf16_delta_fields_per_dataset_and_warn_list(bench):
+    """The bf16-vs-f32 accuracy delta aggregation: fraction accuracies
+    keyed by service label -> per-dataset mean deltas in POINTS, with
+    the >1 pt warn list naming datasets (apps), not services."""
+    accs_f32 = {"hotel/frontend": 0.90, "hotel/search": 0.80,
+                "media/compose": 0.95}
+    accs_bf16 = {"hotel/frontend": 0.905, "hotel/search": 0.810,
+                 "media/compose": 0.90}
+    out = bench.bf16_delta_fields(accs_f32, accs_bf16)
+    # hotel mean delta = (0.5 + 1.0)/2 = 0.75 pts; media = -5.0 pts
+    assert out["accuracy_delta_vs_f32_per_dataset"] == {
+        "hotel": 0.75, "media": -5.0}
+    assert out["bf16_delta_exceeds_1pt"] == ["media"]
+    # overall mean over services: (0.5 + 1.0 - 5.0) / 3
+    assert out["accuracy_delta_vs_f32"] == round((0.5 + 1.0 - 5.0) / 3, 4)
+    # empty input degrades to None / empty, not a crash
+    empty = bench.bf16_delta_fields({}, {})
+    assert empty["accuracy_delta_vs_f32"] is None
+    assert empty["bf16_delta_exceeds_1pt"] == []

@@ -107,6 +107,86 @@ def test_compaction_redispatch_shapes_stay_bucketed():
     assert np.array_equal(out_a, out_b)
 
 
+@pytest.mark.precision
+def test_bf16_solve_streams_bf16_blocks_and_recompiles_zero(monkeypatch):
+    """Tier-1 mixed-precision smoke: a TW_PRECISION=bf16 solve under
+    JAX_PLATFORMS=cpu must actually hand the Sinkhorn/rounding stage
+    bfloat16 score blocks (no silent f32 fallback anywhere between the
+    score build and the OT solve), and a second identical bf16 solve
+    must cost zero backend compiles — the precision static argument may
+    add exactly one compiled variant, never retrace per call."""
+    import jax.numpy as jnp
+
+    import traceweaver_tpu.algorithms.weaver_tpu as wt
+
+    seen = []
+    real_assign_topk = wt.assign_topk
+
+    def spy(S_ot, *a, **k):
+        # runs at trace time: S_ot is the assembled OT block the sweep
+        # streams — its dtype IS the end-to-end score-path precision
+        seen.append(jnp.dtype(S_ot.dtype).name)
+        return real_assign_topk(S_ot, *a, **k)
+
+    monkeypatch.setattr(wt, "assign_topk", spy)
+
+    args = _tiny_args(seed=4)
+    # unique hyper combo so this test owns its trace (the spy only
+    # observes at trace time; a jit cache hit would record nothing)
+    kwargs = dict(n_sinkhorn=11, n_sweeps=3, sinkhorn_tol=1e-3,
+                  precision="bf16")
+    compile_counters()
+    out1 = np.asarray(wt.solve_windows_packed(*_tiny_args(seed=4), **kwargs))
+    assert seen and set(seen) == {"bfloat16"}, (
+        f"bf16 solve leaked non-bf16 score blocks into the sweep: {seen}")
+
+    before = compile_counters()
+    out2 = np.asarray(wt.solve_windows_packed(*args, **kwargs))
+    delta = counters_delta(before)
+    assert delta["backend_compiles"] == 0, (
+        f"identical second bf16 solve recompiled: {delta}")
+    assert np.array_equal(out1, out2)
+
+    # the default remains f32 end-to-end (same observation point)
+    seen.clear()
+    np.asarray(wt.solve_windows_packed(
+        *_tiny_args(seed=4), n_sinkhorn=11, n_sweeps=3, sinkhorn_tol=1e-3))
+    assert seen and set(seen) == {"float32"}, seen
+
+
+@pytest.mark.precision
+def test_env_bf16_rides_the_whole_fleet_path(monkeypatch):
+    """TW_PRECISION=bf16 (the env knob, no explicit argument) must reach
+    every fused fleet dispatch — warm pass, compaction redispatch, and
+    the pipeline — with bf16 blocks, and the byte-denominated ledger
+    must account score traffic at 2 B/elem."""
+    from test_pipeline import _mixed_items
+
+    import jax.numpy as jnp
+
+    import traceweaver_tpu.algorithms.weaver_tpu as wt
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+    seen = set()
+    real_assign_topk = wt.assign_topk
+
+    def spy(S_ot, *a, **k):
+        seen.add(jnp.dtype(S_ot.dtype).name)
+        return real_assign_topk(S_ot, *a, **k)
+
+    monkeypatch.setattr(wt, "assign_topk", spy)
+    monkeypatch.setenv("TW_PRECISION", "bf16")
+
+    stats = {}
+    # unique hyper combo so this solve owns its traces (the spy observes
+    # at trace time only; a jit cache hit would record nothing)
+    out = solve_fleet(_mixed_items(), stats=stats, n_sinkhorn=13)
+    assert len(out) == 3
+    assert seen == {"bfloat16"}, (
+        f"env-selected bf16 leaked f32 score blocks: {seen or '(no trace)'}")
+    assert stats.get("bytes_est_xla", 0) > 0
+
+
 @pytest.mark.pipeline
 def test_pipelined_fleet_runs_and_second_solve_is_compile_free():
     """Tier-1 pipeline smoke: under JAX_PLATFORMS=cpu the fleet solve

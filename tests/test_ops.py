@@ -393,7 +393,8 @@ def test_sinkhorn_dispatch_oversized_block_takes_jnp_path(monkeypatch):
 
     monkeypatch.setenv("TW_PALLAS", "1")
     monkeypatch.delenv("TW_PALLAS_INTERPRET", raising=False)
-    monkeypatch.setattr(ps, "fits_pallas_vmem", lambda n, m: False)
+    monkeypatch.setattr(ps, "fits_pallas_vmem",
+                        lambda n, m, itemsize=4: False)
     called = {"pallas": False}
 
     def boom(*a, **k):

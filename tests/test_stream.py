@@ -288,6 +288,71 @@ def test_checkpoint_kill_resume_no_loss_no_double_emit(synth_store, tmp_path):
     assert summary["accuracy"] == uninterrupted["accuracy"]
 
 
+@pytest.mark.precision
+def test_checkpoint_is_precision_portable(synth_store, tmp_path, monkeypatch):
+    """A checkpoint written under one score precision must resume
+    correctly under the other: every checkpointed value (carried
+    EdgeDist statistics, window buffers, offsets) is host-side f32 and
+    precision-independent — only device score blocks built AFTER the
+    resume change. The resumed run must complete, keep span
+    conservation, record its own precision in the summary, and land
+    within the streamed-accuracy band of an uninterrupted f32 run."""
+    from traceweaver_tpu.stream import (
+        ReplaySource,
+        StreamingReconstructor,
+        TraceSink,
+    )
+
+    _, store = synth_store
+    monkeypatch.delenv("TW_PRECISION", raising=False)
+    golden = _run_stream(store)
+    assert golden["precision"] == "f32"
+
+    ckpt = str(tmp_path / "xprec.pkl")
+    out_path = str(tmp_path / "xprec.jsonl")
+    cfg = _stream_cfg(checkpoint_path=ckpt, checkpoint_every=2)
+    source = ReplaySource(store, ooo_us=50_000.0, seed=1)
+    svc = StreamingReconstructor(source, cfg, sink=TraceSink(out_path))
+    assert svc.precision == "f32"
+    partial = svc.run(max_windows=3)
+    assert not partial["final"]
+    svc.sink.close()
+
+    # resume the f32 checkpoint under bf16
+    monkeypatch.setenv("TW_PRECISION", "bf16")
+    source2 = ReplaySource(store, ooo_us=50_000.0, seed=1)
+    resumed = StreamingReconstructor.resume(ckpt, source2)
+    assert resumed.precision == "bf16"
+    summary = resumed.run()
+    resumed.sink.close()
+    assert summary["final"]
+    assert summary["precision"] == "bf16"
+    # span conservation survives the precision switch
+    assert (summary["stats"].get("spans_emitted", 0)
+            + summary["late_dropped"] == summary["consumed"])
+    assert summary["consumed"] == golden["consumed"]
+    assert summary["emitted_windows"] == golden["emitted_windows"]
+    # accuracy parity across the switch (same bar as streamed-vs-batch)
+    assert summary["accuracy"]["e2e"] >= golden["accuracy"]["e2e"] - 2.0
+
+    # and the reverse direction: a bf16 checkpoint resumes under f32
+    ckpt2 = str(tmp_path / "xprec2.pkl")
+    cfg2 = _stream_cfg(checkpoint_path=ckpt2, checkpoint_every=2)
+    svc2 = StreamingReconstructor(
+        ReplaySource(store, ooo_us=50_000.0, seed=1), cfg2,
+        sink=TraceSink(str(tmp_path / "xprec2.jsonl")))
+    assert svc2.precision == "bf16"
+    svc2.run(max_windows=3)
+    svc2.sink.close()
+    monkeypatch.delenv("TW_PRECISION", raising=False)
+    back = StreamingReconstructor.resume(
+        ckpt2, ReplaySource(store, ooo_us=50_000.0, seed=1))
+    assert back.precision == "f32"
+    summary2 = back.run()
+    back.sink.close()
+    assert summary2["final"] and summary2["precision"] == "f32"
+
+
 def test_stream_emission_is_parseable_and_owned_once(synth_store, tmp_path):
     """Sink records: one JSON object per window; every emitted (service,
     endpoint) row references an owned incoming span at most once across
@@ -341,6 +406,9 @@ def test_cli_stream_end_to_end(synth_store, tmp_path):
     )
     assert res.returncode == 0, res.stderr
     assert "[stream] win=" in res.stdout          # live per-window stats
+    # per-window and summary lines are labeled with the score precision
+    assert "prec=f32" in res.stdout
+    assert "[stream] done [f32]:" in res.stdout
     assert "streamed end-to-end accuracy" in res.stdout
     with open(out) as f:
         lines = f.readlines()

@@ -55,12 +55,25 @@ from traceweaver_tpu.algorithms.weaver_tpu import (
     solve_em_fleet,
     solve_windows_fleet,
 )
+from traceweaver_tpu.ops.precision import (
+    precision_from_env,
+    score_itemsize,
+    validate_precision,
+)
 from traceweaver_tpu.spans import NA
 
-# fleet single-dispatch budget: live f32 elements of the [B, E, W, M]
-# score block (the dominant allocation). Past this the padded single
-# program would stress HBM; fall back to per-service dispatches instead.
+# fleet single-dispatch budget, denominated in f32 elements for knob
+# back-compat (TW_FLEET_BUDGET): live bytes of the [B, E, W, M] score
+# block (the dominant allocation) are bounded by 4x this. Past it the
+# padded single program would stress HBM; fall back to per-service
+# dispatches instead. Group costs are counted in BYTES at the score
+# precision (ops/precision.py), so a TW_PRECISION=bf16 solve fits ~2x
+# the windows per dispatch and ~2x the pipeline depth under one budget.
 FLEET_BUDGET_ELEMS = int(os.environ.get("TW_FLEET_BUDGET", 1 << 28))
+
+
+def _fleet_budget_bytes() -> int:
+    return FLEET_BUDGET_ELEMS * 4
 
 # window-axis keys of a packed fleet batch, dispatch argument order
 _BATCH_KEYS = ("in_start", "in_end", "in_valid", "out_start", "out_end",
@@ -319,6 +332,7 @@ def solve_fleet(
     mesh=None,
     stats: Optional[Dict[str, float]] = None,
     item_cells: Optional[List[float]] = None,
+    precision: Optional[str] = None,
 ) -> List[Tuple]:
     """Solve every item, fusing eligible ones into one device dispatch.
 
@@ -326,8 +340,9 @@ def solve_fleet(
     (:func:`_solve_groups_pipelined`): a pack thread builds group N+1's
     tensors while group N executes on the device, each group's
     dispatch/compaction/decode flow runs on a small worker pool
-    (``TW_DECODE_WORKERS``), and ``FLEET_BUDGET_ELEMS`` bounds the live
-    in-flight elements (the pipeline depth limit). The pipeline reorders
+    (``TW_DECODE_WORKERS``), and the ``TW_FLEET_BUDGET`` byte budget
+    bounds the live in-flight blocks (the pipeline depth limit). The
+    pipeline reorders
     WORK only, never output — results are bit-identical and in input
     order; ``TW_PIPELINE=0`` restores the strictly serial flow.
 
@@ -346,6 +361,11 @@ def solve_fleet(
     used by callers to attribute one dispatch's wall-clock to services
     (runtime executor and the parity harness share this model).
 
+    ``precision`` (``"f32"``/``"bf16"``, default = ``TW_PRECISION``) is
+    the score-block storage precision for every fused dispatch and the
+    per-service fallback alike; the live-dispatch budget and the pipeline
+    depth limit account in bytes at this precision.
+
     Returns one FindAssignments-style 6-tuple per item, in order:
     ``(all_assignments, all_topk, not_best_count, n_spans,
     per_span_candidates, cnt_unassigned)``.
@@ -356,9 +376,12 @@ def solve_fleet(
     # crashing the whole mixed solve on WeaverTPU's assert
     n_mesh = int(mesh.devices.size) if mesh is not None else 1
     fallback_mesh = mesh if n_mesh & (n_mesh - 1) == 0 else None
+    precision = validate_precision(
+        precision if precision is not None else precision_from_env())
     solver_kwargs = dict(max_window=max_window, epsilon=epsilon,
                          n_sinkhorn=n_sinkhorn, n_sweeps=n_sweeps,
-                         sinkhorn_tol=sinkhorn_tol, mesh=fallback_mesh)
+                         sinkhorn_tol=sinkhorn_tol, mesh=fallback_mesh,
+                         precision=precision)
     solver = WeaverTPU(all_spans, all_processes, **solver_kwargs)
     results: List[Optional[Tuple]] = [None] * len(items)
     st = _as_stats(stats)
@@ -459,7 +482,9 @@ def solve_fleet(
 
     # --- budget + dispatch per group -------------------------------------
     hypers_common = dict(epsilon=epsilon, n_sinkhorn=n_sinkhorn,
-                         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol)
+                         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
+                         precision=precision)
+    itemsize = score_itemsize(precision)
     specs: List[_GroupSpec] = []
     for group in groups:
         W_pad = max(p[6] for p in group)
@@ -475,7 +500,11 @@ def solve_fleet(
         # the fused refit gathers each service's window rows: [P*Ne, Bmax*W]
         # (single-pass dynamism groups never refit)
         refit_elems = P * Ne * bmax * W_pad if n_passes == 2 else 0
-        if score_elems + refit_elems > FLEET_BUDGET_ELEMS:
+        # cost in BYTES, dtype-aware: score blocks at the configured
+        # precision's itemsize (bf16 = half), the refit samples always
+        # f32 (GMM EM stays full-precision)
+        cost = score_elems * itemsize + refit_elems * 4
+        if cost > _fleet_budget_bytes():
             # padded group block would stress HBM: per-service dispatches.
             # The counter accumulates — a mixed workload can trip the
             # budget on several groups and the ledger must say how many.
@@ -483,10 +512,9 @@ def solve_fleet(
                           all_spans, all_processes, solver_kwargs, st)
             st.add("fleet_fallback_budget", 1.0)
             continue
-        cost = score_elems + refit_elems
-        # depth-limit observability: the largest single admission and the
-        # total the budget must amortize (budget < total => the pipeline
-        # gate/serial drain actually engaged on this workload)
+        # depth-limit observability (bytes): the largest single admission
+        # and the total the budget must amortize (budget < total => the
+        # pipeline gate/serial drain actually engaged on this workload)
         st.record_max("fleet_group_cost_max", float(cost))
         st.add("fleet_group_cost_total", float(cost))
         specs.append(_GroupSpec(group, W_pad, M_pad, E_pad, bmax, n_passes,
@@ -516,8 +544,9 @@ def solve_fleet(
 
 class _GroupSpec:
     """One shape-class dispatch group plus its padded geometry and budget
-    cost (live f32 elements while its blocks are in flight — the unit
-    the pipeline depth limit is denominated in)."""
+    cost (live BYTES while its blocks are in flight, dtype-aware at the
+    score precision — the unit the pipeline depth limit is denominated
+    in)."""
 
     __slots__ = ("group", "W_pad", "M_pad", "E_pad", "bmax", "n_passes",
                  "cost")
@@ -539,7 +568,7 @@ def _solve_groups_serial(specs, solver, results, st, hypers_common, mesh):
     pending = []
     total_live = 0
     for spec in specs:
-        if total_live + spec.cost > FLEET_BUDGET_ELEMS:
+        if total_live + spec.cost > _fleet_budget_bytes():
             # keep every live dispatch under one budget: drain first
             for pend in pending:
                 _decode_group(solver, pend, results, st)
@@ -564,9 +593,11 @@ def _solve_groups_pipelined(specs, solver, results, st, hypers_common,
       so one group's host-side flag gather or decode never idles the
       device: other flows' dispatches keep it fed (the event-driven
       warm->gather->redispatch requirement);
-    - ``FLEET_BUDGET_ELEMS`` — the existing live-dispatch bound — is the
-      pipeline depth limit: the gate blocks before admitting a group
-      that would push the in-flight element total past one budget.
+    - the live-dispatch bound (``TW_FLEET_BUDGET``, counted in BYTES at
+      the score precision) is the pipeline depth limit: the gate blocks
+      before admitting a group that would push the in-flight byte total
+      past one budget — a bf16 solve's groups cost half, so the same
+      budget admits ~2x the depth.
 
     Only WORK is reordered, never output: every flow writes its items'
     input-order ``results`` slots and runs byte-for-byte the serial
@@ -602,7 +633,7 @@ def _solve_groups_pipelined(specs, solver, results, st, hypers_common,
                 # the live-element budget (a lone over-budget group was
                 # already routed to the per-service fallback upstream)
                 while live["elems"] > 0 and \
-                        live["elems"] + spec.cost > FLEET_BUDGET_ELEMS:
+                        live["elems"] + spec.cost > _fleet_budget_bytes():
                     gate.wait()
                 live["elems"] += spec.cost
                 live["flows"] += 1
@@ -678,6 +709,7 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
         # exit early on convergence), same model as WeaverTPU._solve_once
         n_sweeps = hypers_common["n_sweeps"]
         n_sinkhorn = hypers_common["n_sinkhorn"]
+        itemsize = score_itemsize(hypers_common.get("precision", "f32"))
         K = params["in_wt"].shape[2]
         cells = (n_windows_total * E_pad * W_pad * M_pad
                  * n_sweeps * n_passes)
@@ -686,8 +718,10 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
             + 6.0 * 2 * n_sinkhorn
             + 8.0 * max(1, W_pad.bit_length())
         ))
-        st.add("bytes_est_xla", cells * 4.0 * 2 * n_sinkhorn)
-        st.add("bytes_est_pallas", cells * 4.0 * 3)
+        # score-block HBM traffic at the configured precision's itemsize
+        # (bf16 halves it); the Pallas term keeps the f32 plan write
+        st.add("bytes_est_xla", cells * float(itemsize) * 2 * n_sinkhorn)
+        st.add("bytes_est_pallas", cells * (float(itemsize) + 2 * 4.0))
         if n_passes == 2:
             # counts fused EM dispatches (the grouping may produce several)
             st.add("fused_em_applied", 1.0)
@@ -735,6 +769,7 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
     hypers = dict(epsilon=hypers_common["epsilon"],
                   n_sinkhorn=hypers_common["n_sinkhorn"],
                   sinkhorn_tol=hypers_common["sinkhorn_tol"],
+                  precision=hypers_common.get("precision", "f32"),
                   max_preds=pg["max_preds"], max_succs=pg["max_succs"])
     warm = _compaction_warm()
     use_compact = (_compaction_on() and warm < n_sweeps

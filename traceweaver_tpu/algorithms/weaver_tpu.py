@@ -60,6 +60,11 @@ from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
 from traceweaver_tpu.algorithms.timing import MAX_COMPONENTS, EdgeDist
 from traceweaver_tpu.metrics.accuracy import get_out_eps_in_order
 from traceweaver_tpu.ops.pallas_sinkhorn import assign_topk
+from traceweaver_tpu.ops.precision import (
+    precision_from_env,
+    score_itemsize,
+    validate_precision,
+)
 from traceweaver_tpu.ops.scores import mixture_logpdf, pair_scores
 from traceweaver_tpu.spans import NA, SKIP, Span
 
@@ -112,6 +117,7 @@ def _solve_windows_impl(
     sinkhorn_tol: float,
     max_preds: int = 0,
     max_succs: int = 0,
+    precision: str = "f32",
 ):
     """Shared body of :func:`solve_windows` / :func:`solve_windows_fleet`.
 
@@ -129,7 +135,19 @@ def _solve_windows_impl(
     real DAG edges (in-degree is ~1 in these call graphs) pay for
     evaluation. Identical sums: gathered entries are exactly the
     mask-true entries, padding contributes 0.0.
+
+    ``precision`` (static; see :mod:`traceweaver_tpu.ops.precision`) is
+    the score-BLOCK storage precision: the mixture terms are evaluated
+    and summed in f32 (accumulation stays full-precision), then each
+    endpoint's assembled OT block is stored at ``precision`` before the
+    Sinkhorn loop streams it — under ``"bf16"`` the block the sweep
+    ``while_loop`` re-reads every iteration is half the bytes. The
+    Sinkhorn potentials, marginals, convergence test, transport plan,
+    and rounding margins stay f32 throughout (``ops/sinkhorn.py`` /
+    ``ops/pallas_sinkhorn.py``); ``"f32"`` compiles the historical
+    program bit-identically (no cast is inserted at all).
     """
+    precision = validate_precision(precision)
     B, E, M = out_start.shape
     W = in_start.shape[1]
     POS = -NEG
@@ -223,8 +241,29 @@ def _solve_windows_impl(
             skip_score = jnp.where(fskip[e], 0.0, skip_score)
             skip_score = jnp.where(in_v, skip_score, NEG)
             Sfull = jnp.concatenate([S, skip_score[:, None]], axis=1)  # [W, M+1]
+            if precision == "bf16":
+                # store the assembled OT block at the score precision:
+                # this is the array the Sinkhorn loop streams twice per
+                # iteration, and the argmax below compares the SAME
+                # values the solver actually ranked. f32 accumulation
+                # already happened (the term sums above). Each row is
+                # centered at its best feasible score BEFORE the
+                # downcast: entropic OT plans are invariant to per-row
+                # additive constants (they fold into the f potentials),
+                # and DAG-conditioned rows carry common offsets of
+                # hundreds of log units (e.g. the return-edge term) that
+                # would otherwise eat bf16's ~8-bit mantissa — the
+                # margins BETWEEN candidates, the part the solve must
+                # resolve, sit near 0 after centering. Masked entries
+                # stay at NEG (an all-infeasible row centers at 0).
+                row_ref = jnp.where(row_best > NEG / 2, row_best, 0.0)
+                Sfull = jnp.where(Sfull > NEG / 2,
+                                  Sfull - row_ref[:, None], NEG)
+                Sfull = Sfull.astype(jnp.bfloat16)
 
             # --- marginals (dummy row absorbs surplus columns) ----------
+            # marginals stay f32 regardless of the score precision (S is
+            # the f32 accumulated block; counts must be exact)
             n_rows = jnp.sum(in_v).astype(S.dtype)
             n_cols = jnp.sum(o_v[e]).astype(S.dtype)
             cap_e = jnp.maximum(cap[e], jnp.maximum(n_rows - n_cols, 0.0))
@@ -234,7 +273,7 @@ def _solve_windows_impl(
             )
             col_marg = jnp.concatenate([o_v[e].astype(S.dtype), cap_e[None]])
             S_ot = jnp.concatenate(
-                [Sfull, jnp.zeros((1, M + 1), dtype=S.dtype)], axis=0
+                [Sfull, jnp.zeros((1, M + 1), dtype=Sfull.dtype)], axis=0
             )
 
             # fused persistent-sweep block: Sinkhorn + greedy rounding +
@@ -320,7 +359,8 @@ def _solve_windows_impl(
 
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
-                                   "sinkhorn_tol", "max_preds", "max_succs"))
+                                   "sinkhorn_tol", "max_preds", "max_succs",
+                                   "precision"))
 def solve_windows(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
     skip_cap, force_skip,
@@ -337,6 +377,7 @@ def solve_windows(
     sinkhorn_tol: float = 0.0,
     max_preds: int = 0,
     max_succs: int = 0,
+    precision: str = "f32",
 ):
     """Solve every window by Gauss-Seidel coordinate descent over endpoints.
 
@@ -365,7 +406,7 @@ def solve_windows(
         ret_wt[None], ret_mu[None], ret_sd[None],
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
-        max_preds=max_preds, max_succs=max_succs,
+        max_preds=max_preds, max_succs=max_succs, precision=precision,
     )
     return assign, tk, not_best, feas
 
@@ -387,12 +428,14 @@ def _pack_solver_outputs(assign, tk, not_best, feas):
 
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
-                                   "sinkhorn_tol", "max_preds", "max_succs"),
+                                   "sinkhorn_tol", "max_preds", "max_succs",
+                                   "precision"),
          donate_argnums=tuple(range(8)))
 def solve_windows_packed(*args, epsilon: float = 1.0, n_sinkhorn: int = 40,
                          topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
                          sinkhorn_tol: float = 0.0,
-                         max_preds: int = 0, max_succs: int = 0):
+                         max_preds: int = 0, max_succs: int = 0,
+                         precision: str = "f32"):
     """:func:`solve_windows` with the outputs packed into one int32 tensor
     ``[B, E, W, 3+topk]`` (see :func:`_pack_solver_outputs`) so a solve
     costs a single device->host transfer instead of four. The window
@@ -405,7 +448,7 @@ def solve_windows_packed(*args, epsilon: float = 1.0, n_sinkhorn: int = 40,
         *(a[None] for a in args[8:]),
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
-        max_preds=max_preds, max_succs=max_succs,
+        max_preds=max_preds, max_succs=max_succs, precision=precision,
     )
     return _pack_solver_outputs(*outs[:4])
 
@@ -462,7 +505,8 @@ def em_family_samples(assign, in_start, in_end, in_valid,
 
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
-                                   "sinkhorn_tol", "max_preds", "max_succs"),
+                                   "sinkhorn_tol", "max_preds", "max_succs",
+                                   "precision"),
          donate_argnums=tuple(range(8)))
 def solve_em_packed(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
@@ -473,6 +517,7 @@ def solve_em_packed(
     topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
     sinkhorn_tol: float = 0.0,
     max_preds: int = 0, max_succs: int = 0,
+    precision: str = "f32",
 ):
     """Both EM iterations in ONE device dispatch.
 
@@ -491,6 +536,10 @@ def solve_em_packed(
     perfect-cut segment was split beyond ``max_window``), and the GMM EM
     uses the deterministic quantile init / fixed iteration count of the
     device fit rather than sklearn's k-means init.
+
+    ``precision`` covers BOTH passes' score blocks; the delay-sample
+    extraction and the in-graph BIC-GMM refit between them stay f32
+    (the EM statistics are the accumulator state of this pipeline).
     """
     B, E, M = out_start.shape
     W = in_start.shape[1]
@@ -503,6 +552,7 @@ def solve_em_packed(
         ret_wt, ret_mu, ret_sd,
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk, n_sweeps=n_sweeps,
         sinkhorn_tol=sinkhorn_tol, max_preds=max_preds, max_succs=max_succs,
+        precision=precision,
     )
 
     # --- M-step samples: the three production edge families --------------
@@ -527,11 +577,13 @@ def solve_em_packed(
         w[E + E * E:], mu[E + E * E:], sd[E + E * E:],
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk, n_sweeps=n_sweeps,
         sinkhorn_tol=sinkhorn_tol, max_preds=max_preds, max_succs=max_succs,
+        precision=precision,
     )
 
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
-                                   "sinkhorn_tol", "max_preds", "max_succs"),
+                                   "sinkhorn_tol", "max_preds", "max_succs",
+                                   "precision"),
          donate_argnums=tuple(range(8)))
 def solve_windows_fleet(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
@@ -543,6 +595,7 @@ def solve_windows_fleet(
     topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
     sinkhorn_tol: float = 0.0,
     max_preds: int = 0, max_succs: int = 0,
+    precision: str = "f32",
 ):
     """Multi-service :func:`solve_windows` with the packed int32 output
     (window tensors donated — see :func:`solve_windows_packed`).
@@ -565,7 +618,7 @@ def solve_windows_fleet(
         ret_wts, ret_mus, ret_sds,
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
-        max_preds=max_preds, max_succs=max_succs,
+        max_preds=max_preds, max_succs=max_succs, precision=precision,
     )
     return _pack_solver_outputs(*outs[:4]), outs[4]
 
@@ -641,7 +694,8 @@ def refit_fleet_params(assign0, in_start, in_end, in_valid,
 
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
-                                   "sinkhorn_tol", "max_preds", "max_succs"),
+                                   "sinkhorn_tol", "max_preds", "max_succs",
+                                   "precision"),
          donate_argnums=tuple(range(8)))
 def solve_em_fleet(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
@@ -653,6 +707,7 @@ def solve_em_fleet(
     topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
     sinkhorn_tol: float = 0.0,
     max_preds: int = 0, max_succs: int = 0,
+    precision: str = "f32",
 ):
     """Both EM iterations for a whole service fleet in ONE dispatch.
 
@@ -679,7 +734,7 @@ def solve_em_fleet(
         ret_wts, ret_mus, ret_sds,
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
-        max_preds=max_preds, max_succs=max_succs,
+        max_preds=max_preds, max_succs=max_succs, precision=precision,
     )
 
     tables = _fleet_refit_tables(
@@ -694,7 +749,7 @@ def solve_em_fleet(
         *tables,
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
-        max_preds=max_preds, max_succs=max_succs,
+        max_preds=max_preds, max_succs=max_succs, precision=precision,
     )
 
 
@@ -1023,13 +1078,18 @@ class WeaverTPU:
     def __init__(self, all_spans, all_processes, max_window: int = DEFAULT_MAX_WINDOW,
                  epsilon: float = 1.0, n_sinkhorn: int = 40, n_sweeps: int = 5,
                  mesh=None, score_mode: str = "mixture",
-                 sinkhorn_tol: float = 1e-3):
+                 sinkhorn_tol: float = 1e-3, precision: Optional[str] = None):
         self.all_spans = all_spans
         self.all_processes = all_processes
         self.max_window = max_window
         self.epsilon = epsilon
         self.n_sinkhorn = n_sinkhorn
         self.n_sweeps = n_sweeps
+        # score-block storage precision ("f32" default — bit-identical
+        # historical program — or "bf16"; see ops/precision.py). None
+        # reads TW_PRECISION at construction time.
+        self.precision = validate_precision(
+            precision if precision is not None else precision_from_env())
         # early-exit tolerance for the Sinkhorn potentials (n_sinkhorn stays
         # the hard cap); the Gauss-Seidel sweep loop exits exactly on
         # assignment stability regardless of this value
@@ -1140,10 +1200,16 @@ class WeaverTPU:
                 "divide evenly across devices")
 
         stats = self.stats
+        # per-dispatch budget in BYTES (CHUNK_ELEMS is denominated in f32
+        # elements for knob back-compat): a bf16 score block charges half,
+        # so the same HBM bound admits ~2x the windows per dispatch
+        itemsize = score_itemsize(self.precision)
+        chunk_bytes = CHUNK_ELEMS * 4
         plan = []
         for wclass, wins in batches_spec:
             m_est = est_m(wins)
-            per_chunk = max(1, CHUNK_ELEMS // (wclass * m_est * E)) * n_dev
+            per_chunk = max(
+                1, chunk_bytes // (wclass * m_est * E * itemsize)) * n_dev
             chunks = [wins[i:i + per_chunk]
                       for i in range(0, len(wins), per_chunk)]
             for chunk in chunks:
@@ -1202,13 +1268,15 @@ class WeaverTPU:
                 + 6.0 * 2 * self.n_sinkhorn
                 + 8.0 * max(1, W_c.bit_length())
             )
-            # XLA-path HBM traffic bound: the [W, M] block streams twice
-            # per Sinkhorn iteration (row+col LSE); the Pallas kernel
-            # keeps it VMEM-resident and only pays one read + one write
+            # XLA-path HBM traffic bound: the [W, M] score block streams
+            # twice per Sinkhorn iteration (row+col LSE) at the SCORE
+            # itemsize (bf16 halves this — the whole point of
+            # TW_PRECISION); the Pallas kernel keeps it VMEM-resident and
+            # only pays one score read plus the f32 plan/result write
             stats["bytes_est_xla"] = stats.get("bytes_est_xla", 0.0) + (
-                cells * 4.0 * 2 * self.n_sinkhorn)
+                cells * float(itemsize) * 2 * self.n_sinkhorn)
             stats["bytes_est_pallas"] = stats.get(
-                "bytes_est_pallas", 0.0) + cells * 4.0 * 3
+                "bytes_est_pallas", 0.0) + cells * (float(itemsize) + 2 * 4.0)
             t0 = _time.perf_counter()
             solve_fn = solve_em_packed if use_fused else solve_windows_packed
             out = solve_fn(
@@ -1221,7 +1289,7 @@ class WeaverTPU:
                 a["ret_wt"], a["ret_mu"], a["ret_sd"],
                 epsilon=self.epsilon, n_sinkhorn=self.n_sinkhorn,
                 n_sweeps=n_sweeps, sinkhorn_tol=self.sinkhorn_tol,
-                max_preds=mp, max_succs=ms,
+                max_preds=mp, max_succs=ms, precision=self.precision,
             )
             stats["dispatch_s"] = stats.get("dispatch_s", 0.0) + (
                 _time.perf_counter() - t0)

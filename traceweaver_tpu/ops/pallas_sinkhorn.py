@@ -59,21 +59,41 @@ def _vmem_cap_bytes() -> int:
     return max(_VMEM_FLOOR_BYTES, min(cap, _VMEM_HW_BYTES_V5E))
 
 
-def _padded_block_bytes(n: int, m: int) -> int:
-    return _round_up(n, 8) * _round_up(m, 128) * 4
+def _sublane(itemsize: int) -> int:
+    """Minimum sublane tile per dtype (f32: 8, bf16: 16 — the packed
+    16-bit tiling doubles the sublane count at half the bytes)."""
+    return 8 if itemsize >= 4 else 16
 
 
-def fits_pallas_vmem(n: int, m: int) -> bool:
-    """True when the padded [n, m] f32 block's pipeline footprint
-    (~6x block) fits the scoped-VMEM cap."""
-    return 6 * _padded_block_bytes(n, m) <= _vmem_cap_bytes()
+def _padded_block_bytes(n: int, m: int, itemsize: int = 4) -> int:
+    return (_round_up(n, _sublane(itemsize)) * _round_up(m, 128)
+            * itemsize)
+
+
+def fits_pallas_vmem(n: int, m: int, itemsize: int = 4) -> bool:
+    """True when the padded [n, m] score block's pipeline footprint
+    (~6x block BYTES — calibrated on the f32 bench fleet block, see the
+    VMEM-sizing comment above) fits the scoped-VMEM cap. Dtype-aware:
+    a bf16 block (``itemsize=2``) charges half the bytes, so the same
+    cap admits ~2x the elements — the block stays resident at the score
+    precision and only transient per-iteration temporaries upcast."""
+    return 6 * _padded_block_bytes(n, m, itemsize) <= _vmem_cap_bytes()
 
 
 def _kernel(s_ref, r_ref, c_ref, out_ref, *, n_iters: int, inv_eps: float,
             tol_phi: float):
-    logK = s_ref[:] * inv_eps      # [N, M], VMEM-resident throughout
+    # the score block stays VMEM-resident AT ITS STORAGE PRECISION
+    # (bf16 under TW_PRECISION=bf16 — half the residency and half the
+    # HBM read); each use upcasts to f32 transiently, so the potentials,
+    # the LSE accumulations, and the convergence delta are all f32. For
+    # f32 input the astype is an identity and the math is bit-identical
+    # to the historical hoisted `logK = s * inv_eps`.
+    s_raw = s_ref[:]               # [N, M] score-dtype resident block
     log_r = r_ref[:]               # [N, 1] log row marginals (NEG = disabled)
     log_c = c_ref[:]               # [1, M]
+
+    def logK():
+        return s_raw.astype(jnp.float32) * inv_eps
 
     def lse_rows(x):
         m = jnp.max(x, axis=1, keepdims=True)
@@ -84,9 +104,9 @@ def _kernel(s_ref, r_ref, c_ref, out_ref, *, n_iters: int, inv_eps: float,
         return m + jnp.log(jnp.sum(jnp.exp(x - m), axis=0, keepdims=True))
 
     def update(f, g):
-        f = log_r - lse_rows(logK + g)
+        f = log_r - lse_rows(logK() + g)
         f = jnp.where(log_r > NEG / 2, f, NEG)
-        g = log_c - lse_cols(logK + f)
+        g = log_c - lse_cols(logK() + f)
         g = jnp.where(log_c > NEG / 2, g, NEG)
         return f, g
 
@@ -111,7 +131,7 @@ def _kernel(s_ref, r_ref, c_ref, out_ref, *, n_iters: int, inv_eps: float,
         init = (f, g, jnp.asarray(0, jnp.int32),
                 jnp.asarray(jnp.inf, jnp.float32))
         f, g, _, _ = jax.lax.while_loop(cond, body, init)
-    out_ref[:] = jnp.exp(jnp.clip(logK + f + g, -80.0, 80.0))
+    out_ref[:] = jnp.exp(jnp.clip(logK() + f + g, -80.0, 80.0))
 
 
 def _round_up(n: int, k: int) -> int:
@@ -131,35 +151,39 @@ def sinkhorn_log_pallas(
 ) -> jnp.ndarray:
     """Drop-in for :func:`traceweaver_tpu.ops.sinkhorn.sinkhorn_log`.
 
-    Pads to TPU tile multiples (8 sublanes × 128 lanes for f32); padded
-    rows/columns carry marginal 0 and score NEG, so they take no mass.
-    ``tol`` has the same early-exit semantics as ``sinkhorn_log`` (it is
-    rescaled to the kernel's ``φ = f/ε`` potentials internally).
+    Pads to TPU tile multiples (8 sublanes × 128 lanes for f32, 16 × 128
+    for bf16 score blocks); padded rows/columns carry marginal 0 and
+    score NEG, so they take no mass. ``tol`` has the same early-exit
+    semantics as ``sinkhorn_log`` (it is rescaled to the kernel's
+    ``φ = f/ε`` potentials internally). bf16 ``scores`` stay bf16 in
+    VMEM (potentials/marginals f32) and the returned plan is f32, like
+    the jnp reference.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n, m = scores.shape
-    np_, mp = _round_up(n, 8), _round_up(m, 128)
+    itemsize = jnp.dtype(scores.dtype).itemsize
+    np_, mp = _round_up(n, _sublane(itemsize)), _round_up(m, 128)
 
-    s = jnp.full((np_, mp), NEG, dtype=jnp.float32)
-    s = jax.lax.dynamic_update_slice(s, scores.astype(jnp.float32), (0, 0))
+    s = jnp.full((np_, mp), NEG, dtype=scores.dtype)
+    s = jax.lax.dynamic_update_slice(s, scores, (0, 0))
+    row_marginals = row_marginals.astype(jnp.float32)
+    col_marginals = col_marginals.astype(jnp.float32)
     log_r = jnp.where(row_marginals > 0,
                       jnp.log(jnp.maximum(row_marginals, 1e-30)), NEG)
     log_c = jnp.where(col_marginals > 0,
                       jnp.log(jnp.maximum(col_marginals, 1e-30)), NEG)
     r = jnp.full((np_, 1), NEG, dtype=jnp.float32)
-    r = jax.lax.dynamic_update_slice(
-        r, log_r.astype(jnp.float32)[:, None], (0, 0))
+    r = jax.lax.dynamic_update_slice(r, log_r[:, None], (0, 0))
     c = jnp.full((1, mp), NEG, dtype=jnp.float32)
-    c = jax.lax.dynamic_update_slice(
-        c, log_c.astype(jnp.float32)[None, :], (0, 0))
+    c = jax.lax.dynamic_update_slice(c, log_c[None, :], (0, 0))
 
     kernel = functools.partial(
         _kernel, n_iters=n_iters, inv_eps=1.0 / epsilon,
         tol_phi=tol / epsilon)
     vmem_budget = min(_vmem_cap_bytes(),
-                      max(_VMEM_FLOOR_BYTES, 6 * np_ * mp * 4))
+                      max(_VMEM_FLOOR_BYTES, 6 * np_ * mp * itemsize))
     plan = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((np_, mp), jnp.float32),
@@ -173,7 +197,9 @@ def sinkhorn_log_pallas(
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=vmem_budget),
     )(s, r, c)
-    return plan[:n, :m].astype(scores.dtype)
+    # plan stays f32 even for bf16 scores (matches sinkhorn_log): the
+    # rounding tie-break margins downstream need full precision
+    return plan[:n, :m]
 
 
 # ---------------------------------------------------------------------------
@@ -206,9 +232,17 @@ def _fused_kernel(s_ref, r_ref, c_ref, cap_ref, out_ref, *, n_iters: int,
     """
     from traceweaver_tpu.ops.rounding import greedy_round_core, topk_peel_core
 
-    logK = s_ref[:] * inv_eps      # [Rp, Cp], VMEM-resident throughout
+    # score block resident at its STORAGE precision (bf16 halves both
+    # the VMEM residency and the kernel's one HBM read under
+    # TW_PRECISION=bf16); every use upcasts to f32 transiently — the
+    # potentials, plan, and rounding state are all f32 (identity for
+    # f32 input, bit-identical to the historical hoisted logK)
+    s_raw = s_ref[:]               # [Rp, Cp] score-dtype resident block
     log_r = r_ref[:]               # [Rp, 1] log row marginals (NEG = disabled)
     log_c = c_ref[:]               # [1, Cp]
+
+    def logK():
+        return s_raw.astype(jnp.float32) * inv_eps
 
     def lse_rows(x):
         m = jnp.max(x, axis=1, keepdims=True)
@@ -219,9 +253,9 @@ def _fused_kernel(s_ref, r_ref, c_ref, cap_ref, out_ref, *, n_iters: int,
         return m + jnp.log(jnp.sum(jnp.exp(x - m), axis=0, keepdims=True))
 
     def update(f, g):
-        f = log_r - lse_rows(logK + g)
+        f = log_r - lse_rows(logK() + g)
         f = jnp.where(log_r > NEG / 2, f, NEG)
-        g = log_c - lse_cols(logK + f)
+        g = log_c - lse_cols(logK() + f)
         g = jnp.where(log_c > NEG / 2, g, NEG)
         return f, g
 
@@ -246,7 +280,7 @@ def _fused_kernel(s_ref, r_ref, c_ref, cap_ref, out_ref, *, n_iters: int,
                 jnp.asarray(jnp.inf, jnp.float32))
         f, g, _, _ = jax.lax.while_loop(cond, body, init)
 
-    plan = jnp.exp(jnp.clip(logK + f + g, -80.0, 80.0))  # [Rp, Cp]
+    plan = jnp.exp(jnp.clip(logK() + f + g, -80.0, 80.0))  # [Rp, Cp] f32
 
     rp, cp = plan.shape
     row_iota = jax.lax.broadcasted_iota(jnp.int32, (rp, cp), 0)
@@ -300,20 +334,21 @@ def fused_assign_pallas(
     from jax.experimental.pallas import tpu as pltpu
 
     r_dim, c_dim = scores.shape
-    rp, cp = _round_up(r_dim, 8), _round_up(c_dim, 128)
+    itemsize = jnp.dtype(scores.dtype).itemsize
+    rp, cp = _round_up(r_dim, _sublane(itemsize)), _round_up(c_dim, 128)
 
-    s = jnp.full((rp, cp), NEG, dtype=jnp.float32)
-    s = jax.lax.dynamic_update_slice(s, scores.astype(jnp.float32), (0, 0))
+    s = jnp.full((rp, cp), NEG, dtype=scores.dtype)
+    s = jax.lax.dynamic_update_slice(s, scores, (0, 0))
+    row_marginals = row_marginals.astype(jnp.float32)
+    col_marginals = col_marginals.astype(jnp.float32)
     log_r = jnp.where(row_marginals > 0,
                       jnp.log(jnp.maximum(row_marginals, 1e-30)), NEG)
     log_c = jnp.where(col_marginals > 0,
                       jnp.log(jnp.maximum(col_marginals, 1e-30)), NEG)
     r = jnp.full((rp, 1), NEG, dtype=jnp.float32)
-    r = jax.lax.dynamic_update_slice(
-        r, log_r.astype(jnp.float32)[:, None], (0, 0))
+    r = jax.lax.dynamic_update_slice(r, log_r[:, None], (0, 0))
     c = jnp.full((1, cp), NEG, dtype=jnp.float32)
-    c = jax.lax.dynamic_update_slice(
-        c, log_c.astype(jnp.float32)[None, :], (0, 0))
+    c = jax.lax.dynamic_update_slice(c, log_c[None, :], (0, 0))
     cap = jnp.asarray(skip_cap, jnp.float32).reshape(1, 1)
 
     kernel = functools.partial(
@@ -321,7 +356,7 @@ def fused_assign_pallas(
         tol_phi=tol / epsilon, n_rows=n_rows, skip_col=c_dim - 1,
         topk=topk, min_topk_mass=min_topk_mass)
     vmem_budget = min(_vmem_cap_bytes(),
-                      max(_VMEM_FLOOR_BYTES, 6 * rp * cp * 4))
+                      max(_VMEM_FLOOR_BYTES, 6 * rp * cp * itemsize))
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((rp, _FUSED_OUT_LANES), jnp.int32),
@@ -350,7 +385,10 @@ def assign_topk_jnp(S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap,
 
     plan = sinkhorn(S_ot, row_marg, col_marg,
                     epsilon=epsilon, n_iters=n_iters, tol=tol)
-    plan = plan[:n_rows, :]
+    # the plan is f32 for every score precision (the Sinkhorn paths
+    # promote against the f32 potentials); assert rather than silently
+    # round tie-break margins through a reduced dtype
+    plan = plan.astype(jnp.float32)[:n_rows, :]
     assign = greedy_round(plan, in_valid, col_valid,
                           skip_cap.astype(jnp.int32), n_steps=n_rows)
     tk_mass, tk = topk_peel(
@@ -374,7 +412,7 @@ def assign_topk(S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap,
     n, m = S_ot.shape
     fused_ok = os.environ.get("TW_PALLAS_FUSED", "1") not in ("0", "false", "")
     if (not fused_ok or not use_pallas() or n * m < 64 * 128
-            or not fits_pallas_vmem(n, m)):
+            or not fits_pallas_vmem(n, m, jnp.dtype(S_ot.dtype).itemsize)):
         return assign_topk_jnp(
             S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap, n_rows,
             epsilon=epsilon, n_iters=n_iters, tol=tol, topk=topk,
@@ -434,7 +472,7 @@ def sinkhorn(scores, row_marginals, col_marginals, epsilon=1.0, n_iters=50,
 
     n, m = scores.shape
     if (not use_pallas() or n * m < 64 * 128
-            or not fits_pallas_vmem(n, m)):
+            or not fits_pallas_vmem(n, m, jnp.dtype(scores.dtype).itemsize)):
         return sinkhorn_log(scores, row_marginals, col_marginals,
                             epsilon=epsilon, n_iters=n_iters, tol=tol)
     if os.environ.get("TW_PALLAS_INTERPRET") == "1":

@@ -1,0 +1,78 @@
+"""Mixed-precision policy for the score path (``TW_PRECISION``).
+
+The r05 device profile shows the solve is memory-bound, not
+compute-bound: ``mfu_measured_pct`` 0.39 against ``wait_s`` dominated by
+the vmapped Sinkhorn sweep loops streaming f32 ``[B, E, W, M]`` score
+blocks. The likelihood scores tolerate reduced precision (log-domain
+Sinkhorn with entropic regularization is stable under a coarse kernel
+matrix — the potentials re-normalize every iteration), so the score
+*blocks* may be stored and streamed in bfloat16 while everything that
+accumulates or compares stays f32:
+
+- **bf16**: the ``[N, M]`` score block (the array the Sinkhorn loop
+  reads twice per iteration — the dominant HBM traffic);
+- **f32**: the Sinkhorn potentials f/g, the row/column marginals, the
+  convergence test, the transport plan handed to rounding (tie-break
+  margins must be deterministic), and the whole GMM EM fit.
+
+This is the standard TPU training-stack split (bf16 activations, f32
+accumulators/state) applied to the solver. The policy is a *static*
+property of the compiled program: every jitted entry point takes
+``precision`` as a static argument, so ``"f32"`` (the default) compiles
+exactly the historical all-f32 program — bit-identical outputs — and
+``"bf16"`` is a separate compiled variant.
+
+One knob: ``TW_PRECISION`` (``f32`` default | ``bf16``), read at solve
+time by the entry points that do not receive an explicit ``precision``
+argument. Byte accounting elsewhere (fleet live-dispatch budget, Pallas
+VMEM admission, bench HBM estimates) keys off :func:`score_itemsize` so
+bf16 blocks count half — the fused kernel admits ~2x larger
+VMEM-resident blocks and the dispatch pipeline ~2x deeper groups.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+
+#: accepted values of TW_PRECISION / the ``precision`` solver arguments
+PRECISIONS = ("f32", "bf16")
+
+_ALIASES = {
+    "": "f32",
+    "f32": "f32",
+    "fp32": "f32",
+    "float32": "f32",
+    "bf16": "bf16",
+    "bfloat16": "bf16",
+}
+
+
+def validate_precision(precision: str) -> str:
+    """Normalize a precision spec; raise on anything unknown (a typo'd
+    ``TW_PRECISION=bf61`` must fail loudly, not silently run f32)."""
+    norm = _ALIASES.get(str(precision).strip().lower())
+    if norm is None:
+        raise ValueError(
+            f"unknown precision {precision!r}; expected one of {PRECISIONS}")
+    return norm
+
+
+def precision_from_env() -> str:
+    """The active score-path precision (``TW_PRECISION``, default f32).
+    Read at call time — test fixtures and launchers export it after
+    import."""
+    return validate_precision(os.environ.get("TW_PRECISION", "f32"))
+
+
+def score_dtype(precision: str):
+    """jnp dtype of the score blocks under ``precision``."""
+    return jnp.bfloat16 if validate_precision(precision) == "bf16" \
+        else jnp.float32
+
+
+def score_itemsize(precision: str) -> int:
+    """Bytes per score-block element — the unit every byte-denominated
+    budget (fleet dispatch, Pallas VMEM admission, HBM estimates) uses."""
+    return 2 if validate_precision(precision) == "bf16" else 4

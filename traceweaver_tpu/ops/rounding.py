@@ -133,8 +133,16 @@ def greedy_round(
     skip_capacity: jnp.ndarray,  # scalar int
     n_steps: int,
 ) -> jnp.ndarray:
-    """Returns assignment [N] int32: column index per row, M = skip, -1 = none."""
+    """Returns assignment [N] int32: column index per row, M = skip, -1 = none.
+
+    The peel order is decided by mass comparisons, so the plan is forced
+    to f32 here (identity on the solver's plans, which are already f32
+    for every score precision — see the mixed-precision contract in
+    :mod:`traceweaver_tpu.ops.precision`): tie-break margins through a
+    reduced dtype would make the assignment order nondeterministic
+    across backends."""
     n, m1 = plan.shape
+    plan = plan.astype(jnp.float32)
     mass0 = jnp.where(row_valid[:, None] & col_valid[None, :], plan, NEG)
     return greedy_round_core(mass0, skip_capacity, n_steps, skip_col=m1 - 1)
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import math
 import os
 
+import jax
 import jax.numpy as jnp
 from jax.scipy.special import logsumexp
 
@@ -26,7 +27,8 @@ _USE_GEMM = os.environ.get("TW_SCORE_GEMM") == "1"
 
 
 def mixture_logpdf_gemm(x: jnp.ndarray, weights: jnp.ndarray,
-                        means: jnp.ndarray, stds: jnp.ndarray) -> jnp.ndarray:
+                        means: jnp.ndarray, stds: jnp.ndarray,
+                        out_dtype=None) -> jnp.ndarray:
     """GEMM formulation of the K-component Gaussian-mixture log-density.
 
     Expanding the per-component exponent makes each logit an inner
@@ -46,6 +48,14 @@ def mixture_logpdf_gemm(x: jnp.ndarray, weights: jnp.ndarray,
     ``y ~ d_k``); residual f32 error grows as ``(y/sd)^2 * eps`` and is
     asserted against the elementwise form in tests/test_ops.py.
     x: [...]; params: [K].
+
+    ``out_dtype`` (e.g. ``jnp.bfloat16`` under ``TW_PRECISION=bf16``)
+    casts the *result block* to the score-path storage precision and,
+    when it is bf16, feeds the contraction bf16 operands with an f32
+    accumulator (``preferred_element_type``) — the MXU's native input
+    format, the training-stack "bf16 activations, f32 accumulation"
+    shape. The coefficient table and the log-sum-exp stay f32: the
+    mixture coefficients span decades and the LSE is the accumulator.
     """
     var = stds * stds
     wsum = jnp.maximum(jnp.sum(weights), 1e-30)
@@ -59,8 +69,15 @@ def mixture_logpdf_gemm(x: jnp.ndarray, weights: jnp.ndarray,
     coef = jnp.stack([a, b, c], axis=0)                      # [3, K]
     y = x - mu_bar
     feats = jnp.stack([y * y, y, jnp.ones_like(y)], axis=-1)  # [..., 3]
-    logits = jnp.tensordot(feats, coef, axes=([-1], [0]))     # [..., K]
-    return logsumexp(logits, axis=-1)
+    if out_dtype is not None and jnp.dtype(out_dtype) == jnp.bfloat16:
+        logits = jax.lax.dot_general(
+            feats.astype(jnp.bfloat16), coef.astype(jnp.bfloat16),
+            (((feats.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [..., K] f32
+    else:
+        logits = jnp.tensordot(feats, coef, axes=([-1], [0]))  # [..., K]
+    out = logsumexp(logits, axis=-1)
+    return out if out_dtype is None else out.astype(out_dtype)
 
 
 def mixture_logpdf(x: jnp.ndarray, weights: jnp.ndarray, means: jnp.ndarray,
@@ -80,8 +97,20 @@ def mixture_logpdf(x: jnp.ndarray, weights: jnp.ndarray, means: jnp.ndarray,
 
 def pair_scores(t_prev: jnp.ndarray, out_start: jnp.ndarray,
                 weights: jnp.ndarray, means: jnp.ndarray,
-                stds: jnp.ndarray) -> jnp.ndarray:
+                stds: jnp.ndarray, out_dtype=None) -> jnp.ndarray:
     """Score matrix S[i, j] = log p(out_start_j - t_prev_i) under one edge's
-    mixture. t_prev: [N]; out_start: [M]; mixture params: [K]."""
+    mixture. t_prev: [N]; out_start: [M]; mixture params: [K].
+
+    ``out_dtype`` casts the emitted block to the score-path storage
+    precision (``traceweaver_tpu.ops.precision``). The mixture evaluation
+    itself stays f32 — the solver SUMS several of these blocks per
+    endpoint (f32 accumulation), so only the final accumulated block is
+    stored reduced; direct callers that want a bf16 block without an
+    accumulation step get the cast here.
+    """
     delta = out_start[None, :] - t_prev[:, None]  # [N, M]
-    return mixture_logpdf(delta, weights, means, stds)
+    if _USE_GEMM and weights.ndim == 1:
+        return mixture_logpdf_gemm(delta, weights, means, stds,
+                                   out_dtype=out_dtype)
+    out = mixture_logpdf(delta, weights, means, stds)
+    return out if out_dtype is None else out.astype(out_dtype)

@@ -54,11 +54,29 @@ def sinkhorn_log(
     slowest finishes; reclaiming those cycles is the caller's job
     (convergence compaction in :mod:`traceweaver_tpu.algorithms.fleet`
     redispatches only unconverged windows).
+
+    Mixed precision (``TW_PRECISION=bf16`` score path): ``scores`` may be
+    bfloat16. The kernel matrix then STAYS bf16 — the [N, M] block the
+    loop streams twice per iteration is the solve's dominant HBM traffic
+    and halving its bytes is the point — while the potentials f/g, the
+    marginals, the per-iteration delta/convergence test, and the returned
+    plan are all f32 (``logK + g`` promotes elementwise; XLA fuses the
+    upcast into the log-sum-exp reduction, so no f32 copy of the block is
+    ever materialized). f32 scores compile the historical all-f32
+    program unchanged.
     """
+    row_marginals = row_marginals.astype(jnp.float32)
+    col_marginals = col_marginals.astype(jnp.float32)
     log_r = jnp.where(row_marginals > 0, jnp.log(jnp.maximum(row_marginals, 1e-30)), NEG)
     log_c = jnp.where(col_marginals > 0, jnp.log(jnp.maximum(col_marginals, 1e-30)), NEG)
 
-    logK = scores / epsilon  # [N, M]
+    if scores.dtype == jnp.float32:
+        logK = scores / epsilon  # [N, M]
+    else:
+        # divide in f32 for accuracy, store back at the score precision:
+        # the loop below re-reads this array every iteration and its
+        # residency/bandwidth is what the reduced precision buys
+        logK = (scores.astype(jnp.float32) / epsilon).astype(scores.dtype)
 
     def update(f, g):
         # f_i = eps*(log r_i - LSE_j(logK_ij + g_j/eps))
@@ -68,8 +86,8 @@ def sinkhorn_log(
         g = jnp.where(col_marginals > 0, g, NEG)
         return f, g
 
-    f0 = jnp.zeros_like(row_marginals, dtype=scores.dtype)
-    g0 = jnp.zeros_like(col_marginals, dtype=scores.dtype)
+    f0 = jnp.zeros_like(row_marginals, dtype=jnp.float32)
+    g0 = jnp.zeros_like(col_marginals, dtype=jnp.float32)
     if tol == 0.0:
         # fixed count: keeps the pre-tolerance codegen (fori_loop is
         # reverse-mode differentiable; while_loop is not)
@@ -98,5 +116,8 @@ def sinkhorn_log(
         init = (f0, g0, jnp.asarray(0, jnp.int32), jnp.asarray(False))
         f, g, _, _ = jax.lax.while_loop(cond, body, init)
 
+    # the plan is f32 regardless of the score precision (bf16 logK
+    # promotes against the f32 potentials): rounding's tie-break margins
+    # must compare at full precision for a deterministic peel order
     log_plan = logK + (f[:, None] + g[None, :]) / epsilon
     return jnp.exp(jnp.clip(log_plan, -80.0, 80.0))

@@ -164,9 +164,10 @@ def stream_main(argv) -> int:
         service = StreamingReconstructor(source, cfg, sink=sink)
     summary = service.run()
 
-    print("[stream] done: %d events -> %d windows, %d spans emitted, "
+    print("[stream] done [%s]: %d events -> %d windows, %d spans emitted, "
           "late %d rerouted / %d dropped, shed %d spilled / %d dropped"
-          % (summary["consumed"], summary["emitted_windows"],
+          % (summary.get("precision", "f32"), summary["consumed"],
+             summary["emitted_windows"],
              summary["stats"].get("spans_emitted", 0),
              summary["late_rerouted"], summary["late_dropped"],
              summary["shed_spilled"], summary["shed_dropped_windows"]))

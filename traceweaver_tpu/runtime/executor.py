@@ -259,11 +259,21 @@ def _solve_fleet_method(cfg: ExecutorConfig, store: TraceStore, method: str,
         n_sinkhorn=predictor.n_sinkhorn, n_sweeps=predictor.n_sweeps,
         sinkhorn_tol=predictor.sinkhorn_tol, mesh=predictor.mesh,
         item_cells=cells, stats=fleet_stats,
+        precision=getattr(predictor, "precision", None),
     )
     elapsed = time.time() - start
     # dispatch observability: recompiles are the shape-class regression
     # signal (a warm steady state runs at zero), and the compaction line
     # says how much sweep work the convergence redispatch reclaimed
+    precision = getattr(predictor, "precision", "f32") or "f32"
+    if precision != "f32":
+        # reduced-precision runs must be unmistakable in the log: the
+        # score blocks stream at this precision (potentials/EM stay f32)
+        from traceweaver_tpu.ops.precision import score_itemsize
+
+        print("[fleet] %s: score-path precision=%s (TW_PRECISION; byte "
+              "ledger bytes_est_* accounts at %d B/elem)"
+              % (method, precision, score_itemsize(precision)))
     n_compiles = int(fleet_stats.get("backend_compiles", 0))
     n_hits = int(fleet_stats.get("persistent_cache_hits", 0))
     if n_compiles or n_hits:

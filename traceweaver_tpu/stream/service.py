@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from traceweaver_tpu.ops.precision import precision_from_env
 from traceweaver_tpu.spans import NA, SKIP, Span
 from traceweaver_tpu.stream.checkpoint import load_checkpoint, save_checkpoint
 from traceweaver_tpu.stream.scheduler import MicroBatchScheduler
@@ -139,6 +140,13 @@ class StreamingReconstructor:
         self.stats: Dict[str, float] = {}
         self.fleet_stats: Dict[str, float] = {}
         self._since_checkpoint = 0
+        # score-path precision (TW_PRECISION, read at service start) —
+        # labels every micro-batch/window line and rides the checkpoint
+        # so a resume under a DIFFERENT precision is visible, not silent
+        # (it is safe: all checkpointed state — carried EdgeDist
+        # statistics, window buffers, offsets — is host-side f32 and
+        # precision-independent; only the device score blocks change)
+        self.precision = precision_from_env()
 
     # -- per-window problem construction ----------------------------------
     def _window_problems(self, buf: WindowBuffer) -> List[_WindowProblem]:
@@ -219,7 +227,8 @@ class StreamingReconstructor:
             counters_before = compile_counters()
             outs = solve_fleet(items, all_spans=self.live.all_spans,
                                all_processes=self.live.all_processes,
-                               stats=self.fleet_stats)
+                               stats=self.fleet_stats,
+                               precision=self.precision)
             delta = counters_delta(counters_before)
             self.stats["micro_batches"] = self.stats.get(
                 "micro_batches", 0) + 1
@@ -229,10 +238,10 @@ class StreamingReconstructor:
             # regression the batch bench's recompile counter watches for
             if self.cfg.verbose and (delta["backend_compiles"]
                                      or delta["persistent_cache_hits"]):
-                print("[stream] micro-batch %d: %d windows, %d XLA "
+                print("[stream] micro-batch %d [%s]: %d windows, %d XLA "
                       "compiles (%d persistent-cache hits, %d misses)"
-                      % (self.stats["micro_batches"], len(bufs),
-                         delta["backend_compiles"],
+                      % (self.stats["micro_batches"], self.precision,
+                         len(bufs), delta["backend_compiles"],
                          delta["persistent_cache_hits"],
                          delta["persistent_cache_misses"]))
         solve_s = time.perf_counter() - t0
@@ -365,11 +374,12 @@ class StreamingReconstructor:
             rate = (res.n_rows / res.solve_share_s
                     if res.solve_share_s > 0 else 0.0)
             print(
-                "[stream] win=%d spans=%d owned=%d traces=%d svc=%d "
-                "acc=%s wm_delay=%.2fs late=%d/%d shed=%d backlog=%d "
-                "%.1f spans/s"
-                % (buf.k, buf.n_spans, buf.n_owned, len(res.traces),
-                   len(res.problems), acc, buf.seal_delay_us / 1e6,
+                "[stream] win=%d prec=%s spans=%d owned=%d traces=%d "
+                "svc=%d acc=%s wm_delay=%.2fs late=%d/%d shed=%d "
+                "backlog=%d %.1f spans/s"
+                % (buf.k, self.precision, buf.n_spans, buf.n_owned,
+                   len(res.traces), len(res.problems), acc,
+                   buf.seal_delay_us / 1e6,
                    self.windower.late_rerouted, self.windower.late_dropped,
                    self.scheduler.shed_spilled
                    + self.scheduler.shed_dropped_windows,
@@ -384,6 +394,7 @@ class StreamingReconstructor:
             return
         save_checkpoint(self.cfg.checkpoint_path, dict(
             cfg=self.cfg,
+            precision=self.precision,
             consumed=self.consumed,
             emitted_windows=self.emitted_windows,
             emit_offset=self.sink.offset if self.sink else 0,
@@ -417,6 +428,19 @@ class StreamingReconstructor:
         if sink is None and state.get("sink_path"):
             sink = TraceSink(state["sink_path"])
         svc = cls(source, cfg, sink=sink)
+        # precision compatibility: checkpoints are precision-portable by
+        # construction (every checkpointed value — carried EdgeDist
+        # statistics, spans, offsets — is host-side f32; the score
+        # precision only affects device blocks built AFTER resume), so a
+        # cross-precision resume is legal. It changes the solver the
+        # re-solved windows run under, so say so rather than resume
+        # silently; pre-precision checkpoints carry "f32" implicitly.
+        ckpt_precision = state.get("precision", "f32")
+        if ckpt_precision != svc.precision and cfg.verbose:
+            print("[stream] resume: checkpoint was written under "
+                  "precision=%s, resuming under %s (carried state is "
+                  "precision-independent)"
+                  % (ckpt_precision, svc.precision))
         svc.consumed = state["consumed"]
         svc.emitted_windows = state["emitted_windows"]
         svc.watermark = state["watermark"]
@@ -486,6 +510,7 @@ class StreamingReconstructor:
     def _summary(self, final: bool) -> Dict:
         out = dict(
             final=final,
+            precision=self.precision,
             consumed=self.consumed,
             emitted_windows=self.emitted_windows,
             late_rerouted=self.windower.late_rerouted,
