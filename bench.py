@@ -485,6 +485,49 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         f"(delta_vs_f32 {report['accuracy_delta_vs_f32']} pts) — "
         "report updated")
 
+    # --- opt-in chaos leg (bench.py --faults / TW_BENCH_FAULTS): the same
+    # subset inputs re-solved under injected faults. The solve must
+    # COMPLETE through the supervisor's degradation ladder; the ledger
+    # (retries/bisections/fallbacks/quarantined/deadletter bytes) and the
+    # chaos-vs-clean accuracy delta (must stay ≤ 1 pt) ship in the
+    # report. ----------------------------------------------------------
+    chaos_spec = os.environ.get("TW_BENCH_FAULTS")
+    if chaos_spec:
+        from traceweaver_tpu.runtime import faults as faults_mod
+
+        t0 = time.perf_counter()
+        chaos_stats: dict = {}
+        chaos_q: list = []
+        chaos_seed = int(os.environ.get("TW_FAULTS_SEED", "0"))
+        log(f"child: chaos leg under TW_BENCH_FAULTS={chaos_spec!r} "
+            f"(seed {chaos_seed})")
+        with faults_mod.override(chaos_spec, seed=chaos_seed) as plan:
+            chaos_outs = solve_fleet(sub_items, stats=chaos_stats,
+                                     quarantined=chaos_q,
+                                     precision=precision)
+        accs_chaos = {
+            label: accuracy_for_service(out[0], sub_ta, sub_in)
+            for (label, _, sub_in, sub_ta), out in zip(sub_meta, chaos_outs)
+        }
+        dlq_bytes = sum(
+            len(json.dumps({"service": sub_meta[i][0],
+                            "reason": "quarantined"})) + 1
+            for i in chaos_q)
+        report.update(chaos_fields(
+            chaos_stats, accs_by_prec[precision], accs_chaos, dlq_bytes))
+        report["chaos_spec"] = chaos_spec
+        report["chaos_injected"] = plan.total_injected()
+        report["chaos_solve_s"] = round(time.perf_counter() - t0, 2)
+        if report["chaos_delta_exceeds_1pt"]:
+            log("child: WARNING — chaos-leg accuracy delta exceeds 1 pt "
+                f"vs the unfaulted leg ({report['chaos_accuracy_delta_pts']}"
+                " pts)")
+        write_json_atomic(out_path, report)
+        log(f"child: chaos leg {report['chaos_solve_s']}s — "
+            f"{report['chaos_retries']} retries, "
+            f"{report['chaos_quarantined']} quarantined, "
+            f"delta {report['chaos_accuracy_delta_pts']} pts")
+
     # --- enrichment ------------------------------------------------------
     # NOTE: the parent holds the baseline child until the marker below, so
     # enrichment (profile parse, pallas compile check) must finish first —
@@ -605,6 +648,35 @@ def bf16_delta_fields(accs_f32: dict, accs_bf16: dict) -> dict:
             ds: round(d, 4) for ds, d in per_dataset.items()},
         "bf16_delta_exceeds_1pt": sorted(
             ds for ds, d in per_dataset.items() if abs(d) > 1.0),
+    }
+
+
+def chaos_fields(fault_stats: dict, accs_clean: dict, accs_chaos: dict,
+                 deadletter_bytes: int) -> dict:
+    """Chaos-leg ledger + accuracy delta -> report fields.
+
+    ``fault_stats`` is the faulted solve's fleet stats dict (the
+    supervisor's ``fault_*`` counters); accuracies are fractions (0..1)
+    keyed by service label, deltas reported in POINTS against the ≤1 pt
+    acceptance bar. Quarantined services score 0-vs-clean by definition
+    (their windows are all-NA), so the delta *includes* the cost of
+    giving up — the bar measures the whole ladder, not just the lucky
+    retries."""
+    deltas = [(accs_chaos[k] - accs_clean[k]) * 100.0
+              for k in accs_clean if k in accs_chaos]
+    delta = round(sum(deltas) / len(deltas), 4) if deltas else None
+    return {
+        "chaos_retries": int(fault_stats.get("fault_retries", 0)),
+        "chaos_bisections": int(fault_stats.get("fault_bisections", 0)),
+        "chaos_xla_fallbacks": int(
+            fault_stats.get("fault_xla_fallbacks", 0)),
+        "chaos_host_fallbacks": int(
+            fault_stats.get("fault_host_fallbacks", 0)),
+        "chaos_quarantined": int(fault_stats.get("fault_quarantined", 0)),
+        "chaos_deadletter_bytes": int(deadletter_bytes),
+        "chaos_accuracy_delta_pts": delta,
+        "chaos_delta_exceeds_1pt": bool(delta is not None
+                                        and abs(delta) > 1.0),
     }
 
 
@@ -1089,6 +1161,19 @@ def main() -> None:
                                               if exact_sps_all else None),
         "baseline_fresh_solves": (baseline or {}).get("n_fresh"),
         "baseline_recorded_carried": (baseline or {}).get("n_recorded"),
+        # chaos leg (--faults / TW_BENCH_FAULTS): supervisor ledger of a
+        # fault-injected re-solve of the subset inputs + its accuracy
+        # delta vs the unfaulted leg (the ≤1 pt robustness bar)
+        "chaos_spec": solver.get("chaos_spec"),
+        "chaos_injected": solver.get("chaos_injected"),
+        "chaos_retries": solver.get("chaos_retries"),
+        "chaos_bisections": solver.get("chaos_bisections"),
+        "chaos_xla_fallbacks": solver.get("chaos_xla_fallbacks"),
+        "chaos_host_fallbacks": solver.get("chaos_host_fallbacks"),
+        "chaos_quarantined": solver.get("chaos_quarantined"),
+        "chaos_deadletter_bytes": solver.get("chaos_deadletter_bytes"),
+        "chaos_accuracy_delta_pts": solver.get("chaos_accuracy_delta_pts"),
+        "chaos_delta_exceeds_1pt": solver.get("chaos_delta_exceeds_1pt"),
         "pallas_on_device_ok": solver.get("pallas_on_device_ok"),
         "stage_seconds": solver.get("stage_seconds"),
         "fused_em_dispatches": solver.get("fused_em_dispatches"),
@@ -1120,7 +1205,16 @@ if __name__ == "__main__":
                     default="parent")
     ap.add_argument("--bundle")
     ap.add_argument("--out")
+    ap.add_argument("--faults", nargs="?", const="dispatch:0.2",
+                    default=None, metavar="SPEC",
+                    help="opt-in chaos leg: re-solve the subset inputs "
+                         "under injected faults (default spec "
+                         "dispatch:0.2) and report the supervisor "
+                         "ledger + accuracy delta vs the unfaulted leg")
     args = ap.parse_args()
+    if args.faults:
+        # env, so the solver CHILD (where the leg runs) inherits it
+        os.environ["TW_BENCH_FAULTS"] = args.faults
     if args.mode == "solver":
         run_solver_child(args.bundle, args.out)
     elif args.mode == "baseline":

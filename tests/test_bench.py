@@ -160,6 +160,38 @@ def test_backend_label_flags_cpu_fallback(bench):
     assert bench.backend_label("axon") == ("axon", True)
 
 
+@pytest.mark.faults
+def test_chaos_fields_ledger_and_delta(bench):
+    """The chaos-leg report builder: fleet fault counters -> chaos_*
+    ledger fields, accuracy fractions -> delta in POINTS against the
+    ≤1 pt bar, dead-letter bytes passed through verbatim."""
+    fault_stats = {"fault_retries": 7.0, "fault_bisections": 2.0,
+                   "fault_xla_fallbacks": 1.0, "fault_host_fallbacks": 1.0,
+                   "fault_quarantined": 1.0,
+                   "fault_ladder": ["retry", "retry", "bisect"]}
+    clean = {"hotel/frontend": 0.90, "hotel/search": 1.0}
+    chaos = {"hotel/frontend": 0.90, "hotel/search": 0.99}
+    out = bench.chaos_fields(fault_stats, clean, chaos, 123)
+    assert out["chaos_retries"] == 7
+    assert out["chaos_bisections"] == 2
+    assert out["chaos_xla_fallbacks"] == 1
+    assert out["chaos_host_fallbacks"] == 1
+    assert out["chaos_quarantined"] == 1
+    assert out["chaos_deadletter_bytes"] == 123
+    # mean of (0, -1.0) pts
+    assert out["chaos_accuracy_delta_pts"] == -0.5
+    assert out["chaos_delta_exceeds_1pt"] is False
+
+    # a quarantined-heavy run blows the bar -> flagged, not hidden
+    bad = bench.chaos_fields({}, clean, {"hotel/frontend": 0.0,
+                                         "hotel/search": 1.0}, 0)
+    assert bad["chaos_delta_exceeds_1pt"] is True
+    # empty accuracies degrade to None, not a crash
+    empty = bench.chaos_fields({}, {}, {}, 0)
+    assert empty["chaos_accuracy_delta_pts"] is None
+    assert empty["chaos_delta_exceeds_1pt"] is False
+
+
 @pytest.mark.precision
 def test_bf16_delta_fields_per_dataset_and_warn_list(bench):
     """The bf16-vs-f32 accuracy delta aggregation: fraction accuracies

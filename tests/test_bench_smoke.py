@@ -187,6 +187,36 @@ def test_env_bf16_rides_the_whole_fleet_path(monkeypatch):
     assert stats.get("bytes_est_xla", 0) > 0
 
 
+@pytest.mark.faults
+def test_chaos_smoke_fault_injected_solve_completes_with_ledger(monkeypatch):
+    """Tier-1 chaos smoke: a TW_FAULTS-injected fleet solve under
+    JAX_PLATFORMS=cpu must COMPLETE through the supervisor's degradation
+    ladder with a nonzero retry ledger and zero lost windows — every
+    item's slot holds a result identical to the unfaulted run (no 'host'
+    faults are injected, so every recovery rung is output-exact and
+    quarantine is unreachable)."""
+    from test_pipeline import _mixed_items
+
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+    from traceweaver_tpu.runtime import faults
+
+    faults.reset()
+    out_clean = solve_fleet(_mixed_items(), stats={})
+    monkeypatch.setenv("TW_FAULTS", "dispatch:0.5,fetch:0.2")
+    monkeypatch.setenv("TW_FAULTS_SEED", "1")
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    try:
+        stats, q = {}, []
+        out = solve_fleet(_mixed_items(), stats=stats, quarantined=q)
+    finally:
+        faults.reset()
+    assert stats.get("faults_injected", 0) > 0, "chaos never engaged"
+    assert stats.get("fault_retries", 0) > 0, "retry ledger empty"
+    assert q == [] and all(r is not None for r in out)  # zero lost windows
+    for a, b in zip(out_clean, out):
+        assert a[0] == b[0] and a[1] == b[1] and a[2:] == b[2:]
+
+
 @pytest.mark.pipeline
 def test_pipelined_fleet_runs_and_second_solve_is_compile_free():
     """Tier-1 pipeline smoke: under JAX_PLATFORMS=cpu the fleet solve

@@ -60,6 +60,8 @@ from traceweaver_tpu.ops.precision import (
     score_itemsize,
     validate_precision,
 )
+from traceweaver_tpu.runtime import faults as _faults
+from traceweaver_tpu.runtime import knobs as _knobs
 from traceweaver_tpu.spans import NA
 
 # fleet single-dispatch budget, denominated in f32 elements for knob
@@ -90,11 +92,10 @@ def _compaction_warm() -> int:
     """Warm sweep count before convergence compaction redispatches
     (``TW_SWEEP_WARM``, default 2 — sweep 0 plus one verification sweep,
     which certifies the large fraction of windows whose Gauss-Seidel
-    assignments are already a fixed point after the forward pass)."""
-    try:
-        return max(1, int(os.environ.get("TW_SWEEP_WARM", "2")))
-    except ValueError:
-        return 2
+    assignments are already a fixed point after the forward pass).
+    Declared in :mod:`traceweaver_tpu.runtime.knobs`: an unparseable
+    value raises instead of silently running the default."""
+    return _knobs.get_int("TW_SWEEP_WARM")
 
 
 def _compaction_on() -> bool:
@@ -117,10 +118,35 @@ def _decode_workers() -> int:
     round trips -> output fetch -> decode, so this bounds how many
     groups can overlap their host-side work with other groups' device
     execution (the live-element budget bounds depth independently)."""
+    return _knobs.get_int("TW_DECODE_WORKERS")
+
+
+def _retry_max() -> int:
+    """Bounded redispatch retries before the supervisor's ladder bisects
+    (``TW_RETRY_MAX``, default 2)."""
+    return _knobs.get_int("TW_RETRY_MAX")
+
+
+def _retry_backoff_s() -> float:
+    """Base of the exponential retry backoff, seconds
+    (``TW_RETRY_BACKOFF_S``, default 0.02 — attempt k sleeps
+    ``base * 2**k``; transient device faults such as OOM-under-contention
+    or a relay flake clear on their own, so retries must not hammer)."""
+    return _knobs.get_float("TW_RETRY_BACKOFF_S")
+
+
+def _fault_check(site: str, st: "_Stats") -> None:
+    """Deterministic fault-injection hook (``TW_FAULTS``), ledgered.
+    With no active plan this is one cached-module call returning
+    immediately — the production no-fault path stays bit-identical."""
+    if _faults.active() is None:
+        return
     try:
-        return max(1, int(os.environ.get("TW_DECODE_WORKERS", "2")))
-    except ValueError:
-        return 2
+        _faults.maybe_fail(site)
+    except _faults.FaultError:
+        st.add("faults_injected")
+        st.add("faults_injected_" + site)
+        raise
 
 
 class _Stats:
@@ -156,6 +182,16 @@ class _Stats:
             for k, v in other.items():
                 self.d[k] = self.d.get(k, 0.0) + v
 
+    def note(self, key: str, event: str) -> None:
+        """Append to an ORDERED event list under ``key`` (the supervisor's
+        degradation-ladder audit trail — ``fault_ladder``). List-valued,
+        unlike every counter, so consumers that aggregate numerically
+        must skip it; it serializes to JSON like the rest of the dict."""
+        if self.d is None:
+            return
+        with self._lock:
+            self.d.setdefault(key, []).append(event)
+
 
 def _as_stats(stats) -> _Stats:
     return stats if isinstance(stats, _Stats) else _Stats(stats)
@@ -179,6 +215,7 @@ def _fetch(handle, st: _Stats, flow_wait=None, flag_fetch: bool = False):
     (a 1-element list) accumulates this flow's blocking time so the
     dispatcher can subtract it from its launch-time accounting without
     reading the shared dict back."""
+    _fault_check("fetch", st)
     t0 = time.perf_counter()
     out = np.asarray(handle)
     dt = time.perf_counter() - t0
@@ -333,6 +370,7 @@ def solve_fleet(
     stats: Optional[Dict[str, float]] = None,
     item_cells: Optional[List[float]] = None,
     precision: Optional[str] = None,
+    quarantined: Optional[List[int]] = None,
 ) -> List[Tuple]:
     """Solve every item, fusing eligible ones into one device dispatch.
 
@@ -365,6 +403,20 @@ def solve_fleet(
     the score-block storage precision for every fused dispatch and the
     per-service fallback alike; the live-dispatch budget and the pipeline
     depth limit account in bytes at this precision.
+
+    Every dispatch group runs under the solve SUPERVISOR: a transient
+    device failure (``XlaRuntimeError``, ``RESOURCE_EXHAUSTED``, or an
+    injected ``TW_FAULTS`` fault) walks an explicit degradation ladder —
+    bounded retry with exponential backoff, bisection of the group to
+    isolate the offending service, a fused-Pallas-free XLA redispatch,
+    the per-service host fallback — and only a singleton that exhausts
+    every rung is QUARANTINED: its slot gets an all-NA result, its index
+    is appended to ``quarantined`` (when the caller passes a list), and
+    the whole walk is ledgered in ``stats`` (``fault_retries``,
+    ``fault_bisections``, ``fault_xla_fallbacks``,
+    ``fault_host_fallbacks``, ``fault_quarantined``, plus the ordered
+    ``fault_ladder`` event list). Non-transient errors (bugs) propagate
+    unchanged. See docs/ROBUSTNESS.md.
 
     Returns one FindAssignments-style 6-tuple per item, in order:
     ``(all_assignments, all_topk, not_best_count, n_spans,
@@ -485,26 +537,17 @@ def solve_fleet(
                          n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
                          precision=precision)
     itemsize = score_itemsize(precision)
+    # supervisor context: what the degradation ladder needs to route a
+    # failing singleton to the per-service host fallback, and where it
+    # records quarantined item indices for the caller (the stream service
+    # dead-letters the owning windows from this list)
+    ctx = dict(all_spans=all_spans, all_processes=all_processes,
+               solver_kwargs=solver_kwargs,
+               quarantined=quarantined if quarantined is not None else [])
     specs: List[_GroupSpec] = []
     for group in groups:
-        W_pad = max(p[6] for p in group)
-        M_pad = max(p[7] for p in group)
-        E_pad = max(len(p[2]["out_eps"]) for p in group)
-        n_passes = group[0][2]["n_passes"]  # uniform within a class
-        n_windows_total = sum(len(p[3]) for p in group)
-        bmax = max(len(p[3]) for p in group)
-        P = len(group)
-        # Ne family rows per service in the fused refit (in/edge/return)
-        Ne = E_pad + E_pad * E_pad + E_pad
-        score_elems = n_windows_total * E_pad * W_pad * M_pad
-        # the fused refit gathers each service's window rows: [P*Ne, Bmax*W]
-        # (single-pass dynamism groups never refit)
-        refit_elems = P * Ne * bmax * W_pad if n_passes == 2 else 0
-        # cost in BYTES, dtype-aware: score blocks at the configured
-        # precision's itemsize (bf16 = half), the refit samples always
-        # f32 (GMM EM stays full-precision)
-        cost = score_elems * itemsize + refit_elems * 4
-        if cost > _fleet_budget_bytes():
+        spec = _make_spec(group, itemsize)
+        if spec.cost > _fleet_budget_bytes():
             # padded group block would stress HBM: per-service dispatches.
             # The counter accumulates — a mixed workload can trip the
             # budget on several groups and the ledger must say how many.
@@ -515,10 +558,9 @@ def solve_fleet(
         # depth-limit observability (bytes): the largest single admission
         # and the total the budget must amortize (budget < total => the
         # pipeline gate/serial drain actually engaged on this workload)
-        st.record_max("fleet_group_cost_max", float(cost))
-        st.add("fleet_group_cost_total", float(cost))
-        specs.append(_GroupSpec(group, W_pad, M_pad, E_pad, bmax, n_passes,
-                                cost))
+        st.record_max("fleet_group_cost_max", float(spec.cost))
+        st.add("fleet_group_cost_total", float(spec.cost))
+        specs.append(spec)
     if not specs:
         return results  # type: ignore[return-value]
 
@@ -532,10 +574,10 @@ def solve_fleet(
     counters_before = compile_counters()
     if _pipeline_on():
         _solve_groups_pipelined(specs, solver, results, st, hypers_common,
-                                mesh)
+                                mesh, ctx)
     else:
         _solve_groups_serial(specs, solver, results, st, hypers_common,
-                             mesh)
+                             mesh, ctx)
     for key, val in counters_delta(counters_before).items():
         if val:
             st.add(key, val)
@@ -561,28 +603,206 @@ class _GroupSpec:
         self.cost = cost
 
 
-def _solve_groups_serial(specs, solver, results, st, hypers_common, mesh):
+def _make_spec(group: List, itemsize: int) -> _GroupSpec:
+    """Padded geometry + byte cost of one dispatch group. One definition
+    shared by the initial shape-class grouping and the supervisor's
+    bisection rung, so a bisected half is budgeted and padded by exactly
+    the rules the full group was (per-plan W/M buckets are already
+    powers of two, so halves cannot mint unbucketed shapes)."""
+    W_pad = max(p[6] for p in group)
+    M_pad = max(p[7] for p in group)
+    E_pad = max(len(p[2]["out_eps"]) for p in group)
+    n_passes = group[0][2]["n_passes"]  # uniform within a class
+    n_windows_total = sum(len(p[3]) for p in group)
+    bmax = max(len(p[3]) for p in group)
+    P = len(group)
+    # Ne family rows per service in the fused refit (in/edge/return)
+    Ne = E_pad + E_pad * E_pad + E_pad
+    score_elems = n_windows_total * E_pad * W_pad * M_pad
+    # the fused refit gathers each service's window rows: [P*Ne, Bmax*W]
+    # (single-pass dynamism groups never refit)
+    refit_elems = P * Ne * bmax * W_pad if n_passes == 2 else 0
+    # cost in BYTES, dtype-aware: score blocks at the configured
+    # precision's itemsize (bf16 = half), the refit samples always
+    # f32 (GMM EM stays full-precision)
+    cost = score_elems * itemsize + refit_elems * 4
+    return _GroupSpec(group, W_pad, M_pad, E_pad, bmax, n_passes, cost)
+
+
+# ---------------------------------------------------------------------------
+# Solve supervisor: retry -> bisect -> XLA -> host fallback -> quarantine
+# ---------------------------------------------------------------------------
+
+def _attempt_group(solver, pg, spec, results, st, hypers_common, mesh):
+    """One supervised dispatch+decode attempt of a packed group — the
+    unit every ladder rung retries. ``pg`` stays host-side NumPy, so a
+    failed attempt's donated device buffers never poison the retry:
+    every attempt places fresh device copies."""
+    _fault_check("dispatch", st)
+    pend = _dispatch_packed(pg, spec, st, hypers_common, mesh)
+    _decode_group(solver, pend, results, st)
+
+
+def _enter_ladder(err, solver, pg, spec, results, st, hypers_common, mesh,
+                  ctx):
+    """Classify a group failure: transient faults walk the degradation
+    ladder; anything else (a bug) propagates unchanged."""
+    if not _faults.is_transient_fault(err):
+        raise err
+    st.add("fault_dispatch_errors")
+    _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
+                   ctx)
+
+
+def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
+                   ctx):
+    """Walk the explicit degradation ladder for one failed dispatch group.
+
+    1. **retry** — up to ``TW_RETRY_MAX`` redispatches with exponential
+       backoff (``TW_RETRY_BACKOFF_S``): transient faults (OOM under
+       contention, relay flake, injected ``TW_FAULTS`` draws) usually
+       clear here, at full fidelity.
+    2. **bisect** — split the group in half and re-enter the ladder per
+       half: a single poisoned service must not take its whole shape
+       class down. Halves re-pack through :func:`_make_spec` /
+       :func:`_pack_group`, so their shapes stay power-of-two bucketed.
+    3. **xla** — a surviving singleton redispatches with the fused
+       Pallas kernel pinned off (``pallas=False`` static arg): a
+       Mosaic/kernel-specific failure gets the plain XLA program, which
+       is algorithm-identical (tests/test_fused_kernel.py).
+    4. **host** — the per-service host fallback (:func:`_run_fallback`,
+       the reference's own per-service path).
+    5. **quarantine** — the item's slot gets an all-NA result, its index
+       lands in ``ctx["quarantined"]``, and the poison window is the
+       CONSUMER's problem (the stream service dead-letters it; batch
+       callers see the counted all-NA result) — never a silent drop.
+
+    Every step is ledgered: counters per rung plus the ordered
+    ``fault_ladder`` event list."""
+    retry_max = _retry_max()
+    backoff = _retry_backoff_s()
+    for attempt in range(retry_max):
+        if backoff > 0:
+            time.sleep(backoff * (2 ** attempt))
+        st.add("fault_retries")
+        st.note("fault_ladder", "retry")
+        try:
+            _attempt_group(solver, pg, spec, results, st, hypers_common,
+                           mesh)
+            st.add("fault_recovered_retry")
+            return
+        except Exception as e:  # noqa: BLE001 — classified below
+            if not _faults.is_transient_fault(e):
+                raise
+            err = e
+
+    if len(spec.group) > 1:
+        # bisect: isolate the offender instead of failing the class
+        st.add("fault_bisections")
+        st.note("fault_ladder", "bisect")
+        mid = len(spec.group) // 2
+        itemsize = score_itemsize(hypers_common.get("precision", "f32"))
+        for half in (spec.group[:mid], spec.group[mid:]):
+            half_spec = _make_spec(half, itemsize)
+            half_pg = _pack_group(half_spec, hypers_common, st)
+            try:
+                _attempt_group(solver, half_pg, half_spec, results, st,
+                               hypers_common, mesh)
+            except Exception as e:  # noqa: BLE001
+                _enter_ladder(e, solver, half_pg, half_spec, results, st,
+                              hypers_common, mesh, ctx)
+        return
+
+    # --- singleton rungs -------------------------------------------------
+    st.add("fault_xla_fallbacks")
+    st.note("fault_ladder", "xla")
+    try:
+        _attempt_group(solver, pg, spec, results, st,
+                       {**hypers_common, "pallas": False}, mesh)
+        return
+    except Exception as e:  # noqa: BLE001
+        if not _faults.is_transient_fault(e):
+            raise
+        err = e
+
+    plan = spec.group[0]
+    st.add("fault_host_fallbacks")
+    st.note("fault_ladder", "host")
+    try:
+        _fault_check("host", st)
+        _run_fallback([(plan[0], plan[1])], results, ctx["all_spans"],
+                      ctx["all_processes"], ctx["solver_kwargs"], st)
+        if results[plan[0]] is not None:
+            return
+    except Exception as e:  # noqa: BLE001
+        if not _faults.is_transient_fault(e):
+            raise
+        err = e
+
+    st.add("fault_quarantined")
+    st.note("fault_ladder", "quarantine")
+    results[plan[0]] = _quarantine_result(plan)
+    ctx["quarantined"].append(plan[0])
+
+
+def _quarantine_result(plan) -> Tuple:
+    """The poison-window result: a structurally valid FindAssignments
+    6-tuple with every incoming span unassigned (NA at every endpoint),
+    so batch consumers grade it as what it is — a fully failed window —
+    instead of crashing on a missing slot. ``cnt_unassigned`` equals the
+    span count, which is also the conservation quantity the stream's
+    dead-letter accounting checks."""
+    prep = plan[2]
+    out_eps = prep["out_eps"]
+    in_ids = [s.GetId() for s in prep["in_spans"]]
+    all_assignments = {ep: {iid: NA for iid in in_ids} for ep in out_eps}
+    all_topk = {ep: {iid: [] for iid in in_ids} for ep in out_eps}
+    return (all_assignments, all_topk, 0, prep["n_in"],
+            {iid: 0 for iid in in_ids}, len(in_ids))
+
+
+def _solve_groups_serial(specs, solver, results, st, hypers_common, mesh,
+                         ctx):
     """The ``TW_PIPELINE=0`` reference flow: pack -> dispatch strictly in
     order on the calling thread, decoding (and draining the live-element
-    budget) exactly as the pre-pipeline dispatcher did."""
+    budget) exactly as the pre-pipeline dispatcher did. Failures enter
+    the degradation ladder per group; the happy path is byte-identical
+    to the unsupervised flow."""
     pending = []
     total_live = 0
+
+    def finish(entry):
+        spec, pg, pend = entry
+        try:
+            _decode_group(solver, pend, results, st)
+        except Exception as e:  # noqa: BLE001
+            _enter_ladder(e, solver, pg, spec, results, st, hypers_common,
+                          mesh, ctx)
+
     for spec in specs:
         if total_live + spec.cost > _fleet_budget_bytes():
             # keep every live dispatch under one budget: drain first
-            for pend in pending:
-                _decode_group(solver, pend, results, st)
+            for entry in pending:
+                finish(entry)
             pending = []
             total_live = 0
         total_live += spec.cost
         pg = _pack_group(spec, hypers_common, st)
-        pending.append(_dispatch_packed(pg, spec, st, hypers_common, mesh))
-    for pend in pending:
-        _decode_group(solver, pend, results, st)
+        try:
+            _fault_check("dispatch", st)
+            pend = _dispatch_packed(pg, spec, st, hypers_common, mesh)
+        except Exception as e:  # noqa: BLE001
+            # a launch-time failure: this group degrades synchronously
+            _enter_ladder(e, solver, pg, spec, results, st, hypers_common,
+                          mesh, ctx)
+            continue
+        pending.append((spec, pg, pend))
+    for entry in pending:
+        finish(entry)
 
 
 def _solve_groups_pipelined(specs, solver, results, st, hypers_common,
-                            mesh):
+                            mesh, ctx):
     """Bounded multi-stage pipeline over the dispatch groups.
 
     - a single pack thread builds group N+1's host tensors while group N
@@ -610,8 +830,16 @@ def _solve_groups_pipelined(specs, solver, results, st, hypers_common,
 
     def flow(pg, spec):
         try:
-            pend = _dispatch_packed(pg, spec, st, hypers_common, mesh)
-            _decode_group(solver, pend, results, st)
+            try:
+                _attempt_group(solver, pg, spec, results, st, hypers_common,
+                               mesh)
+            except Exception as e:  # noqa: BLE001 — transient faults
+                # degrade on THIS flow worker (the ladder's retries and
+                # sub-dispatches keep riding the pool, so other flows'
+                # device work still overlaps); non-transient errors
+                # re-raise and propagate through fut.result() below
+                _enter_ladder(e, solver, pg, spec, results, st,
+                              hypers_common, mesh, ctx)
         finally:
             with gate:
                 live["elems"] -= spec.cost
@@ -770,6 +998,11 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
                   n_sinkhorn=hypers_common["n_sinkhorn"],
                   sinkhorn_tol=hypers_common["sinkhorn_tol"],
                   precision=hypers_common.get("precision", "f32"),
+                  # the supervisor's XLA rung pins the fused Pallas
+                  # kernel off for a redispatch (a distinct static-arg
+                  # program variant); the default True is the historical
+                  # program and cache key
+                  pallas=hypers_common.get("pallas", True),
                   max_preds=pg["max_preds"], max_succs=pg["max_succs"])
     warm = _compaction_warm()
     use_compact = (_compaction_on() and warm < n_sweeps

@@ -118,6 +118,7 @@ def _solve_windows_impl(
     max_preds: int = 0,
     max_succs: int = 0,
     precision: str = "f32",
+    pallas: bool = True,
 ):
     """Shared body of :func:`solve_windows` / :func:`solve_windows_fleet`.
 
@@ -290,7 +291,8 @@ def _solve_windows_impl(
             assign, tk = assign_topk(
                 S_ot, row_marg, col_marg, in_v, col_valid, cap_e, W,
                 epsilon=epsilon, n_iters=n_sinkhorn, tol=sinkhorn_tol,
-                topk=topk, min_topk_mass=MIN_TOPK_MASS)
+                topk=topk, min_topk_mass=MIN_TOPK_MASS,
+                allow_pallas=pallas)
 
             # chosen completion: skip passes the predecessor time through
             real = (assign >= 0) & (assign < M)
@@ -360,7 +362,7 @@ def _solve_windows_impl(
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
                                    "sinkhorn_tol", "max_preds", "max_succs",
-                                   "precision"))
+                                   "precision", "pallas"))
 def solve_windows(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
     skip_cap, force_skip,
@@ -378,6 +380,7 @@ def solve_windows(
     max_preds: int = 0,
     max_succs: int = 0,
     precision: str = "f32",
+    pallas: bool = True,
 ):
     """Solve every window by Gauss-Seidel coordinate descent over endpoints.
 
@@ -407,6 +410,7 @@ def solve_windows(
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs, precision=precision,
+        pallas=pallas,
     )
     return assign, tk, not_best, feas
 
@@ -429,13 +433,14 @@ def _pack_solver_outputs(assign, tk, not_best, feas):
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
                                    "sinkhorn_tol", "max_preds", "max_succs",
-                                   "precision"),
+                                   "precision", "pallas"),
          donate_argnums=tuple(range(8)))
 def solve_windows_packed(*args, epsilon: float = 1.0, n_sinkhorn: int = 40,
                          topk: int = DEFAULT_TOPK, n_sweeps: int = 5,
                          sinkhorn_tol: float = 0.0,
                          max_preds: int = 0, max_succs: int = 0,
-                         precision: str = "f32"):
+                         precision: str = "f32",
+                         pallas: bool = True):
     """:func:`solve_windows` with the outputs packed into one int32 tensor
     ``[B, E, W, 3+topk]`` (see :func:`_pack_solver_outputs`) so a solve
     costs a single device->host transfer instead of four. The window
@@ -449,6 +454,7 @@ def solve_windows_packed(*args, epsilon: float = 1.0, n_sinkhorn: int = 40,
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs, precision=precision,
+        pallas=pallas,
     )
     return _pack_solver_outputs(*outs[:4])
 
@@ -506,7 +512,7 @@ def em_family_samples(assign, in_start, in_end, in_valid,
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
                                    "sinkhorn_tol", "max_preds", "max_succs",
-                                   "precision"),
+                                   "precision", "pallas"),
          donate_argnums=tuple(range(8)))
 def solve_em_packed(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
@@ -518,6 +524,7 @@ def solve_em_packed(
     sinkhorn_tol: float = 0.0,
     max_preds: int = 0, max_succs: int = 0,
     precision: str = "f32",
+    pallas: bool = True,
 ):
     """Both EM iterations in ONE device dispatch.
 
@@ -552,7 +559,7 @@ def solve_em_packed(
         ret_wt, ret_mu, ret_sd,
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk, n_sweeps=n_sweeps,
         sinkhorn_tol=sinkhorn_tol, max_preds=max_preds, max_succs=max_succs,
-        precision=precision,
+        precision=precision, pallas=pallas,
     )
 
     # --- M-step samples: the three production edge families --------------
@@ -577,13 +584,13 @@ def solve_em_packed(
         w[E + E * E:], mu[E + E * E:], sd[E + E * E:],
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk, n_sweeps=n_sweeps,
         sinkhorn_tol=sinkhorn_tol, max_preds=max_preds, max_succs=max_succs,
-        precision=precision,
+        precision=precision, pallas=pallas,
     )
 
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
                                    "sinkhorn_tol", "max_preds", "max_succs",
-                                   "precision"),
+                                   "precision", "pallas"),
          donate_argnums=tuple(range(8)))
 def solve_windows_fleet(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
@@ -596,6 +603,7 @@ def solve_windows_fleet(
     sinkhorn_tol: float = 0.0,
     max_preds: int = 0, max_succs: int = 0,
     precision: str = "f32",
+    pallas: bool = True,
 ):
     """Multi-service :func:`solve_windows` with the packed int32 output
     (window tensors donated — see :func:`solve_windows_packed`).
@@ -619,6 +627,7 @@ def solve_windows_fleet(
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs, precision=precision,
+        pallas=pallas,
     )
     return _pack_solver_outputs(*outs[:4]), outs[4]
 
@@ -695,7 +704,7 @@ def refit_fleet_params(assign0, in_start, in_end, in_valid,
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
                                    "sinkhorn_tol", "max_preds", "max_succs",
-                                   "precision"),
+                                   "precision", "pallas"),
          donate_argnums=tuple(range(8)))
 def solve_em_fleet(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
@@ -708,6 +717,7 @@ def solve_em_fleet(
     sinkhorn_tol: float = 0.0,
     max_preds: int = 0, max_succs: int = 0,
     precision: str = "f32",
+    pallas: bool = True,
 ):
     """Both EM iterations for a whole service fleet in ONE dispatch.
 
@@ -735,6 +745,7 @@ def solve_em_fleet(
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs, precision=precision,
+        pallas=pallas,
     )
 
     tables = _fleet_refit_tables(
@@ -750,6 +761,7 @@ def solve_em_fleet(
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs, precision=precision,
+        pallas=pallas,
     )
 
 

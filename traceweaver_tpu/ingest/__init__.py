@@ -2,6 +2,7 @@
 
 from traceweaver_tpu.ingest.jaeger import (  # noqa: F401
     FIX_ROOT_OPS,
+    MalformedSpan,
     load_corpus,
     parse_trace_file,
     time_ordered_trace_files,

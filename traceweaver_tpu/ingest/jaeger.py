@@ -53,6 +53,15 @@ def _random_id(n: int = 16, suffix: str = "") -> str:
     return "".join(random.choice(alphabet) for _ in range(n)) + suffix
 
 
+class MalformedSpan(ValueError):
+    """A span record that cannot be parsed (missing ids/timestamps/refs,
+    non-numeric durations). By default malformed records are
+    skipped-and-counted (``ingest_malformed_spans`` on the store — a
+    dead-letter counter, so a flaky exporter cannot abort a whole corpus
+    load mid-stream); ``strict=True`` (the CLI's ``--strict``) restores
+    the raise."""
+
+
 # ---------------------------------------------------------------------------
 # Directory listing, time-ordered (reference executor.py:287-339)
 # ---------------------------------------------------------------------------
@@ -261,17 +270,34 @@ def _record_from_json(rec: dict) -> RawSpan:
     for tag in rec.get("tags", []):
         if tag.get("key") == "span.kind":
             span_kind = tag.get("value")
-    refs = tuple(
-        (ref["traceID"], ref["spanID"]) for ref in rec.get("references", [])
-    )
+    try:
+        refs = tuple(
+            (ref["traceID"], ref["spanID"])
+            for ref in rec.get("references", [])
+        )
+        trace_id = rec["traceID"]
+        sid = rec["spanID"]
+        start_mus = rec["startTime"]
+        duration_mus = rec["duration"]
+        process_id = rec["processID"]
+    except (KeyError, TypeError) as e:
+        raise MalformedSpan(
+            f"span record missing required field: {e}") from None
+    try:
+        float(start_mus)
+        float(duration_mus)
+    except (TypeError, ValueError):
+        raise MalformedSpan(
+            f"span {sid!r}: non-numeric startTime/duration "
+            f"({start_mus!r}, {duration_mus!r})") from None
     return RawSpan(
-        trace_id=rec["traceID"],
-        sid=rec["spanID"],
-        start_mus=rec["startTime"],
-        duration_mus=rec["duration"],
+        trace_id=trace_id,
+        sid=sid,
+        start_mus=start_mus,
+        duration_mus=duration_mus,
         op_name=rec.get("requestType", rec.get("operationName")),
         refs=refs,
-        process_id=rec["processID"],
+        process_id=process_id,
         span_kind=span_kind,
         caller=rec.get("caller"),
         callee=rec.get("callee"),
@@ -324,9 +350,16 @@ def parse_trace_file(
     fix: int,
     self_loop_map: Dict[str, List[str]],
     service_loop_map: Dict[str, str],
+    strict: bool = False,
+    counters: Optional[Dict[str, int]] = None,
 ) -> Optional[Tuple[str, Dict[SpanId, Span], Dict[str, str]]]:
     """Parse one trace file. Returns (trace_id, spans, processes) or None
     if the trace was dropped (time-containment violation in Alibaba mode).
+
+    Malformed span records (missing ids/refs/timestamps, non-numeric
+    durations) are skipped and counted under ``counters["malformed_spans"]``
+    — a dead-letter counter, never a mid-stream crash; ``strict=True``
+    restores the raise (the CLI's ``--strict``).
     """
     with open(path, "r") as f:
         payload = json.load(f)
@@ -335,7 +368,16 @@ def parse_trace_file(
     processes: Dict[str, str] = {}
     for trace_json in payload["data"]:
         trace_id = trace_json["traceID"]
-        records = [_record_from_json(rec) for rec in trace_json["spans"]]
+        records = []
+        for rec in trace_json["spans"]:
+            try:
+                records.append(_record_from_json(rec))
+            except MalformedSpan:
+                if strict:
+                    raise
+                if counters is not None:
+                    counters["malformed_spans"] = (
+                        counters.get("malformed_spans", 0) + 1)
         raw_processes = {
             pid: entry["serviceName"]
             for pid, entry in trace_json.get("processes", {}).items()
@@ -411,6 +453,8 @@ def _native_file_traces(
     fix: int,
     self_loop_map: Dict[str, List[str]],
     service_loop_map: Dict[str, str],
+    strict: bool = False,
+    counters: Optional[Dict[str, int]] = None,
 ):
     """Yield ``(trace_id, spans, processes)`` per input file of a native
     corpus — same semantics as :func:`parse_trace_file` (including the
@@ -438,11 +482,16 @@ def _native_file_traces(
                 op = int(nc.op[i])
                 pidx = int(nc.process[i])
                 if pidx < 0:
-                    # Match the Python front-end, which raises KeyError on a
-                    # span without a processID.
-                    raise KeyError(
-                        f"span {strings[nc.sid[i]]!r} has no processID"
-                    )
+                    # Match the Python front-end: skip-and-count the
+                    # malformed record (raise under --strict).
+                    if strict:
+                        raise MalformedSpan(
+                            f"span {strings[nc.sid[i]]!r} has no processID"
+                        )
+                    if counters is not None:
+                        counters["malformed_spans"] = (
+                            counters.get("malformed_spans", 0) + 1)
+                    continue
                 kind = int(nc.kind[i])
                 caller = int(nc.caller[i])
                 callee = int(nc.callee[i])
@@ -483,6 +532,7 @@ def load_corpus(
     cache: bool = True,
     write_cache: bool = False,
     native: str = "auto",
+    strict: bool = False,
 ) -> TraceStore:
     """Load a directory of Jaeger-JSON traces into a TraceStore.
 
@@ -491,8 +541,13 @@ def load_corpus(
 
     ``native``: "auto" uses the C++ streaming loader when available,
     "never" forces the pure-Python parser. Both produce identical stores.
+
+    ``strict``: malformed span records raise (:class:`MalformedSpan`)
+    instead of the default skip-and-count; either way the dead-letter
+    count lands on ``store.ingest_malformed_spans``.
     """
     store = TraceStore()
+    counters = store.ingest_counters
     self_loop_map: Dict[str, List[str]] = {}
     files = time_ordered_trace_files(directory, clear_cache=clear_cache,
                                      cache=cache, write_cache=write_cache)
@@ -513,7 +568,8 @@ def load_corpus(
                 break
             chunk_start += size
             for parsed in _native_file_traces(
-                nc, fix, self_loop_map, store.service_loop_map
+                nc, fix, self_loop_map, store.service_loop_map,
+                strict=strict, counters=counters,
             ):
                 if parsed is None:
                     continue
@@ -525,7 +581,8 @@ def load_corpus(
             return store
     for path in files:
         parsed = parse_trace_file(path, fix, self_loop_map,
-                                  store.service_loop_map)
+                                  store.service_loop_map,
+                                  strict=strict, counters=counters)
         if parsed is None:
             continue
         trace_id, spans, processes = parsed

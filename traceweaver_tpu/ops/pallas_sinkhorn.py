@@ -399,7 +399,7 @@ def assign_topk_jnp(S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap,
 
 def assign_topk(S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap,
                 n_rows: int, *, epsilon: float, n_iters: int, tol: float,
-                topk: int, min_topk_mass: float):
+                topk: int, min_topk_mass: float, allow_pallas: bool = True):
     """Backend-dispatching fused assignment: one persistent-sweep kernel on
     TPU (score block, potentials, plan, and the rounding state all
     VMEM-resident for the block's whole device lifetime), the jnp
@@ -408,10 +408,17 @@ def assign_topk(S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap,
     forces, platform selection happens at lowering time. ``TW_PALLAS_FUSED=0``
     keeps the plain per-stage Pallas dispatch (kill switch: the Sinkhorn
     kernel still runs fused-per-stage, only the cross-stage fusion is off).
+
+    ``allow_pallas=False`` pins the XLA composition unconditionally —
+    the solve supervisor's degradation rung: a dispatch whose fused
+    kernel keeps dying retries as a distinct Pallas-free program (it is
+    a *static* solver argument, so the variant gets its own jit cache
+    entry instead of re-hitting the cached kernel program).
     """
     n, m = S_ot.shape
     fused_ok = os.environ.get("TW_PALLAS_FUSED", "1") not in ("0", "false", "")
-    if (not fused_ok or not use_pallas() or n * m < 64 * 128
+    if (not allow_pallas or not fused_ok or not use_pallas()
+            or n * m < 64 * 128
             or not fits_pallas_vmem(n, m, jnp.dtype(S_ot.dtype).itemsize)):
         return assign_topk_jnp(
             S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap, n_rows,

@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated list of algorithm indices to run")
     p.add_argument("--max_traces", type=int, default=1000,
                    help="trace ingestion cap (reference hardcodes 1000)")
+    p.add_argument("--strict", type=int, default=0, choices=[0, 1],
+                   help="malformed span records raise instead of the "
+                        "default skip-and-count dead-letter behavior")
     return p
 
 
@@ -116,6 +119,19 @@ def build_stream_parser() -> argparse.ArgumentParser:
                    help="emitted windows between checkpoints")
     p.add_argument("--resume", action="store_true",
                    help="resume from --checkpoint instead of starting over")
+    p.add_argument("--deadletter", default=None,
+                   help="dead-letter JSONL sidecar for poison windows "
+                        "(default: <out>.deadletter.jsonl when --out is "
+                        "set); quarantined windows are recorded here, "
+                        "never silently dropped")
+    p.add_argument("--watchdog_s", type=float, default=None,
+                   help="micro-batch solve watchdog timeout (seconds); "
+                        "a timed-out batch retries, then dead-letters")
+    p.add_argument("--solve_retries", type=int, default=1,
+                   help="micro-batch retry budget past the first attempt")
+    p.add_argument("--strict", action="store_true",
+                   help="malformed span records raise at ingest instead "
+                        "of the default skip-and-count")
     p.add_argument("--no_warm", action="store_true",
                    help="disable carried-state warm start (two-pass EM "
                         "per window, the batch executor's shape)")
@@ -143,7 +159,7 @@ def stream_main(argv) -> int:
         return 2
     source = parse_source_spec(
         args.source, fix=args.fix, max_traces=args.max_traces,
-        ooo_us=args.ooo_ms * 1000.0)
+        ooo_us=args.ooo_ms * 1000.0, strict=args.strict)
     cfg = StreamConfig(
         window_us=args.window_s * 1e6,
         overlap_us=args.overlap_s * 1e6,
@@ -155,6 +171,9 @@ def stream_main(argv) -> int:
         grade=not args.no_grade,
         checkpoint_path=args.checkpoint,
         checkpoint_every=args.checkpoint_every,
+        deadletter_path=args.deadletter,
+        solve_watchdog_s=args.watchdog_s,
+        solve_retries=args.solve_retries,
     )
     sink = TraceSink(args.out) if args.out else None
     if args.resume:
@@ -180,6 +199,24 @@ def stream_main(argv) -> int:
           % (int(fleet.get("backend_compiles", 0)),
              int(fleet.get("persistent_cache_hits", 0)),
              int(fleet.get("persistent_cache_misses", 0))))
+    # robustness ledger: only printed when the supervisor / dead-letter /
+    # integrity machinery actually engaged, so a clean run stays clean
+    fl = summary.get("faults", {})
+    if any(fl.values()) or summary.get("deadletter_windows"):
+        print("[stream] faults: %d injected, %d retries, %d bisections, "
+              "%d xla fallbacks, %d host fallbacks, %d quarantined; "
+              "%d solve timeouts / %d batch retries; "
+              "%d checkpoint failures / %d recovered; "
+              "dead-letter %d windows (%d spans, %d bytes)"
+              % (fl.get("injected", 0), fl.get("retries", 0),
+                 fl.get("bisections", 0), fl.get("xla_fallbacks", 0),
+                 fl.get("host_fallbacks", 0), fl.get("quarantined", 0),
+                 fl.get("solve_timeouts", 0), fl.get("solve_retried", 0),
+                 fl.get("checkpoint_failures", 0),
+                 fl.get("checkpoint_recovered", 0),
+                 summary.get("deadletter_windows", 0),
+                 summary.get("deadletter_spans", 0),
+                 summary.get("deadletter_bytes", 0)))
     streamed_acc = None
     if "accuracy" in summary:
         streamed_acc = summary["accuracy"]["e2e"]
@@ -205,6 +242,11 @@ def stream_main(argv) -> int:
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
+    # startup knob hygiene: a misspelled TW_* env var is a warning, not a
+    # silent no-op (runtime/knobs.py holds the registry)
+    from traceweaver_tpu.runtime import knobs
+
+    knobs.warn_unknown()
     if argv and argv[0] == "stream":
         # online mode rides its own subcommand; the bare flag surface
         # below stays byte-compatible with the reference executor CLI
@@ -296,6 +338,7 @@ def main(argv=None) -> int:
         compressed=bool(args.compressed),
         predictor_indices=indices,
         max_traces=args.max_traces,
+        strict_ingest=bool(args.strict),
         service_to_replica=replica_table,
         # multi-chip: TW_MESH_DEVICES=N shards solver window batches over
         # an N-device 1-D mesh (XLA SPMD; see parallel/mesh.py). Env, not

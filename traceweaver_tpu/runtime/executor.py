@@ -90,6 +90,9 @@ class ExecutorConfig:
     gt_free_dag: bool = False
     predictor_indices: List[int] = field(default_factory=list)
     max_traces: int = 1000
+    # --strict: malformed span records raise at ingest instead of the
+    # default skip-and-count dead-letter behavior (ingest/jaeger.py)
+    strict_ingest: bool = False
     # replica table for compress-factor scaling; absent in the reference
     # release (SURVEY.md §6 artifact gap) so defaults to 1 replica per service
     service_to_replica: Optional[Dict[str, list]] = None
@@ -295,6 +298,17 @@ def _solve_fleet_method(cfg: ExecutorConfig, store: TraceStore, method: str,
               "(TW_PIPELINE=0 restores the serial flow)"
               % (method, int(fleet_stats["pipeline_groups"]),
                  int(fleet_stats.get("pipeline_depth", 0))))
+    if fleet_stats.get("fault_retries") or fleet_stats.get("fault_quarantined"):
+        # the solve survived real (or injected) device faults — say how
+        # far down the degradation ladder it had to walk
+        print("[fleet] %s: solve supervisor engaged — %d retries, "
+              "%d bisections, %d XLA fallbacks, %d host fallbacks, "
+              "%d QUARANTINED (docs/ROBUSTNESS.md)"
+              % (method, int(fleet_stats.get("fault_retries", 0)),
+                 int(fleet_stats.get("fault_bisections", 0)),
+                 int(fleet_stats.get("fault_xla_fallbacks", 0)),
+                 int(fleet_stats.get("fault_host_fallbacks", 0)),
+                 int(fleet_stats.get("fault_quarantined", 0))))
     # per-service seconds = share of the dispatch wall-clock proportional
     # to each service's padded compute cells at its own shape class — the
     # quantity the device spends time on (the same attribution model the
@@ -344,7 +358,13 @@ def run_experiment(cfg: ExecutorConfig,
         if cfg.compressed:
             maybe_uncompress(cfg.data_path)
         store = load_corpus(cfg.data_path, cfg.fix, max_traces=cfg.max_traces,
-                            clear_cache=cfg.clear_cache)
+                            clear_cache=cfg.clear_cache,
+                            strict=cfg.strict_ingest)
+    malformed = getattr(store, "ingest_malformed_spans", 0)
+    if malformed:
+        print("[ingest] WARNING: %d malformed span record(s) skipped and "
+              "dead-lettered (run with --strict to raise instead)"
+              % malformed)
 
     from traceweaver_tpu.algorithms.weaver_tpu import WeaverTPU
 

@@ -1,0 +1,243 @@
+"""Deterministic fault injection for the solve supervisor (``TW_FAULTS``).
+
+The reconstructor's value proposition — reconstruction without touching
+the application — only holds if the reconstructor itself survives
+production conditions: a transient ``XlaRuntimeError`` or
+``RESOURCE_EXHAUSTED`` inside a fused fleet dispatch must degrade, not
+abort the solve, and a truncated checkpoint must resume from the
+previous one, not crash the stream. This module is the *test stimulus*
+for that machinery: a seeded, spec-driven injector whose failure draws
+are woven into the real production code paths (device dispatch, D2H
+fetches, the per-service host fallback, checkpoint I/O, source reads),
+so the degradation ladder in :mod:`traceweaver_tpu.algorithms.fleet`
+and the stream's dead-letter/integrity consumers can be exercised
+deterministically on any backend — chaos testing without a chaotic
+environment.
+
+Spec grammar (``TW_FAULTS``)::
+
+    TW_FAULTS="dispatch:0.2,fetch:0.05"          # site:probability
+    TW_FAULTS="dispatch:1.0:max=3"               # cap injections per site
+    TW_FAULTS_SEED=7                             # RNG seed (default 0)
+
+Sites (anything else raises — the ops/precision.py raise-on-typo rule):
+
+- ``dispatch``   — fused fleet device dispatch (fleet supervisor);
+- ``fetch``      — blocking D2H fetches (``fleet._fetch``);
+- ``host``       — the per-service host-fallback solve (the ladder's
+  last compute rung; injecting here is how tests force quarantine);
+- ``checkpoint`` — checkpoint save/load I/O (``stream/checkpoint.py``);
+- ``source``     — span-source reads (``stream/service.py`` run loop).
+
+Determinism: one seeded RNG shared across sites, so a given
+``(spec, seed)`` produces one fixed draw sequence. Under the pipelined
+dispatcher several threads draw concurrently and the *interleaving* may
+vary run to run; tests that need exact reproducibility pin
+``TW_PIPELINE=0`` or use probability 0/1. With ``TW_FAULTS`` unset every
+hook is a no-op returning immediately — the default solve runs the
+HEAD program bit-identically (pinned by ``tests/test_faults.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+#: every legal injection site, in ladder order of first appearance
+SITES = ("dispatch", "fetch", "host", "checkpoint", "source")
+
+
+class FaultError(RuntimeError):
+    """An injected fault (stands in for ``XlaRuntimeError`` and friends).
+
+    Raised by :func:`maybe_fail`; classified as a device/transient fault
+    by :func:`is_transient_fault`, so it walks the same supervisor
+    ladder a real runtime error would."""
+
+
+class FaultPlan:
+    """One parsed ``TW_FAULTS`` spec plus its live injection state."""
+
+    def __init__(self, sites: Dict[str, "SiteSpec"], seed: int = 0) -> None:
+        self.sites = sites
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.injected = {s: 0 for s in sites}
+        self.draws = {s: 0 for s in sites}
+
+    def should_fail(self, site: str) -> bool:
+        spec = self.sites.get(site)
+        if spec is None:
+            return False
+        with self._lock:
+            self.draws[site] += 1
+            if spec.max is not None and self.injected[site] >= spec.max:
+                return False
+            if self._rng.random() < spec.p:
+                self.injected[site] += 1
+                return True
+        return False
+
+    def total_injected(self) -> int:
+        with self._lock:
+            return sum(self.injected.values())
+
+
+class SiteSpec:
+    __slots__ = ("p", "max")
+
+    def __init__(self, p: float, max: Optional[int] = None) -> None:
+        self.p = p
+        self.max = max
+
+
+def parse_faults(spec: str, seed: int = 0) -> Optional[FaultPlan]:
+    """Parse a ``TW_FAULTS`` spec string into a :class:`FaultPlan`.
+
+    Empty/blank specs mean "no injection" (None). Unknown sites, bad
+    probabilities, and malformed options raise ``ValueError`` — a typo'd
+    chaos spec must fail loudly, never silently run an unfaulted solve
+    that then "passes" the chaos leg.
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    sites: Dict[str, SiteSpec] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"TW_FAULTS entry {entry!r}: expected site:probability")
+        site = parts[0].strip()
+        if site not in SITES:
+            raise ValueError(
+                f"TW_FAULTS entry {entry!r}: unknown site {site!r}; "
+                f"expected one of {SITES}")
+        try:
+            p = float(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"TW_FAULTS entry {entry!r}: probability {parts[1]!r} "
+                "is not a number") from None
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(
+                f"TW_FAULTS entry {entry!r}: probability {p} not in [0, 1]")
+        max_n: Optional[int] = None
+        for opt in parts[2:]:
+            key, _, val = opt.partition("=")
+            if key.strip() != "max":
+                raise ValueError(
+                    f"TW_FAULTS entry {entry!r}: unknown option {opt!r}; "
+                    "expected max=N")
+            try:
+                max_n = int(val)
+            except ValueError:
+                raise ValueError(
+                    f"TW_FAULTS entry {entry!r}: max={val!r} is not an "
+                    "integer") from None
+            if max_n < 0:
+                raise ValueError(
+                    f"TW_FAULTS entry {entry!r}: max must be >= 0")
+        if site in sites:
+            raise ValueError(f"TW_FAULTS: duplicate site {site!r}")
+        sites[site] = SiteSpec(p, max_n)
+    return FaultPlan(sites, seed=seed)
+
+
+# the active plan is cached per (spec, seed) env value so injection state
+# (RNG sequence, per-site counters) persists across calls within one run;
+# changing the env (tests: monkeypatch) transparently rebuilds it
+_ACTIVE: Optional[FaultPlan] = None
+_ACTIVE_KEY: Optional[tuple] = None
+_OVERRIDE: Optional[FaultPlan] = None
+_STATE_LOCK = threading.Lock()
+
+
+def active() -> Optional[FaultPlan]:
+    """The live fault plan: a programmatic :func:`override` if one is in
+    force, else the (cached) ``TW_FAULTS``/``TW_FAULTS_SEED`` env plan,
+    else None. Read at call time, like every other ``TW_*`` knob."""
+    global _ACTIVE, _ACTIVE_KEY
+    if _OVERRIDE is not None:
+        return _OVERRIDE
+    spec = os.environ.get("TW_FAULTS", "")
+    seed_raw = os.environ.get("TW_FAULTS_SEED", "0")
+    try:
+        seed = int(seed_raw)
+    except ValueError:
+        raise ValueError(
+            f"TW_FAULTS_SEED={seed_raw!r} is not an integer") from None
+    key = (spec, seed)
+    with _STATE_LOCK:
+        if key != _ACTIVE_KEY:
+            _ACTIVE = parse_faults(spec, seed=seed)
+            _ACTIVE_KEY = key
+        return _ACTIVE
+
+
+def reset() -> None:
+    """Drop all injection state (tests: a fresh plan re-seeds the RNG)."""
+    global _ACTIVE, _ACTIVE_KEY, _OVERRIDE
+    with _STATE_LOCK:
+        _ACTIVE = None
+        _ACTIVE_KEY = None
+        _OVERRIDE = None
+
+
+@contextmanager
+def override(spec: str, seed: int = 0):
+    """Force a fault plan for the duration of the context, regardless of
+    the env (the bench chaos leg uses this so one process can run a
+    faulted and an unfaulted leg side by side). Yields the plan so the
+    caller can read its injection counters afterwards."""
+    global _OVERRIDE
+    plan = parse_faults(spec, seed=seed)
+    prev = _OVERRIDE
+    _OVERRIDE = plan
+    try:
+        yield plan
+    finally:
+        _OVERRIDE = prev
+
+
+def maybe_fail(site: str) -> None:
+    """Raise :class:`FaultError` if the active plan draws a failure for
+    ``site``. No-op (one dict lookup) when no plan is active — the
+    TW_FAULTS-unset production path stays bit-identical to HEAD."""
+    plan = active()
+    if plan is not None and plan.should_fail(site):
+        raise FaultError(f"injected fault at site {site!r} "
+                         f"(#{plan.injected[site]}, seed {plan.seed})")
+
+
+# message fragments that mark a *transient* runtime failure — the kinds a
+# retry/degrade ladder can meaningfully absorb (OOM, preemption, relay
+# flake), per the jax/XLA status taxonomy
+_TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "DATA_LOSS",
+                      "INTERNAL", "ABORTED", "DEADLINE_EXCEEDED",
+                      "CANCELLED")
+
+
+def is_transient_fault(exc: BaseException) -> bool:
+    """Should the solve supervisor walk its degradation ladder for this
+    exception? True for injected faults, ``XlaRuntimeError`` (any
+    status — a device program that died is retryable by redispatch), and
+    runtime/OS errors carrying a transient XLA status marker. Everything
+    else (TypeError, ValueError, assertion failures ...) is a *bug* and
+    must propagate unchanged — retrying a deterministic error would loop
+    the ladder for nothing and bury the traceback."""
+    if isinstance(exc, FaultError):
+        return True
+    if type(exc).__name__ == "XlaRuntimeError":
+        return True
+    if isinstance(exc, (RuntimeError, OSError)):
+        msg = str(exc)
+        return any(marker in msg for marker in _TRANSIENT_MARKERS)
+    return False

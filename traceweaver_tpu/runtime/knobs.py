@@ -1,0 +1,201 @@
+"""Central registry of every ``TW_*`` environment knob.
+
+Generalizes the ``ops/precision.py`` rule — a typo'd ``TW_PRECISION``
+raises instead of silently running f32 — to the whole knob surface:
+
+- every knob is declared ONCE here, with its type, default, and legal
+  range, so readers (:func:`get_int` & friends) share one parse/validate
+  path: an unparseable value raises :class:`KnobError` loudly instead of
+  silently falling back to the default, and out-of-range values clamp to
+  the declared bounds (the bound is the knob's contract, e.g. "at least
+  one decode worker");
+- :func:`warn_unknown` scans the environment for ``TW_*`` names the
+  registry does not know and reports them at startup — a misspelled
+  ``TW_PIPLINE=0`` stops being a silently-ignored no-op.
+
+Values are read from the environment at *call* time (test fixtures and
+launchers export after import), same discipline as ``precision_from_env``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+
+class KnobError(ValueError):
+    """An unparseable ``TW_*`` value (the raise-on-typo rule)."""
+
+
+class Knob:
+    __slots__ = ("name", "type", "default", "lo", "hi", "choices", "help")
+
+    def __init__(self, name: str, type: str, default, lo=None, hi=None,
+                 choices=None, help: str = "") -> None:
+        self.name = name
+        self.type = type          # "int" | "float" | "bool" | "str" | "enum"
+        self.default = default
+        self.lo = lo
+        self.hi = hi
+        self.choices = choices
+        self.help = help
+
+
+def _k(*args, **kw) -> Knob:
+    return Knob(*args, **kw)
+
+
+#: the registry: one declaration per knob. docs/ROBUSTNESS.md renders the
+#: operator-facing table from the same facts.
+REGISTRY: Dict[str, Knob] = {k.name: k for k in [
+    # --- solver/fleet ----------------------------------------------------
+    _k("TW_PIPELINE", "bool", True,
+       help="0 kills the pipelined fleet dispatcher (serial flow)"),
+    _k("TW_COMPACT", "bool", True,
+       help="0 disables convergence compaction"),
+    _k("TW_SWEEP_WARM", "int", 2, lo=1,
+       help="warm sweeps before the compaction redispatch"),
+    _k("TW_DECODE_WORKERS", "int", 2, lo=1,
+       help="pipeline flow/decode worker count"),
+    _k("TW_FLEET_BUDGET", "int", 1 << 28, lo=1,
+       help="live-dispatch budget (f32-element-denominated)"),
+    _k("TW_FLEET_MERGE", "int", None, lo=0,
+       help="shape-class merge budget override (0 = never merge)"),
+    _k("TW_PRECISION", "enum", "f32", choices=("f32", "bf16"),
+       help="score-block storage precision (ops/precision.py validates)"),
+    _k("TW_SCORE_GEMM", "str", None, help="score GEMM path override"),
+    _k("TW_JAX_GMM", "str", None, help="GMM refit path override"),
+    # --- Pallas ----------------------------------------------------------
+    _k("TW_PALLAS", "bool", None,
+       help="force the Pallas kernels on/off (default: on real TPU)"),
+    _k("TW_PALLAS_INTERPRET", "bool", False,
+       help="run Pallas kernels in interpret mode (off-TPU testing)"),
+    _k("TW_PALLAS_FUSED", "bool", True,
+       help="0 keeps Pallas per-stage (no cross-stage fusion)"),
+    _k("TW_PALLAS_VMEM_CAP", "int", 96 << 20, lo=1,
+       help="scoped-VMEM admission budget (clamped to v5e 128MB/core)"),
+    # --- runtime/backends ------------------------------------------------
+    _k("TW_BACKEND", "str", "cpu", help="CLI backend selection (cpu|axon|tpu)"),
+    _k("TW_MESH_DEVICES", "int", 0, lo=0,
+       help="1-D mesh size (0 = single device; must be a power of two)"),
+    _k("TW_GT_FREE_DAG", "bool", False,
+       help="ground-truth-free invocation-DAG discovery"),
+    _k("TW_JAX_CACHE", "bool", True, help="persistent XLA compile cache"),
+    _k("TW_JAX_CACHE_DIR", "str", None, help="compile cache location"),
+    _k("TW_DISABLE_NATIVE", "bool", False,
+       help="force the pure-Python ingest parser"),
+    # --- faults / robustness (this PR) -----------------------------------
+    _k("TW_FAULTS", "str", None,
+       help="fault-injection spec, e.g. dispatch:0.2,fetch:0.05 "
+            "(runtime/faults.py validates sites and probabilities)"),
+    _k("TW_FAULTS_SEED", "int", 0, help="fault-injection RNG seed"),
+    _k("TW_RETRY_MAX", "int", 2, lo=0, hi=16,
+       help="bounded redispatch retries before the ladder bisects"),
+    _k("TW_RETRY_BACKOFF_S", "float", 0.02, lo=0.0, hi=30.0,
+       help="base of the exponential retry backoff (seconds)"),
+    # --- bench orchestration ---------------------------------------------
+    _k("TW_BENCH_SUBSET", "int", 25, lo=1, help="subset spans per service"),
+    _k("TW_BENCH_EXACT_ALARM", "int", 95, lo=1,
+       help="per-service alarm for exact-path solves (s)"),
+    _k("TW_BENCH_DEADLINE", "int", 780, lo=1, help="whole-bench envelope (s)"),
+    _k("TW_BENCH_BACKEND_UP", "int", 120, lo=1,
+       help="backend-init down-detection gate (s)"),
+    _k("TW_BENCH_CPU_RESERVE", "int", 170, lo=0,
+       help="budget held back for the CPU fallback leg (s)"),
+    _k("TW_BENCH_BASELINE_RESERVE", "int", 110, lo=0,
+       help="budget held back for the baseline leg (s)"),
+    _k("TW_BENCH_TPU_TIMEOUT", "int", 480, lo=1,
+       help="TPU solver child phase cap (s)"),
+    _k("TW_BENCH_BASELINE_BUDGET", "float", 110.0, lo=0.0,
+       help="baseline child solve budget (s)"),
+    _k("TW_BENCH_CPU_FULL_NEEDS", "int", None, lo=0,
+       help="full-workload CPU leg cost estimate (s)"),
+    _k("TW_BENCH_CPU_RETRY_RESERVE", "int", 130, lo=0,
+       help="reduced-retry reserve under the full CPU leg (s)"),
+    _k("TW_BENCH_APPS", "str", None, help="restrict bench apps (smoke)"),
+    _k("TW_BENCH_MAX_TRACES", "int", 1000, lo=1,
+       help="bench corpus cap (smoke)"),
+    _k("TW_BENCH_RECORD", "str", None,
+       help="write a fresh exact-path recording here"),
+    _k("TW_BENCH_PROFILE_DIR", "str", None, help="keep the xplane trace"),
+    _k("TW_BENCH_PROFILE_JSON", "str", None, help="profile summary sidecar"),
+    _k("TW_BENCH_FAULTS", "str", None,
+       help="chaos-leg fault spec for bench --faults (default dispatch:0.2)"),
+]}
+
+
+_TRUTHY_FALSE = ("0", "false", "")
+
+
+def _parse(knob: Knob, raw: str):
+    if knob.type == "bool":
+        return raw not in _TRUTHY_FALSE
+    if knob.type == "int":
+        try:
+            val = int(raw)
+        except ValueError:
+            raise KnobError(
+                f"{knob.name}={raw!r} is not an integer") from None
+    elif knob.type == "float":
+        try:
+            val = float(raw)
+        except ValueError:
+            raise KnobError(
+                f"{knob.name}={raw!r} is not a number") from None
+    elif knob.type == "enum":
+        if raw not in knob.choices:
+            raise KnobError(
+                f"{knob.name}={raw!r}: expected one of {knob.choices}")
+        return raw
+    else:
+        return raw
+    if knob.lo is not None:
+        val = max(knob.lo, val)
+    if knob.hi is not None:
+        val = min(knob.hi, val)
+    return val
+
+
+def get(name: str):
+    """Read one registered knob from the env: parsed, validated (raises
+    :class:`KnobError` on a typo'd value), clamped to its declared range;
+    the declared default when unset."""
+    knob = REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return knob.default
+    return _parse(knob, raw)
+
+
+def get_int(name: str) -> int:
+    return get(name)
+
+
+def get_float(name: str) -> float:
+    return get(name)
+
+
+def get_bool(name: str) -> bool:
+    return get(name)
+
+
+def unknown_knobs(environ: Optional[Dict[str, str]] = None) -> List[str]:
+    """Every ``TW_*`` name present in the environment but absent from the
+    registry — i.e. knobs that would be silently ignored."""
+    env = os.environ if environ is None else environ
+    return sorted(name for name in env
+                  if name.startswith("TW_") and name not in REGISTRY)
+
+
+def warn_unknown(printer=None) -> List[str]:
+    """Startup hygiene: report unknown ``TW_*`` env vars (default: to
+    stderr). Returns the offending names so callers/tests can assert."""
+    import sys
+
+    names = unknown_knobs()
+    if names:
+        msg = ("[knobs] WARNING: unknown TW_* environment variable(s) "
+               "ignored: %s — known knobs are declared in "
+               "traceweaver_tpu/runtime/knobs.py" % ", ".join(names))
+        (printer or (lambda m: print(m, file=sys.stderr)))(msg)
+    return names

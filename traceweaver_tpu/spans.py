@@ -166,6 +166,14 @@ class TraceStore:
         self.out_spans_by_process: Dict[str, List[Span]] = {}
         # synthetic "-loop" service -> original service (Alibaba self-calls)
         self.service_loop_map: Dict[str, str] = {}
+        # ingestion dead-letter counters (ingest/jaeger.py bumps these:
+        # malformed records are skipped-and-counted, never silently lost)
+        self.ingest_counters: Dict[str, int] = {}
+
+    @property
+    def ingest_malformed_spans(self) -> int:
+        """Span records dropped as malformed during ingestion."""
+        return self.ingest_counters.get("malformed_spans", 0)
 
     def services(self) -> List[str]:
         return list(self.out_spans_by_process.keys())

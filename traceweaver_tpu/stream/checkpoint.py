@@ -17,6 +17,21 @@ Resume contract (tested by ``tests/test_stream.py``):
   byte-identically, so the final emitted trace set equals the
   uninterrupted run's exactly: no loss, no double-emit.
 
+Integrity contract (version 2, tested by ``tests/test_faults.py``):
+
+- every checkpoint carries a CRC32 trailer (``MAGIC + crc32 + length``
+  over the pickle payload), so truncation and bit rot are DETECTED at
+  load instead of surfacing as an unpickling crash or, worse, silently
+  corrupt state;
+- ``save_checkpoint`` rotates the previous checkpoint to ``path.prev``
+  before replacing, so there is always a last-known-good file;
+- ``load_checkpoint`` falls back to ``path.prev`` when the primary is
+  corrupt or truncated — counted and warned (the returned state carries
+  ``_recovered_from_prev``), never silent, and only *fatal* when both
+  generations are unreadable;
+- version-1 checkpoints (no trailer) are still readable, so a deployed
+  service upgrades in place.
+
 Everything in the state dict is plain pickle material (Span dataclasses,
 numpy arrays inside EdgeDists, networkx-free); sharing is preserved
 because the whole dict rides one pickle (the live store's span objects
@@ -27,30 +42,109 @@ from __future__ import annotations
 
 import os
 import pickle
+import struct
+import sys
+import zlib
 from typing import Dict
 
-CHECKPOINT_VERSION = 1
+CHECKPOINT_VERSION = 2
+
+#: trailer = MAGIC + u32 crc32(payload) + u64 len(payload), little-endian
+_MAGIC = b"TWCK"
+_TRAILER = struct.Struct("<4sIQ")
+
+
+class CheckpointCorrupt(ValueError):
+    """The checkpoint file failed its integrity check (bad CRC, short
+    payload, or unreadable pickle) and no fallback generation worked."""
+
+
+def _maybe_fail(site: str) -> None:
+    # lazy import: checkpoint.py stays importable without pulling the
+    # runtime package (and jax) in at module-import time
+    from traceweaver_tpu.runtime import faults
+
+    faults.maybe_fail(site)
 
 
 def save_checkpoint(path: str, state: Dict) -> None:
-    """Atomic write: pickle to a sibling temp file, fsync, rename."""
-    payload = dict(state)
-    payload["version"] = CHECKPOINT_VERSION
+    """Atomic write with integrity trailer and keep-last-good rotation:
+    pickle to a sibling temp file, append the CRC trailer, fsync, rotate
+    the current checkpoint to ``path.prev``, rename into place."""
+    _maybe_fail("checkpoint")
+    payload_dict = dict(state)
+    payload_dict["version"] = CHECKPOINT_VERSION
+    payload = pickle.dumps(payload_dict, protocol=pickle.HIGHEST_PROTOCOL)
+    trailer = _TRAILER.pack(_MAGIC, zlib.crc32(payload), len(payload))
     tmp = path + ".tmp"
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(tmp, "wb") as f:
-        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.write(payload)
+        f.write(trailer)
         f.flush()
         os.fsync(f.fileno())
+    if os.path.exists(path):
+        # keep-last-good: the generation being replaced becomes .prev so
+        # a corrupt/truncated primary never strands the service
+        os.replace(path, path + ".prev")
     os.replace(tmp, path)
 
 
-def load_checkpoint(path: str) -> Dict:
+def _load_one(path: str) -> Dict:
+    """Read + verify one checkpoint file (v2 trailer or bare v1 pickle).
+    Raises :class:`CheckpointCorrupt` on any integrity failure."""
     with open(path, "rb") as f:
-        state = pickle.load(f)
+        raw = f.read()
+    if len(raw) >= _TRAILER.size and raw[-_TRAILER.size:][:4] == _MAGIC:
+        magic, crc, length = _TRAILER.unpack(raw[-_TRAILER.size:])
+        payload = raw[:-_TRAILER.size]
+        if length != len(payload):
+            raise CheckpointCorrupt(
+                f"checkpoint {path}: trailer says {length} payload bytes, "
+                f"file has {len(payload)} (truncated or overwritten)")
+        if zlib.crc32(payload) != crc:
+            raise CheckpointCorrupt(
+                f"checkpoint {path}: CRC mismatch (bit rot or torn write)")
+    else:
+        # no trailer: either a version-1 checkpoint (legal, pre-integrity
+        # format) or a truncation that ate the trailer — the pickle load
+        # below distinguishes (a truncated pickle cannot load)
+        payload = raw
+    try:
+        state = pickle.loads(payload)
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path}: unreadable pickle "
+            f"({type(e).__name__}: {e})") from e
     version = state.get("version")
-    if version != CHECKPOINT_VERSION:
+    if version not in (1, CHECKPOINT_VERSION):
         raise ValueError(
             f"checkpoint {path} has version {version}, "
-            f"this build reads version {CHECKPOINT_VERSION}")
+            f"this build reads versions 1..{CHECKPOINT_VERSION}")
     return state
+
+
+def load_checkpoint(path: str) -> Dict:
+    """Load a checkpoint, falling back to the rotated ``path.prev`` when
+    the primary fails its integrity check. A recovered load is warned on
+    stderr and marked in the returned state (``_recovered_from_prev``)
+    so the service can count it; only primary+fallback both failing is
+    fatal."""
+    _maybe_fail("checkpoint")
+    try:
+        return _load_one(path)
+    except CheckpointCorrupt as primary_err:
+        prev = path + ".prev"
+        if not os.path.exists(prev):
+            raise
+        try:
+            state = _load_one(prev)
+        except (CheckpointCorrupt, ValueError) as prev_err:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} is corrupt ({primary_err}) and the "
+                f"last-good fallback failed too ({prev_err})"
+            ) from primary_err
+        print(f"[checkpoint] WARNING: {primary_err}; resumed from "
+              f"last-good {prev}", file=sys.stderr)
+        state["_recovered_from_prev"] = True
+        return state

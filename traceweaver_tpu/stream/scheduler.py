@@ -20,14 +20,33 @@ Backpressure is explicit and quantified:
   its spans counted (``shed_dropped_windows`` / ``shed_dropped_spans``)
   — the only lossy outcome, and it is the operator-visible signal that
   the deployment is under-provisioned.
+
+Failure is explicit and quantified too (the stream consumer side of the
+solve supervisor, docs/ROBUSTNESS.md): each micro-batch solve runs under
+an optional WATCHDOG timeout (``watchdog_s``) and a bounded retry
+(``solve_retries``); a batch that exhausts both is handed to
+``poison_fn`` — the service's dead-letter constructor — so a poisoned
+batch becomes counted poison-window results, never a lost micro-batch or
+an aborted stream. Without a ``poison_fn`` the final error propagates
+(the pre-supervisor behavior).
 """
 
 from __future__ import annotations
 
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Callable, Deque, List, Optional
 
 from traceweaver_tpu.stream.window import WindowBuffer
+
+
+class SolveTimeout(RuntimeError):
+    """A micro-batch solve exceeded the watchdog timeout. Classified as
+    transient (a hung device dispatch is exactly what the retry exists
+    for); the hung attempt's thread is abandoned, not interrupted —
+    device work cannot be cancelled — and its eventual result is
+    discarded."""
 
 
 class MicroBatchScheduler:
@@ -36,22 +55,33 @@ class MicroBatchScheduler:
     ``solve_fn(batch: List[WindowBuffer]) -> List[result]`` solves a
     micro-batch of sealed windows and returns one result per window, in
     order. The scheduler owns no solver state itself, so checkpointing
-    only needs its two queues.
+    only needs its two queues (the watchdog/retry counters ride the
+    service's stats dict).
     """
 
     def __init__(self, solve_fn: Callable[[List[WindowBuffer]], List],
-                 max_pending: int = 4, spill_max: int = 64) -> None:
+                 max_pending: int = 4, spill_max: int = 64,
+                 watchdog_s: Optional[float] = None,
+                 solve_retries: int = 1,
+                 poison_fn: Optional[Callable] = None) -> None:
         if max_pending < 1:
             raise ValueError("max_pending must be >= 1")
         self.solve_fn = solve_fn
         self.max_pending = int(max_pending)
         self.spill_max = int(spill_max)
+        self.watchdog_s = watchdog_s
+        self.solve_retries = max(0, int(solve_retries))
+        self.poison_fn = poison_fn
         self.pending: Deque[WindowBuffer] = deque()
         self.spill: Deque[WindowBuffer] = deque()
         self.shed_spilled = 0
         self.shed_dropped_windows = 0
         self.shed_dropped_spans = 0
         self.solved_windows = 0
+        self.solve_timeouts = 0
+        self.solve_retried = 0
+        self.poisoned_windows = 0
+        self._watchdog_pool: Optional[ThreadPoolExecutor] = None
 
     # -- producer side ----------------------------------------------------
     def offer(self, buf: WindowBuffer) -> str:
@@ -73,6 +103,50 @@ class MicroBatchScheduler:
         return len(self.pending) + len(self.spill)
 
     # -- consumer side ----------------------------------------------------
+    def _solve_once(self, batch: List[WindowBuffer]) -> List:
+        """One solve attempt, under the watchdog when configured. The
+        watchdog runs the solve on a single persistent worker thread and
+        bounds the WAIT — a timed-out solve keeps running detached (its
+        thread is not interruptible) and its late result is dropped."""
+        if not self.watchdog_s:
+            return self.solve_fn(batch)
+        if self._watchdog_pool is None:
+            self._watchdog_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tw-stream-watchdog")
+        fut = self._watchdog_pool.submit(self.solve_fn, batch)
+        try:
+            return fut.result(timeout=self.watchdog_s)
+        except FutureTimeout:
+            self.solve_timeouts += 1
+            fut.cancel()  # best effort; a running solve is abandoned
+            # a hung worker would serialize behind the abandoned solve:
+            # detach the pool so the retry gets a fresh thread
+            self._watchdog_pool = None
+            raise SolveTimeout(
+                f"micro-batch solve of {len(batch)} window(s) exceeded "
+                f"the {self.watchdog_s:.1f}s watchdog") from None
+
+    def _solve_guarded(self, batch: List[WindowBuffer]) -> List:
+        """Watchdog + bounded retry + poison hand-off for one batch."""
+        from traceweaver_tpu.runtime import faults
+
+        err: Optional[BaseException] = None
+        for attempt in range(1 + self.solve_retries):
+            if attempt:
+                self.solve_retried += 1
+            try:
+                return self._solve_once(batch)
+            except SolveTimeout as e:
+                err = e
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not faults.is_transient_fault(e):
+                    raise
+                err = e
+        self.poisoned_windows += len(batch)
+        if self.poison_fn is not None:
+            return self.poison_fn(batch, err)
+        raise err
+
     def pump(self, max_batches: Optional[int] = None) -> List:
         """Solve queued windows in micro-batches of ``max_pending``,
         refilling from the spill queue between batches, until the backlog
@@ -88,7 +162,7 @@ class MicroBatchScheduler:
                 self.pending.append(self.spill.popleft())
             batch = list(self.pending)
             self.pending.clear()
-            out = self.solve_fn(batch)
+            out = self._solve_guarded(batch)
             if len(out) != len(batch):
                 raise RuntimeError(
                     f"solve_fn returned {len(out)} results for a "
@@ -97,3 +171,8 @@ class MicroBatchScheduler:
             self.solved_windows += len(batch)
             batches += 1
         return results
+
+    def close(self) -> None:
+        if self._watchdog_pool is not None:
+            self._watchdog_pool.shutdown(wait=False)
+            self._watchdog_pool = None

@@ -56,6 +56,12 @@ class StreamConfig:
     checkpoint_path: Optional[str] = None
     checkpoint_every: int = 8      # emitted windows between checkpoints
     verbose: bool = True
+    # robustness (docs/ROBUSTNESS.md): dead-letter sidecar for poison
+    # windows (default: <sink>.deadletter.jsonl when a sink is set),
+    # micro-batch watchdog timeout + bounded retry
+    deadletter_path: Optional[str] = None
+    solve_watchdog_s: Optional[float] = None
+    solve_retries: int = 1
 
 
 class TraceSink:
@@ -102,7 +108,9 @@ class _WindowProblem:
 
 @dataclass
 class WindowResult:
-    """One solved window, ready for emission."""
+    """One solved window, ready for emission — or a POISON window that
+    exhausted the solve supervisor's ladder / the micro-batch watchdog
+    and must be dead-lettered instead of emitted."""
 
     buf: WindowBuffer
     assignments: Dict[str, Dict[str, Dict]]  # svc -> ep -> {in: out}
@@ -111,6 +119,9 @@ class WindowResult:
     accuracy: Optional[float]
     n_rows: int = 0
     solve_share_s: float = 0.0
+    poisoned: bool = False
+    poison_reason: str = ""
+    quarantined_services: Tuple[str, ...] = ()
 
 
 def _sid(span_id) -> List[str]:
@@ -131,7 +142,15 @@ class StreamingReconstructor:
             c.window_us, overlap_us=c.overlap_us, grace_us=c.grace_us)
         self.scheduler = MicroBatchScheduler(
             self._solve_batch, max_pending=c.max_pending,
-            spill_max=c.spill_max)
+            spill_max=c.spill_max, watchdog_s=c.solve_watchdog_s,
+            solve_retries=c.solve_retries, poison_fn=self._poison_batch)
+        # dead-letter sidecar for poison windows: an append-only JSONL
+        # file with the same offset/truncate resume semantics as the
+        # sink, so a kill/resume can never double-record (or lose) a
+        # dead-lettered window
+        dlq_path = c.deadletter_path or (
+            sink.path + ".deadletter.jsonl" if sink is not None else None)
+        self.deadletter = TraceSink(dlq_path) if dlq_path else None
         self.live = LiveTraceStore()
         self.carried = CarriedState()
         self.grader = StreamGrader() if c.grade else None
@@ -218,6 +237,7 @@ class StreamingReconstructor:
                     wp.truth, wp.dag, store=self.live, warm_dists=warm))
                 owners.append(b)
         outs = []
+        quarantined: List[int] = []
         if items:
             from traceweaver_tpu.runtime.jax_cache import (
                 compile_counters,
@@ -228,7 +248,8 @@ class StreamingReconstructor:
             outs = solve_fleet(items, all_spans=self.live.all_spans,
                                all_processes=self.live.all_processes,
                                stats=self.fleet_stats,
-                               precision=self.precision)
+                               precision=self.precision,
+                               quarantined=quarantined)
             delta = counters_delta(counters_before)
             self.stats["micro_batches"] = self.stats.get(
                 "micro_batches", 0) + 1
@@ -249,34 +270,63 @@ class StreamingReconstructor:
 
         results: List[WindowResult] = []
         by_buf_outs: List[List] = [[] for _ in bufs]
-        for b, out in zip(owners, outs):
+        by_buf_idx: List[List[int]] = [[] for _ in bufs]
+        for idx, (b, out) in enumerate(zip(owners, outs)):
             by_buf_outs[b].append(out)
+            by_buf_idx[b].append(idx)
+        qset = set(quarantined)
         total_rows = max(1, sum(len(wp.in_spans)
                                 for probs in per_buf for wp in probs))
-        for buf, probs, buf_outs in zip(bufs, per_buf, by_buf_outs):
+        for buf, probs, buf_outs, buf_idx in zip(bufs, per_buf, by_buf_outs,
+                                                 by_buf_idx):
             assignments: Dict[str, Dict[str, Dict]] = {}
             n_rows = 0
-            for wp, out in zip(probs, buf_outs):
+            quarantined_svcs = tuple(
+                wp.service for wp, idx in zip(probs, buf_idx) if idx in qset)
+            for wp, out, idx in zip(probs, buf_outs, buf_idx):
                 amap = out[0]
                 assignments[wp.service] = amap
                 n_rows += len(wp.in_spans)
+                if idx in qset:
+                    # a quarantined item's all-NA result must not feed
+                    # the carried statistics or the grader — the window
+                    # is dead-lettered, not emitted, and poisoned data
+                    # must not warm later windows
+                    continue
                 if self.cfg.warm_start:
                     self.carried.update(wp.service, timing.refit_from_assignments(
                         {wp.in_ep: wp.in_spans}, wp.out_parts, wp.dag,
                         amap, self.live.all_spans))
-                if self.grader is not None:
+                if self.grader is not None and not quarantined_svcs:
                     owned = [s for s in wp.in_spans
                              if s.GetId() in buf.owned_ids]
                     self.grader.accumulate(wp.service, wp.in_ep, owned,
                                            wp.out_parts, amap)
+            poisoned = bool(quarantined_svcs)
             acc = (self._window_accuracy(buf, probs, assignments)
-                   if self.cfg.grade else None)
+                   if self.cfg.grade and not poisoned else None)
             results.append(WindowResult(
                 buf=buf, assignments=assignments, problems=probs,
                 traces=self._stitch(buf, assignments),
                 accuracy=acc, n_rows=n_rows,
-                solve_share_s=solve_s * n_rows / total_rows))
+                solve_share_s=solve_s * n_rows / total_rows,
+                poisoned=poisoned,
+                poison_reason=("quarantined service(s): %s"
+                               % ", ".join(quarantined_svcs)
+                               if poisoned else ""),
+                quarantined_services=quarantined_svcs))
         return results
+
+    def _poison_batch(self, bufs: List[WindowBuffer],
+                      err: Optional[BaseException]) -> List[WindowResult]:
+        """Dead-letter constructor for a micro-batch that exhausted the
+        scheduler's watchdog+retry budget: every window becomes a counted
+        poison window (consumed by :meth:`_emit` into the dead-letter
+        queue) instead of aborting the stream."""
+        reason = f"{type(err).__name__}: {err}" if err else "solve failed"
+        return [WindowResult(
+            buf=buf, assignments={}, problems=[], traces={}, accuracy=None,
+            poisoned=True, poison_reason=reason) for buf in bufs]
 
     def _window_accuracy(self, buf: WindowBuffer,
                          probs: List[_WindowProblem],
@@ -338,7 +388,37 @@ class StreamingReconstructor:
         return traces
 
     # -- emission ---------------------------------------------------------
+    def _deadletter(self, res: WindowResult) -> None:
+        """Record a poison window in the dead-letter queue: counted in
+        the stats AND persisted as one JSONL record in the sidecar file
+        (when configured) — a quarantined window is never silently
+        dropped. Conservation invariant (tests/test_faults.py): every
+        sealed-and-solved window is either emitted or dead-lettered."""
+        buf = res.buf
+        rec = dict(
+            window=buf.k, start_us=buf.start_us, end_us=buf.end_us,
+            n_spans=buf.n_spans, n_owned=buf.n_owned,
+            reason=res.poison_reason,
+            quarantined_services=sorted(res.quarantined_services),
+        )
+        line = json.dumps(rec, sort_keys=True)
+        if self.deadletter is not None:
+            self.deadletter.write_line(line)
+            self._bump("deadletter_bytes", len(line) + 1)
+        elif self.cfg.verbose:
+            print("[stream] WARNING: no dead-letter path configured; "
+                  "poison window %d counted but not persisted" % buf.k)
+        self._bump("deadletter_windows")
+        self._bump("deadletter_spans", buf.n_owned)
+        self._since_checkpoint += 1
+        if self.cfg.verbose:
+            print("[stream] win=%d DEAD-LETTERED spans=%d owned=%d (%s)"
+                  % (buf.k, buf.n_spans, buf.n_owned, res.poison_reason))
+
     def _emit(self, res: WindowResult) -> None:
+        if res.poisoned:
+            self._deadletter(res)
+            return
         buf = res.buf
         if self.sink is not None:
             services = {}
@@ -392,13 +472,17 @@ class StreamingReconstructor:
     def _checkpoint(self) -> None:
         if not self.cfg.checkpoint_path:
             return
-        save_checkpoint(self.cfg.checkpoint_path, dict(
+        state = dict(
             cfg=self.cfg,
             precision=self.precision,
             consumed=self.consumed,
             emitted_windows=self.emitted_windows,
             emit_offset=self.sink.offset if self.sink else 0,
             sink_path=self.sink.path if self.sink else None,
+            deadletter_offset=(self.deadletter.offset
+                               if self.deadletter else 0),
+            deadletter_path=(self.deadletter.path
+                             if self.deadletter else None),
             watermark=self.watermark,
             windower=self.windower,
             live=self.live,
@@ -411,8 +495,29 @@ class StreamingReconstructor:
             scheduler_counters=(self.scheduler.shed_spilled,
                                 self.scheduler.shed_dropped_windows,
                                 self.scheduler.shed_dropped_spans,
-                                self.scheduler.solved_windows),
-        ))
+                                self.scheduler.solved_windows,
+                                self.scheduler.solve_timeouts,
+                                self.scheduler.solve_retried,
+                                self.scheduler.poisoned_windows),
+        )
+        try:
+            save_checkpoint(self.cfg.checkpoint_path, state)
+        except (OSError, RuntimeError) as e:
+            from traceweaver_tpu.runtime import faults
+
+            if not (isinstance(e, (OSError, faults.FaultError))
+                    or faults.is_transient_fault(e)):
+                raise
+            # a failed checkpoint write must not kill the stream: the
+            # rotation in save_checkpoint means the last good generation
+            # is still on disk — count, warn, keep serving (the next
+            # cadence retries)
+            self._bump("checkpoint_failures")
+            if self.cfg.verbose:
+                print("[stream] WARNING: checkpoint write failed "
+                      "(%s: %s) — continuing on the last good checkpoint"
+                      % (type(e).__name__, e))
+            return
         self._since_checkpoint = 0
 
     @classmethod
@@ -441,6 +546,12 @@ class StreamingReconstructor:
                   "precision=%s, resuming under %s (carried state is "
                   "precision-independent)"
                   % (ckpt_precision, svc.precision))
+        if state.pop("_recovered_from_prev", False):
+            # the primary checkpoint was corrupt/truncated and the load
+            # fell back to the rotated last-good generation — counted so
+            # the summary says the run survived a checkpoint corruption
+            state["stats"]["checkpoint_recovered"] = (
+                state["stats"].get("checkpoint_recovered", 0) + 1)
         svc.consumed = state["consumed"]
         svc.emitted_windows = state["emitted_windows"]
         svc.watermark = state["watermark"]
@@ -452,11 +563,22 @@ class StreamingReconstructor:
         svc.fleet_stats = state["fleet_stats"]
         svc.scheduler.pending.extend(state["pending"])
         svc.scheduler.spill.extend(state["spill"])
+        counters = state["scheduler_counters"]
         (svc.scheduler.shed_spilled, svc.scheduler.shed_dropped_windows,
          svc.scheduler.shed_dropped_spans,
-         svc.scheduler.solved_windows) = state["scheduler_counters"]
+         svc.scheduler.solved_windows) = counters[:4]
+        if len(counters) >= 7:  # v2 checkpoints carry the watchdog ledger
+            (svc.scheduler.solve_timeouts, svc.scheduler.solve_retried,
+             svc.scheduler.poisoned_windows) = counters[4:7]
         if svc.sink is not None:
             svc.sink.truncate(state["emit_offset"])
+        if svc.deadletter is None and state.get("deadletter_path"):
+            svc.deadletter = TraceSink(state["deadletter_path"])
+        if svc.deadletter is not None:
+            # same no-loss/no-double-record splice as the sink: windows
+            # dead-lettered after the checkpoint re-poison (or emit) from
+            # identical state on the resumed run
+            svc.deadletter.truncate(state.get("deadletter_offset", 0))
         return svc
 
     # -- main loop --------------------------------------------------------
@@ -465,8 +587,23 @@ class StreamingReconstructor:
         windows have been emitted — the kill/test hook) and return the
         final summary. Safe to call on a resumed service: it continues
         from the checkpointed offset."""
+        from traceweaver_tpu.runtime import faults
+
         c = self.cfg
-        for ev in self.source.events(skip=self.consumed):
+        it = self.source.events(skip=self.consumed)
+        while True:
+            try:
+                # fault-injection site "source": a failed read retries
+                # the SAME position (the draw happens before next(), so
+                # no event is consumed by a fault) — the transient-ingress
+                # model a collector subscription would need
+                faults.maybe_fail("source")
+                ev = next(it)
+            except StopIteration:
+                break
+            except faults.FaultError:
+                self._bump("source_read_retries")
+                continue
             self.consumed += 1
             self.watermark.observe(ev.event_us)
             span = self.live.add(ev)
@@ -518,6 +655,28 @@ class StreamingReconstructor:
             shed_spilled=self.scheduler.shed_spilled,
             shed_dropped_windows=self.scheduler.shed_dropped_windows,
             shed_dropped_spans=self.scheduler.shed_dropped_spans,
+            deadletter_windows=int(self.stats.get("deadletter_windows", 0)),
+            deadletter_spans=int(self.stats.get("deadletter_spans", 0)),
+            deadletter_bytes=int(self.stats.get("deadletter_bytes", 0)),
+            faults=dict(
+                retries=int(self.fleet_stats.get("fault_retries", 0)),
+                bisections=int(self.fleet_stats.get("fault_bisections", 0)),
+                xla_fallbacks=int(
+                    self.fleet_stats.get("fault_xla_fallbacks", 0)),
+                host_fallbacks=int(
+                    self.fleet_stats.get("fault_host_fallbacks", 0)),
+                quarantined=int(self.fleet_stats.get("fault_quarantined", 0)),
+                injected=int(self.fleet_stats.get("faults_injected", 0)),
+                solve_timeouts=self.scheduler.solve_timeouts,
+                solve_retried=self.scheduler.solve_retried,
+                poisoned_windows=self.scheduler.poisoned_windows,
+                checkpoint_failures=int(
+                    self.stats.get("checkpoint_failures", 0)),
+                checkpoint_recovered=int(
+                    self.stats.get("checkpoint_recovered", 0)),
+                source_read_retries=int(
+                    self.stats.get("source_read_retries", 0)),
+            ),
             pruned_spans=self.live.n_pruned,
             watermark_max_skew_us=self.watermark.max_skew_us,
             stats=dict(self.stats),

@@ -91,7 +91,8 @@ class ReplaySource:
 
     @classmethod
     def from_directory(cls, path: str, fix: int, max_traces: int = 1000,
-                       ooo_us: float = 0.0, seed: int = 0) -> "ReplaySource":
+                       ooo_us: float = 0.0, seed: int = 0,
+                       strict: bool = False) -> "ReplaySource":
         import random
 
         from traceweaver_tpu.ingest import load_corpus
@@ -104,7 +105,7 @@ class ReplaySource:
         # executor (run_experiment seeds 10 before its load).
         random.seed(10)
         store = load_corpus(path, fix=fix, max_traces=max_traces,
-                            cache=False)
+                            cache=False, strict=strict)
         return cls(store, ooo_us=ooo_us, seed=seed)
 
 
@@ -125,7 +126,8 @@ class IterableSource:
 
 
 def parse_source_spec(spec: str, fix: int = 0, max_traces: int = 1000,
-                      ooo_us: float = 0.0, seed: int = 0) -> ReplaySource:
+                      ooo_us: float = 0.0, seed: int = 0,
+                      strict: bool = False) -> ReplaySource:
     """Parse a ``--source`` spec into a source.
 
     ``replay:<dir>`` with optional query parameters overriding the
@@ -156,4 +158,5 @@ def parse_source_spec(spec: str, fix: int = 0, max_traces: int = 1000,
     if "seed" in params:
         seed = int(params["seed"])
     return ReplaySource.from_directory(path, fix=fix, max_traces=max_traces,
-                                      ooo_us=ooo_us, seed=seed)
+                                      ooo_us=ooo_us, seed=seed,
+                                      strict=strict)
