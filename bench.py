@@ -680,6 +680,170 @@ def chaos_fields(fault_stats: dict, accs_clean: dict, accs_chaos: dict,
     }
 
 
+def serve_fields(n_tenants: int, clean: dict, storm: dict) -> dict:
+    """Serve-leg ledgers -> report fields (unit-tested like
+    chaos_fields/bf16_delta_fields, tests/test_bench.py).
+
+    ``clean``/``storm`` summarize one multi-tenant run each: total
+    ``spans`` emitted, ``wall_s``, ``healthy_spans`` (spans emitted by
+    every tenant EXCEPT tenant 0, the storm target), the dispatch ledger
+    (``dispatches``/``shared_solves``/``tenant_batches``), and the
+    isolation counters. The isolation metric is the healthy tenants'
+    throughput delta between the two runs — the number that says one
+    tenant's fault storm did (or did not) tax its neighbors."""
+    def rate(spans, wall):
+        return round(spans / wall, 1) if wall and wall > 0 else None
+
+    clean_healthy = rate(clean.get("healthy_spans", 0),
+                         clean.get("wall_s", 0))
+    storm_healthy = rate(storm.get("healthy_spans", 0),
+                         storm.get("wall_s", 0))
+    iso = (round((storm_healthy - clean_healthy) / clean_healthy * 100.0, 2)
+           if clean_healthy and storm_healthy is not None else None)
+    return {
+        "serve_tenants": int(n_tenants),
+        "serve_spans_total": int(clean.get("spans", 0)),
+        "serve_spans_per_s": rate(clean.get("spans", 0),
+                                  clean.get("wall_s", 0)),
+        "serve_fleet_dispatches": int(clean.get("dispatches", 0)),
+        "serve_shared_solves": int(clean.get("shared_solves", 0)),
+        "serve_tenant_batches": int(clean.get("tenant_batches", 0)),
+        "serve_shed_windows": int(clean.get("shed_windows", 0)),
+        "serve_per_tenant_spans_per_s_min": clean.get("per_tenant_min"),
+        "serve_per_tenant_spans_per_s_max": clean.get("per_tenant_max"),
+        "serve_storm_spec": storm.get("spec"),
+        "serve_storm_injected": int(storm.get("faults_injected", 0)),
+        "serve_quarantined_windows": int(
+            storm.get("quarantined_windows", 0)),
+        "serve_deadletter_windows": int(
+            storm.get("deadletter_windows", 0)),
+        "serve_healthy_spans_per_s_clean": clean_healthy,
+        "serve_healthy_spans_per_s_storm": storm_healthy,
+        "serve_isolation_delta_pct": iso,
+        "serve_only_faulty_tenant_accrues": bool(
+            storm.get("healthy_quarantined", 1) == 0
+            and storm.get("healthy_shed", 1) == 0),
+    }
+
+
+def _serve_trace(i, prefix, base_us, spacing_us=10_000.0, slow_every=6):
+    """One synthetic frontend->search->geo Jaeger trace (fix=2 root op);
+    every ``slow_every``-th trace plants its latency in search."""
+    T = base_us + i * spacing_us
+    s1 = 5000.0 if (i % slow_every) == slow_every - 1 else 600.0
+    tid = f"{prefix}{i:04d}"
+
+    def span(sid, start, dur, op, refs, pid, kind):
+        return dict(traceID=tid, spanID=sid, startTime=start, duration=dur,
+                    operationName=op,
+                    references=[{"traceID": tid, "spanID": r} for r in refs],
+                    processID=pid,
+                    tags=[{"key": "span.kind", "value": kind}])
+
+    return dict(traceID=tid, spans=[
+        span("root", T, s1 + 900, "HTTP GET /hotels", [], "p1", "server"),
+        span("c1", T + 200, s1 + 500, "call-search", ["root"], "p1",
+             "client"),
+        span("s1", T + 300, s1, "search", ["c1"], "p2", "server"),
+        span("c2", T + 400, 300.0, "call-geo", ["s1"], "p2", "client"),
+        span("s2", T + 450, 200.0, "geo", ["c2"], "p3", "server"),
+    ], processes=dict(p1={"serviceName": "frontend"},
+                      p2={"serviceName": "search"},
+                      p3={"serviceName": "geo"}))
+
+
+def run_serve_leg(n_tenants: int) -> dict:
+    """bench.py --serve-tenants N: the multi-tenant service leg.
+
+    N synthetic tenants POST at MIXED rates (tenant i ingests
+    ``4 * (1 + i % 4)`` traces) into one TenantService; the leg reports
+    sustained spans/s, per-tenant min/max, shed/quarantine counts, and
+    the isolation metric: the healthy tenants' throughput delta while
+    tenant 0 re-runs the same feed under a TW_FAULTS-style dispatch
+    fault storm (TW_BENCH_FAULTS, default dispatch:0.5) in isolated
+    dispatches."""
+    import jax
+
+    if os.environ.get("TW_BACKEND", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("TW_RETRY_BACKOFF_S", "0")
+    from traceweaver_tpu.serve import ServeConfig, TenantService
+
+    spec = os.environ.get("TW_BENCH_FAULTS") or "dispatch:0.5"
+
+    def one_run(storm_spec=None):
+        svc = TenantService(ServeConfig(
+            fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+            verbose=False, pump_windows=10**9))
+        if storm_spec:
+            svc.tenant("tenant-0000").fault_spec = storm_spec
+        t0 = time.perf_counter()
+        # tenant 0 feeds in chunks with per-tenant flushes (several
+        # solves -> several fault draws, so a p<1 storm actually fires);
+        # chunk windows are far apart in event time, so an early seal
+        # never makes the next chunk late. Same cadence on the clean
+        # run, keeping the two walls comparable.
+        for chunk in range(4):
+            svc.ingest("tenant-0000", {"data": [
+                _serve_trace(k, f"u0c{chunk}",
+                             base_us=(chunk + 1) * 200e6)
+                for k in range(4)]})
+            svc.flush("tenant-0000")
+        for i in range(1, n_tenants):
+            tid = f"tenant-{i:04d}"
+            n = 4 * (1 + i % 4)  # mixed rates
+            svc.ingest(tid, {"data": [
+                _serve_trace(k, f"u{i:04d}", base_us=(i + 1) * 1e6)
+                for k in range(n)]})
+        svc.flush()
+        wall = time.perf_counter() - t0
+        st = svc.stats()
+        tstats = st["tenants"]
+        healthy = [t for tid, t in tstats.items()
+                   if tid != "tenant-0000"]
+        per_tenant = [t["spans_emitted"] / wall
+                      for t in tstats.values() if wall > 0]
+        return dict(
+            spans=sum(t["spans_emitted"] for t in tstats.values()),
+            wall_s=wall,
+            healthy_spans=sum(t["spans_emitted"] for t in healthy),
+            dispatches=st["dispatch"]["fleet_dispatches"],
+            shared_solves=st["dispatch"]["shared_solves"],
+            tenant_batches=st["dispatch"]["tenant_batches"],
+            shed_windows=sum(t["shed_dropped_windows"]
+                             for t in tstats.values()),
+            per_tenant_min=(round(min(per_tenant), 1)
+                            if per_tenant else None),
+            per_tenant_max=(round(max(per_tenant), 1)
+                            if per_tenant else None),
+            quarantined_windows=sum(t["quarantined_windows"]
+                                    for t in tstats.values()),
+            deadletter_windows=sum(t["deadletter_windows"]
+                                   for t in tstats.values()),
+            healthy_quarantined=sum(t["quarantined_windows"]
+                                    for t in healthy),
+            healthy_shed=sum(t["shed_dropped_windows"] for t in healthy),
+            faults_injected=int(
+                svc.tenant("tenant-0000").fleet_stats.get(
+                    "faults_injected", 0)) if storm_spec else 0,
+            spec=storm_spec,
+        )
+
+    # warmup pass (uncounted): compiles every shape class so the clean
+    # and storm passes below compare warm-vs-warm wall clock — the
+    # isolation delta must measure the storm, not XLA compilation
+    log(f"serve leg: {n_tenants} tenants, warmup pass")
+    one_run()
+    log("serve leg: clean pass")
+    clean = one_run()
+    log(f"serve leg: clean {clean['spans']} spans in "
+        f"{clean['wall_s']:.1f}s; storm pass under {spec!r}")
+    storm = one_run(storm_spec=spec)
+    report = serve_fields(n_tenants, clean, storm)
+    report["mode"] = "serve"
+    return report
+
+
 def backend_label(solver_backend) -> tuple:
     """Top-level backend field for the final JSON line.
 
@@ -1211,10 +1375,25 @@ if __name__ == "__main__":
                          "under injected faults (default spec "
                          "dispatch:0.2) and report the supervisor "
                          "ledger + accuracy delta vs the unfaulted leg")
+    ap.add_argument("--serve-tenants", type=int, default=None, metavar="N",
+                    help="standalone multi-tenant service leg: N "
+                         "synthetic tenants at mixed rates through one "
+                         "TenantService; reports sustained spans/s, "
+                         "shed/quarantine counts, and the healthy-tenant "
+                         "isolation delta under tenant 0's fault storm "
+                         "(TW_BENCH_FAULTS, default dispatch:0.5)")
     args = ap.parse_args()
     if args.faults:
         # env, so the solver CHILD (where the leg runs) inherits it
         os.environ["TW_BENCH_FAULTS"] = args.faults
+    if args.serve_tenants:
+        serve_report = run_serve_leg(args.serve_tenants)
+        line = json.dumps(serve_report)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        sys.exit(0)
     if args.mode == "solver":
         run_solver_child(args.bundle, args.out)
     elif args.mode == "baseline":

@@ -212,3 +212,43 @@ def test_bf16_delta_fields_per_dataset_and_warn_list(bench):
     empty = bench.bf16_delta_fields({}, {})
     assert empty["accuracy_delta_vs_f32"] is None
     assert empty["bf16_delta_exceeds_1pt"] == []
+
+
+@pytest.mark.serve
+def test_serve_fields_ledger_and_isolation_delta(bench):
+    """The --serve-tenants leg's report builder: run summaries -> the
+    serve_* field set the driver consumes, with the isolation metric as
+    the healthy-tenant throughput delta (storm vs clean) in percent."""
+    clean = dict(spans=4000, wall_s=4.0, healthy_spans=3000,
+                 dispatches=3, shared_solves=2, tenant_batches=20,
+                 shed_windows=1, per_tenant_min=10.0, per_tenant_max=90.0)
+    storm = dict(spans=3800, wall_s=4.0, healthy_spans=2850,
+                 quarantined_windows=5, deadletter_windows=5,
+                 healthy_quarantined=0, healthy_shed=0,
+                 faults_injected=17, spec="dispatch:0.5")
+    out = bench.serve_fields(100, clean, storm)
+    assert out["serve_tenants"] == 100
+    assert out["serve_spans_total"] == 4000
+    assert out["serve_spans_per_s"] == 1000.0
+    assert out["serve_fleet_dispatches"] == 3
+    assert out["serve_shared_solves"] == 2
+    assert out["serve_tenant_batches"] == 20
+    assert out["serve_shed_windows"] == 1
+    assert out["serve_per_tenant_spans_per_s_min"] == 10.0
+    assert out["serve_per_tenant_spans_per_s_max"] == 90.0
+    assert out["serve_storm_spec"] == "dispatch:0.5"
+    assert out["serve_storm_injected"] == 17
+    assert out["serve_quarantined_windows"] == 5
+    assert out["serve_deadletter_windows"] == 5
+    assert out["serve_healthy_spans_per_s_clean"] == 750.0
+    assert out["serve_healthy_spans_per_s_storm"] == 712.5
+    assert out["serve_isolation_delta_pct"] == -5.0
+    assert out["serve_only_faulty_tenant_accrues"] is True
+    # a storm that taxes neighbors flips the isolation verdict
+    bad = bench.serve_fields(
+        100, clean, dict(storm, healthy_quarantined=2))
+    assert bad["serve_only_faulty_tenant_accrues"] is False
+    # empty/zero inputs degrade to None rates, never divide-by-zero
+    empty = bench.serve_fields(0, {}, {})
+    assert empty["serve_spans_per_s"] is None
+    assert empty["serve_isolation_delta_pct"] is None

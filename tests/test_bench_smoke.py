@@ -217,6 +217,71 @@ def test_chaos_smoke_fault_injected_solve_completes_with_ledger(monkeypatch):
         assert a[0] == b[0] and a[1] == b[1] and a[2:] == b[2:]
 
 
+@pytest.mark.serve
+def test_serve_smoke_two_tenants_http_roundtrip(tmp_path):
+    """Tier-1 serve smoke: boot the multi-tenant HTTP service on an
+    ephemeral port under JAX_PLATFORMS=cpu, POST Jaeger-JSON spans for
+    TWO tenants, and assert that (a) each tenant round-trips a
+    reconstructed trace through the trace-fetch API and (b) a live
+    delay-culprit query returns the planted culprit service — the whole
+    serving path (ingest -> windows -> shared fleet solve -> ring ->
+    query) in one pass."""
+    import json
+    import threading
+    import urllib.request
+
+    from test_serve import hotel_payload
+
+    from traceweaver_tpu.serve import ServeConfig, TenantService, make_server
+
+    service = TenantService(ServeConfig(
+        fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+        verbose=False, pump_windows=10**9,
+        state_dir=str(tmp_path / "serve_state")))
+    server = make_server(service, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def call(method, path, payload=None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(base + path, data=data, method=method)
+        if data:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json.loads(resp.read())
+
+    try:
+        a = call("POST", "/api/v1/tenants/smoke-a/spans",
+                 hotel_payload(prefix="a"))
+        assert a["ingested_spans"] == 120 and a["malformed_spans"] == 0
+        b = call("POST", "/api/v1/tenants/smoke-b/spans",
+                 hotel_payload(prefix="b", base_us=9e6))
+        assert b["ingested_traces"] == 24
+        flushed = call("POST", "/api/v1/flush")
+        assert flushed["solved_windows"] == 2
+
+        for tid in ("smoke-a", "smoke-b"):
+            traces = call("GET", f"/api/v1/tenants/{tid}/traces")
+            assert traces["n_traces"] == 24
+            rec = call("GET", f"/api/v1/tenants/{tid}/traces/"
+                              f"{traces['trace_ids'][0]}")
+            assert rec["complete"] and rec["n_spans"] == 5
+            q = call("GET", f"/api/v1/tenants/{tid}/query/delay_culprit"
+                            "?percentile=0.8")
+            assert not q["empty"]
+            assert q["worst_service"] == "search", q
+
+        # both tenants' windows rode SHARED dispatches
+        st = call("GET", "/api/v1/stats")
+        assert st["dispatch"]["shared_solves"] == 1
+        assert st["dispatch"]["tenant_batches"] == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+    service.drain()
+
+
 @pytest.mark.pipeline
 def test_pipelined_fleet_runs_and_second_solve_is_compile_free():
     """Tier-1 pipeline smoke: under JAX_PLATFORMS=cpu the fleet solve

@@ -1,10 +1,20 @@
-"""Query-engine tests over executor output."""
+"""Query-engine tests over executor output and emitted-trace records."""
 
+import json
+import os
 import pickle
+import subprocess
+import sys
 
 import pytest
 
-from traceweaver_tpu.query import delay_culprit, extract_hop_latencies, filter_traces
+from traceweaver_tpu.query import (
+    delay_culprit,
+    extract_hop_latencies,
+    filter_traces,
+    live_delay_culprit,
+    load_trace_records,
+)
 from traceweaver_tpu.spans import Span
 
 
@@ -48,7 +58,113 @@ def test_delay_culprit_end_to_end(tmp_path):
     assert r["worst_true"][0] == 1  # hop 1 has the big duration
     assert r["worst_pred"][0] == 1
     assert r["n_pred"] <= r["n_true"]
+    assert r["empty"] is False
     assert out.exists()
     with open(out, "rb") as f:
         ql = pickle.load(f)
     assert "FCFS" in ql and len(ql["FCFS"]) == 2
+
+
+def test_delay_culprit_tolerates_empty_trace_sets(tmp_path):
+    """Empty/incomplete trace sets return a COUNTED zero-result (the
+    ISSUE's graceful-degradation requirement), never crash: empty dicts,
+    methods whose every trace is incomplete, and an empty bracket."""
+    path = tmp_path / "e2e_empty.pickle"
+    with open(path, "wb") as f:
+        pickle.dump({"Empty": [{}, {}],
+                     "AllNone": [{"t": [None, None]}, {"t": [None]}]}, f)
+    results = delay_culprit(str(path), percentile=0.95)
+    for method in ("Empty", "AllNone"):
+        r = results[method]
+        assert r["empty"] is True
+        assert r["n_true"] == 0 and r["n_pred"] == 0
+        assert r["worst_true"] == (None, -1.0)
+    # the CLI main prints the zero-result instead of crashing on None
+    from traceweaver_tpu.query.delay_culprit import main
+
+    assert main([str(path)]) == 0
+
+
+def _record(tid, start, spans):
+    """spans: [(service, kind, start, dur, self_us)]"""
+    recs = [dict(sid=[tid, f"s{i}"], service=svc, kind=kind,
+                 start_us=s, dur_us=d, self_us=self_us)
+            for i, (svc, kind, s, d, self_us) in enumerate(spans)]
+    end = max(r["start_us"] + r["dur_us"] for r in recs)
+    return dict(trace_id=tid, window=0, root_start_us=start,
+                e2e_us=end - start, n_spans=len(recs), complete=True,
+                spans=recs)
+
+
+def test_live_delay_culprit_attributes_self_time():
+    """The live query charges latency to the service that SPENT it
+    (self time), not the frontend that contained it, and filters by
+    percentile + after_us like the reference query."""
+    records = []
+    for i in range(20):
+        start = i * 1000.0
+        slow = i >= 18  # the top-10% traces are slow in "db"
+        db = 5000.0 if slow else 100.0
+        records.append(_record(f"t{i}", start, [
+            ("front", "server", start, db + 300.0, 200.0),
+            ("front", "client", start + 50, db + 150.0, 150.0),
+            ("db", "server", start + 100, db, db),
+        ]))
+    out = live_delay_culprit(records, percentile=0.9)
+    assert not out["empty"]
+    assert out["worst_service"] == "db"
+    assert out["n_bracket"] == 2
+    # after_us excludes the early slow trace
+    out2 = live_delay_culprit(records, percentile=0.9, after_us=18_500.0)
+    assert out2["n_bracket"] == 1
+    # empty inputs: counted zero-result
+    empty = live_delay_culprit([])
+    assert empty["empty"] and empty["worst_service"] is None
+    # incomplete records are excluded like the reference's None-hop rule
+    partial = [dict(r, complete=False) for r in records]
+    assert live_delay_culprit(partial)["empty"]
+
+
+def test_query_cli_subcommand_offline_paths(tmp_path):
+    """`python -m traceweaver_tpu.runtime.cli query <file>`: the offline
+    path works on both an e2e pickle and an emitted-trace JSONL file,
+    without a running server."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cwd = os.path.join(os.path.dirname(__file__), "..")
+
+    pkl = tmp_path / "e2e_q.pickle"
+    _e2e_pickle(pkl)
+    res = subprocess.run(
+        [sys.executable, "-m", "traceweaver_tpu.runtime.cli", "query",
+         str(pkl), "--percentile", "0.5"],
+        capture_output=True, text=True, timeout=300, cwd=cwd, env=env)
+    assert res.returncode == 0, res.stderr
+    assert "worst hop (true) #1" in res.stdout
+    assert "AGREE" in res.stdout
+
+    jsonl = tmp_path / "emitted.jsonl"
+    with open(jsonl, "w") as f:
+        for i in range(10):
+            start = i * 1000.0
+            dur = 4000.0 if i == 9 else 100.0
+            f.write(json.dumps(_record(f"t{i}", start, [
+                ("front", "server", start, dur + 100.0, 100.0),
+                ("slowsvc", "server", start + 10, dur, dur),
+            ])) + "\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "traceweaver_tpu.runtime.cli", "query",
+         str(jsonl), "--percentile", "0.9"],
+        capture_output=True, text=True, timeout=300, cwd=cwd, env=env)
+    assert res.returncode == 0, res.stderr
+    assert "worst service: slowsvc" in res.stdout
+    assert load_trace_records(str(jsonl))[0]["trace_id"] == "t0"
+
+    # empty JSONL: the counted zero-result, exit 0
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    res = subprocess.run(
+        [sys.executable, "-m", "traceweaver_tpu.runtime.cli", "query",
+         str(empty)],
+        capture_output=True, text=True, timeout=300, cwd=cwd, env=env)
+    assert res.returncode == 0, res.stderr
+    assert "empty bracket" in res.stdout and "no culprit" in res.stdout

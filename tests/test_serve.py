@@ -1,0 +1,462 @@
+"""Multi-tenant reconstruction service tests (tier-1, CPU).
+
+Contracts covered (ISSUE 6):
+
+- end-to-end multi-tenant path: >=2 tenants POST Jaeger-JSON over HTTP
+  into one running service, their windows solve in SHARED fleet
+  dispatches (dispatch ledger: fewer dispatch groups than tenant-serial),
+  each tenant's emitted traces match its single-tenant solve
+  byte-for-byte, and a live delay_culprit query returns the planted
+  culprit service;
+- isolation under a fault storm: tenant 0 under ``TW_FAULTS``-style
+  dispatch faults solves in isolated dispatches; other tenants' windows
+  all emit, per-tenant conservation (emitted + dead-lettered == solved)
+  holds, and only tenant 0 accrues quarantine/shed counts;
+- per-tenant backpressure: pending bound -> spill -> counted shed, one
+  tenant's burst never touching a neighbor's counters;
+- the tenant id column through fleet pack/decode (per-tenant window
+  buckets conserve: packed == decoded);
+- tenancy guardrails (tenant cap, id validation, malformed payloads,
+  strict mode) and the TW_SERVE_* knob registry.
+
+The corpus is handcrafted Jaeger JSON (fix=2: root op "HTTP GET
+/hotels", no Alibaba remapping — fully deterministic, no RNG) with a
+planted culprit: every ``slow_every``-th trace spends its latency in the
+``search`` service's self time.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+from traceweaver_tpu.serve import ServeConfig, TenancyError, TenantService
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.serve
+
+
+# ---------------------------------------------------------------------------
+# handcrafted Jaeger-JSON corpus (shared with the tier-1 smoke in
+# test_bench_smoke.py): frontend -> search -> geo, culprit = search
+# ---------------------------------------------------------------------------
+
+def hotel_trace(i, prefix, base_us=1_000_000.0, spacing_us=10_000.0,
+                slow_every=6):
+    T = base_us + i * spacing_us
+    slow = (i % slow_every) == slow_every - 1
+    s1_dur = 5000.0 if slow else 600.0
+    c1_dur = s1_dur + 500.0
+    root_dur = c1_dur + 400.0
+    tid = f"{prefix}{i:03d}"
+
+    def span(sid, start, dur, op, refs, pid, kind):
+        return dict(traceID=tid, spanID=sid, startTime=start, duration=dur,
+                    operationName=op,
+                    references=[{"traceID": tid, "spanID": r} for r in refs],
+                    processID=pid,
+                    tags=[{"key": "span.kind", "value": kind}])
+
+    spans = [
+        span("root", T, root_dur, "HTTP GET /hotels", [], "p1", "server"),
+        span("c1", T + 200, c1_dur, "call-search", ["root"], "p1", "client"),
+        span("s1", T + 300, s1_dur, "search", ["c1"], "p2", "server"),
+        span("c2", T + 400, 300.0, "call-geo", ["s1"], "p2", "client"),
+        span("s2", T + 450, 200.0, "geo", ["c2"], "p3", "server"),
+    ]
+    return dict(traceID=tid, spans=spans,
+                processes=dict(p1={"serviceName": "frontend"},
+                               p2={"serviceName": "search"},
+                               p3={"serviceName": "geo"}))
+
+
+def hotel_payload(n_traces=24, prefix="t", base_us=1_000_000.0,
+                  spacing_us=10_000.0, slow_every=6):
+    return {"data": [hotel_trace(i, prefix, base_us, spacing_us, slow_every)
+                     for i in range(n_traces)]}
+
+
+def _cfg(**kw):
+    base = dict(fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+                verbose=False, pump_windows=10**9)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _run_single_tenant(tmp_path, name, payload):
+    """One tenant alone through its own service (the tenant-serial
+    baseline the shared-dispatch ledger is compared against)."""
+    svc = TenantService(_cfg(state_dir=str(tmp_path / name)))
+    svc.ingest(name, payload)
+    svc.flush()
+    dispatches = int(svc.fleet_stats.get("fleet_dispatches", 0))
+    svc.drain()
+    with open(tmp_path / name / name / "traces.jsonl", "rb") as f:
+        return f.read(), dispatches
+
+
+# ---------------------------------------------------------------------------
+# tentpole: shared dispatches, parity, live query — over HTTP
+# ---------------------------------------------------------------------------
+
+def _http(method, url, payload=None, timeout=120):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_multi_tenant_http_end_to_end(tmp_path):
+    """The acceptance path: two tenants POST Jaeger JSON over HTTP into
+    one running service; one SHARED fleet dispatch solves both (ledger:
+    fewer dispatch groups than the tenant-serial sum); each tenant's
+    emitted traces equal its single-tenant solve byte-for-byte; the live
+    delay-culprit query returns the planted culprit service."""
+    from traceweaver_tpu.serve import make_server
+
+    pay_a = hotel_payload(prefix="a")
+    pay_b = hotel_payload(prefix="b", base_us=9_000_000.0)
+
+    service = TenantService(_cfg(state_dir=str(tmp_path / "mt")))
+    server = make_server(service, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        code, out = _http("POST", base + "/api/v1/tenants/alpha/spans",
+                          pay_a)
+        assert code == 200 and out["ingested_traces"] == 24, out
+        assert out["malformed_spans"] == 0
+        code, out = _http("POST", base + "/api/v1/tenants/beta/spans",
+                          pay_b)
+        assert code == 200 and out["ingested_spans"] == 120
+
+        code, out = _http("POST", base + "/api/v1/flush")
+        assert code == 200 and out["solved_windows"] == 2, out
+
+        code, st = _http("GET", base + "/api/v1/stats")
+        assert code == 200
+        shared_dispatches = st["dispatch"]["fleet_dispatches"]
+        assert st["dispatch"]["shared_solves"] == 1
+        assert st["dispatch"]["tenant_batches"] == 2
+
+        # the live query returns the planted culprit for BOTH tenants
+        for tid in ("alpha", "beta"):
+            code, q = _http(
+                "GET", base + f"/api/v1/tenants/{tid}/query/delay_culprit"
+                "?percentile=0.8")
+            assert code == 200 and not q["empty"]
+            assert q["worst_service"] == "search", q
+            assert q["n_bracket"] > 0
+
+        # trace fetch/list round-trips a reconstructed trace
+        code, tr = _http("GET", base + "/api/v1/tenants/alpha/traces")
+        assert code == 200 and tr["n_traces"] == 24
+        code, rec = _http(
+            "GET", base + f"/api/v1/tenants/alpha/traces/{tr['trace_ids'][0]}")
+        assert code == 200 and rec["complete"] and rec["n_spans"] == 5
+        assert {s["service"] for s in rec["spans"]} \
+            == {"frontend", "search", "geo"}
+    finally:
+        server.shutdown()
+        server.server_close()
+    service.drain()
+
+    # per-tenant parity: the shared-dispatch traces equal each tenant's
+    # single-tenant solve byte-for-byte, with zero cross-tenant leakage
+    with open(tmp_path / "mt" / "alpha" / "traces.jsonl", "rb") as f:
+        got_a = f.read()
+    with open(tmp_path / "mt" / "beta" / "traces.jsonl", "rb") as f:
+        got_b = f.read()
+    solo_a, disp_a = _run_single_tenant(tmp_path, "alpha", pay_a)
+    solo_b, disp_b = _run_single_tenant(tmp_path, "beta", pay_b)
+    assert got_a == solo_a and got_b == solo_b
+    assert b'"b' not in got_a and b'"a0' not in got_b  # no leakage
+    # the dispatch ledger's headline claim: shared < tenant-serial
+    assert shared_dispatches < disp_a + disp_b, (
+        f"shared {shared_dispatches} vs serial {disp_a}+{disp_b}")
+
+
+def test_tenant_id_column_conserves_through_pack_and_decode():
+    """The fleet's tenancy id column: per-tenant packed window counts
+    equal per-tenant decoded window counts (nothing attributed to the
+    wrong tenant, nothing lost between pack and decode)."""
+    svc = TenantService(_cfg())
+    svc.ingest("t-a", hotel_payload(prefix="a"))
+    svc.ingest("t-b", hotel_payload(prefix="b", base_us=9e6))
+    svc.flush()
+    packed = svc.fleet_stats.get("tenant_windows_packed", {})
+    decoded = svc.fleet_stats.get("tenant_windows_decoded", {})
+    assert set(packed) == {"t-a", "t-b"}
+    assert packed == decoded
+    assert all(v > 0 for v in packed.values())
+
+
+# ---------------------------------------------------------------------------
+# isolation: fault storm, backpressure, conservation
+# ---------------------------------------------------------------------------
+
+def _assert_conservation(t):
+    assert t["emitted_windows"] + t["deadletter_windows"] \
+        == t["solved_windows"], t
+
+
+def test_isolation_under_dispatch_fault_storm(monkeypatch):
+    """Tenant 0 under a ``dispatch:0.5`` storm (the acceptance spec):
+    its windows solve in ISOLATED dispatches under its own fault plan;
+    every other tenant's windows all emit, per-tenant conservation holds,
+    and only tenant 0 accrues fault-ladder/quarantine counts."""
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    svc = TenantService(_cfg(window_us=20e6, overlap_us=4e6,
+                             pump_windows=1))
+    svc.tenant("t0").fault_spec = "dispatch:0.5"
+    # multi-window feed (traces 5 s apart, 20 s windows): several pumps,
+    # several isolated dispatches for t0 — enough seeded draws to fire
+    for i, tid in enumerate(("t0", "t1", "t2")):
+        svc.ingest(tid, hotel_payload(
+            prefix=tid[-1], base_us=(i + 1) * 1e6, spacing_us=5e6))
+    svc.flush()
+    st = svc.stats()
+    assert st["dispatch"]["isolated_solves"] > 0
+
+    t0 = st["tenants"]["t0"]
+    _assert_conservation(t0)
+    assert t0["faults"]["injected"] > 0, (
+        "the storm never fired — not an isolation test")
+    for tid in ("t1", "t2"):
+        t = st["tenants"][tid]
+        _assert_conservation(t)
+        assert t["emitted_windows"] > 0
+        assert t["deadletter_windows"] == 0
+        assert t["quarantined_windows"] == 0
+        assert t["shed_dropped_windows"] == 0
+        assert all(v == 0 for v in t["faults"].values()), t["faults"]
+
+
+def test_quarantine_storm_deadletters_only_the_faulty_tenant(monkeypatch):
+    """A storm that exhausts the whole supervisor ladder
+    (``dispatch:1.0,host:1.0``): tenant 0's windows quarantine and
+    dead-letter — counted, conserved, never silently dropped — while the
+    healthy neighbor emits everything."""
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    svc = TenantService(_cfg())
+    svc.tenant("t0").fault_spec = "dispatch:1.0,host:1.0"
+    svc.ingest("t0", hotel_payload(prefix="a"))
+    svc.ingest("t1", hotel_payload(prefix="b", base_us=9e6))
+    svc.flush()
+    st = svc.stats()
+    t0, t1 = st["tenants"]["t0"], st["tenants"]["t1"]
+    assert t0["deadletter_windows"] > 0
+    assert t0["quarantined_windows"] > 0
+    assert t0["faults"]["quarantined"] > 0
+    _assert_conservation(t0)
+    assert t0["emitted_windows"] == 0
+    assert t1["emitted_windows"] == 1 and t1["deadletter_windows"] == 0
+    assert t1["quarantined_windows"] == 0
+    # the poison windows landed in t0's OWN dead-letter sidecar counters,
+    # and t0's ring holds no phantom traces from the poisoned windows
+    assert len(svc.tenant("t0").ring) == 0
+    assert len(svc.tenant("t1").ring) == 24
+
+
+def test_per_tenant_backpressure_sheds_only_the_bursting_tenant():
+    """Per-tenant pending -> spill -> shed: a bursting tenant fills ITS
+    queues and takes ITS losses; the quiet neighbor's counters stay
+    zero and its windows all solve."""
+    svc = TenantService(_cfg(window_us=2e6, overlap_us=0.0,
+                             ooo_bound_us=1e5,
+                             max_pending=1, spill_max=1))
+    # ~60 windows' worth of spans for the burster, no pump in between
+    svc.ingest("burst", hotel_payload(n_traces=40, prefix="x",
+                                      spacing_us=3e6))
+    svc.ingest("quiet", hotel_payload(n_traces=4, prefix="q",
+                                      base_us=2e6, spacing_us=1e5))
+    b = svc.tenant("burst").svc.scheduler
+    assert b.shed_spilled > 0
+    assert b.shed_dropped_windows > 0
+    q = svc.tenant("quiet").svc.scheduler
+    assert q.shed_spilled == 0 and q.shed_dropped_windows == 0
+    svc.flush()
+    st = svc.stats()
+    assert st["tenants"]["quiet"]["emitted_windows"] > 0
+    assert st["tenants"]["quiet"]["shed_dropped_windows"] == 0
+    # shed is quantified loss: solved + dropped covers everything sealed
+    burst = st["tenants"]["burst"]
+    assert burst["shed_dropped_windows"] > 0
+    assert burst["emitted_windows"] > 0  # shed != starved
+
+
+# ---------------------------------------------------------------------------
+# guardrails: tenancy caps, ids, malformed payloads, knobs
+# ---------------------------------------------------------------------------
+
+def test_tenant_cap_and_id_validation():
+    svc = TenantService(_cfg(max_tenants=2))
+    svc.tenant("a")
+    svc.tenant("b")
+    with pytest.raises(TenancyError, match="cap"):
+        svc.tenant("c")
+    with pytest.raises(TenancyError, match="invalid tenant id"):
+        TenantService(_cfg()).tenant("no/slashes")
+    with pytest.raises(TenancyError, match="invalid tenant id"):
+        TenantService(_cfg()).tenant("")
+
+
+def test_malformed_spans_deadletter_and_strict_mode():
+    """The ingest dead-letter path over HTTP-shaped payloads: malformed
+    span records skip-and-count (the jaeger.py rule), strict raises."""
+    from traceweaver_tpu.ingest.jaeger import MalformedSpan
+
+    payload = hotel_payload(n_traces=4, prefix="m")
+    payload["data"][0]["spans"][1] = {"spanID": "broken"}  # no ids/times
+    svc = TenantService(_cfg())
+    out = svc.ingest("m", payload)
+    assert out["malformed_spans"] == 1
+    assert out["ingested_traces"] == 4  # the trace survives minus the span
+
+    strict = TenantService(_cfg(strict=True))
+    with pytest.raises(MalformedSpan):
+        strict.ingest("m", payload)
+
+
+def test_rejected_root_op_is_counted_not_ingested():
+    payload = hotel_payload(n_traces=3, prefix="r")
+    for rec in payload["data"]:
+        rec["spans"][0]["operationName"] = "HTTP GET /other"
+    svc = TenantService(_cfg())  # fix=2 requires "HTTP GET /hotels"
+    out = svc.ingest("r", payload)
+    assert out["ingested_traces"] == 0
+    assert out["rejected_traces"] == 3
+
+
+def test_serve_knobs_registered_and_typos_raise(monkeypatch):
+    from traceweaver_tpu.runtime import knobs
+
+    for name in ("TW_SERVE_PORT", "TW_SERVE_MAX_TENANTS",
+                 "TW_SERVE_PENDING", "TW_SERVE_SPILL", "TW_SERVE_RING",
+                 "TW_SERVE_DRAIN_S", "TW_SERVE_PUMP_WINDOWS"):
+        assert name in knobs.REGISTRY, name
+    monkeypatch.setenv("TW_SERVE_PENDING", "nope")
+    with pytest.raises(knobs.KnobError):
+        knobs.get_int("TW_SERVE_PENDING")
+    # registered knobs are not "unknown" at startup; a typo'd one is
+    monkeypatch.delenv("TW_SERVE_PENDING")
+    monkeypatch.setenv("TW_SERVE_RING", "16")
+    monkeypatch.setenv("TW_SERVE_RNIG", "16")
+    unknown = knobs.unknown_knobs()
+    assert "TW_SERVE_RNIG" in unknown
+    assert "TW_SERVE_RING" not in unknown
+    # knob defaults actually govern ServeConfig
+    assert ServeConfig().ring_size == 16
+
+
+def test_ring_bound_evicts_oldest_and_query_stays_live():
+    svc = TenantService(_cfg(ring_size=8))
+    svc.ingest("r", hotel_payload(n_traces=24, prefix="r"))
+    svc.flush()
+    t = svc.tenant("r")
+    assert len(t.ring) == 8
+    assert t.ring.evicted == 16
+    ids = t.ring.ids()
+    assert ids == [f"r{i:03d}" for i in range(16, 24)]  # newest 8 kept
+    q = svc.query_delay_culprit("r", percentile=0.5)
+    assert not q["empty"] and q["n_traces"] == 8
+
+
+def test_query_before_first_window_returns_counted_zero_result():
+    svc = TenantService(_cfg())
+    svc.tenant("empty")
+    q = svc.query_delay_culprit("empty")
+    assert q["empty"] is True
+    assert q["n_traces"] == 0 and q["n_bracket"] == 0
+    assert q["worst_service"] is None
+
+
+def test_serve_cli_subprocess_sigterm_drains(tmp_path):
+    """`python -m traceweaver_tpu.runtime.cli serve` end-to-end: boots
+    on an ephemeral port, ingests over HTTP, and a SIGTERM gracefully
+    drains — every tenant checkpointed (resumable) before exit."""
+    import re
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    state = tmp_path / "state"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TW_BACKEND="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "traceweaver_tpu.runtime.cli", "serve",
+         "--port", "0", "--fix", "2", "--state-dir", str(state)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=os.path.join(os.path.dirname(__file__), ".."), env=env)
+    try:
+        line = ""
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "listening on" in line:
+                break
+            assert proc.poll() is None, "serve CLI died during startup"
+        m = re.search(r"http://127\.0\.0\.1:(\d+)", line)
+        assert m, f"no listen line: {line!r}"
+        base = f"http://127.0.0.1:{m.group(1)}"
+
+        code, out = _http("POST", base + "/api/v1/tenants/cli-a/spans",
+                          hotel_payload(prefix="a"))
+        assert code == 200 and out["ingested_traces"] == 24
+        code, out = _http("POST", base + "/api/v1/flush")
+        assert code == 200 and out["solved_windows"] == 1
+        code, q = _http("GET", base + "/api/v1/tenants/cli-a/query/"
+                               "delay_culprit?percentile=0.8")
+        assert code == 200 and q["worst_service"] == "search"
+
+        proc.send_signal(signal.SIGTERM)
+        rest = proc.stdout.read()
+        assert proc.wait(timeout=120) == 0, rest
+        assert "drained: 1 tenants checkpointed" in rest, rest
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # the drain checkpoint is resumable
+    resumed = TenantService.resume(_cfg(state_dir=str(state)))
+    assert sorted(resumed.tenants) == ["cli-a"]
+    assert resumed.tenant("cli-a").svc.emitted_windows == 1
+    assert len(resumed.tenant("cli-a").ring) == 24
+
+
+def test_serve_cli_resume_roundtrip(tmp_path):
+    """`cli serve --resume` path machinery: drain writes per-tenant
+    checkpoints, TenantService.resume restores every tenant (windows
+    still open at drain included — zero lost windows)."""
+    cfg = _cfg(state_dir=str(tmp_path / "st"), window_us=20e6,
+               overlap_us=4e6, pump_windows=1)
+    svc = TenantService(cfg)
+    svc.ingest("a", hotel_payload(prefix="a", spacing_us=5e6))
+    svc.ingest("b", hotel_payload(n_traces=12, prefix="b", spacing_us=5e6))
+    pre = {tid: svc.tenant(tid).svc.consumed for tid in ("a", "b")}
+    open_windows = {tid: len(svc.tenant(tid).svc.windower.open)
+                    for tid in ("a", "b")}
+    assert any(v > 0 for v in open_windows.values())
+    drained = svc.drain()
+    assert drained["checkpointed"] == 2 and drained["timed_out"] == 0
+
+    resumed = TenantService.resume(cfg)
+    assert sorted(resumed.tenants) == ["a", "b"]
+    for tid in ("a", "b"):
+        t = resumed.tenant(tid)
+        assert t.svc.consumed == pre[tid]
+        assert len(t.svc.windower.open) == open_windows[tid]
+    out = resumed.flush()  # the checkpointed open windows still solve
+    assert out["solved_windows"] > 0
+    resumed.drain()

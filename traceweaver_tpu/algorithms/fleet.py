@@ -192,6 +192,18 @@ class _Stats:
         with self._lock:
             self.d.setdefault(key, []).append(event)
 
+    def bucket(self, key: str, subkey: str, val: float = 1.0) -> None:
+        """Accumulate into a nested ``{subkey: count}`` dict under
+        ``key`` — the per-tenant ledger (``tenant_windows_packed`` etc.).
+        Dict-valued like ``note``'s lists, so numeric aggregators skip
+        it; only written when the serve layer actually tags items with
+        tenants, so no-tenant callers' stats dicts are unchanged."""
+        if self.d is None:
+            return
+        with self._lock:
+            d = self.d.setdefault(key, {})
+            d[subkey] = d.get(subkey, 0.0) + val
+
 
 def _as_stats(stats) -> _Stats:
     return stats if isinstance(stats, _Stats) else _Stats(stats)
@@ -234,7 +246,7 @@ class FleetItem:
     def __init__(self, svc, in_span_partitions, out_span_partitions,
                  true_assignments, dag=None,
                  method="MaxScoreBatchSubsetWithSkips", store=None,
-                 warm_dists=None):
+                 warm_dists=None, tenant=None):
         self.svc = svc
         self.in_span_partitions = in_span_partitions
         self.out_span_partitions = out_span_partitions
@@ -249,6 +261,15 @@ class FleetItem:
         # pass — the on-device EM refit is what the carried statistics
         # already are (stream/state.py CarriedState)
         self.warm_dists = warm_dists
+        # optional tenant id (the serve layer's shared-fleet tenancy,
+        # traceweaver_tpu/serve): a host-side id column carried through
+        # pack -> compaction -> decode so per-tenant window counts,
+        # straggler redispatches, and quarantines are attributable from
+        # the stats ledger alone. None (the default, and the only value
+        # every pre-serve caller produces) keeps the ledger and the
+        # dispatched programs byte-identical — the column never ships to
+        # the device.
+        self.tenant = tenant
 
 
 def _prepare(item: FleetItem, solver: WeaverTPU):
@@ -886,6 +907,16 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
     param_rows: Dict[str, List[np.ndarray]] = {k: [] for k in _TABLE_KEYS}
     per_item_pack = []
     param_idx = []
+    # tenancy id column (serve layer): per-window tenant indices into a
+    # group-local table, carried HOST-SIDE alongside the packed batch —
+    # pack tags it, compaction attributes straggler redispatches with it,
+    # decode attributes decoded windows with it. It never ships to the
+    # device, so the dispatched programs (and the no-tenant ledger) stay
+    # byte-identical to the pre-tenancy path.
+    tenant_table = sorted({item.tenant for _, item, *_ in group
+                           if item.tenant is not None})
+    tenant_of = {t: ti for ti, t in enumerate(tenant_table)}
+    tenant_idx: List[int] = []
     for p, (i, item, prep, windows, ranges, skip_caps, _, _) in enumerate(group):
         packed = pack_problem(
             prep["in_spans"], item.out_span_partitions, prep["out_eps"],
@@ -908,6 +939,9 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
         for key in param_rows:
             param_rows[key].append(a[key])
         param_idx.extend([p] * n_w)
+        tenant_idx.extend([tenant_of.get(item.tenant, -1)] * n_w)
+        if item.tenant is not None:
+            st.bucket("tenant_windows_packed", item.tenant, float(n_w))
         per_item_pack.append((i, item, prep, packed, n_w))
 
     batch = {k: np.concatenate(v, axis=0) for k, v in arrays_cat.items()}
@@ -957,7 +991,9 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
             st.add("fleet_dynamism_dispatches", 1.0)
     return dict(batch=batch, params=params, pidx=pidx,
                 window_rows=window_rows, window_valid=window_valid,
-                per_item_pack=per_item_pack, max_preds=_mp, max_succs=_ms)
+                per_item_pack=per_item_pack, max_preds=_mp, max_succs=_ms,
+                tenant_table=tenant_table,
+                tenant_col=np.asarray(tenant_idx, dtype=np.int32))
 
 
 def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
@@ -992,6 +1028,12 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
     """
     batch, params, pidx = pg["batch"], pg["params"], pg["pidx"]
     window_rows, window_valid = pg["window_rows"], pg["window_valid"]
+    # the host-side tenancy column rides the dispatch ticket so the
+    # compacted flow can attribute straggler redispatches per tenant;
+    # None whenever no item in the group is tenant-tagged (every
+    # pre-serve caller), keeping this flow untouched
+    tenant_table = pg.get("tenant_table") or None
+    tenant_col = pg.get("tenant_col") if tenant_table else None
     n_passes = spec.n_passes
     n_sweeps = hypers_common["n_sweeps"]
     hypers = dict(epsilon=hypers_common["epsilon"],
@@ -1019,6 +1061,14 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
         pidx = np.concatenate(
             [pidx, np.zeros(batch["in_start"].shape[0] - true_b,
                             dtype=pidx.dtype)])
+        if tenant_col is not None:
+            # mesh padding rows belong to no tenant (-1): they are
+            # all-invalid windows decoded by nobody, so they must not
+            # surface in anyone's redispatch attribution either
+            tenant_col = np.concatenate(
+                [tenant_col,
+                 np.full(batch["in_start"].shape[0] - true_b, -1,
+                         dtype=tenant_col.dtype)])
     t0 = time.perf_counter()
     # this flow's blocking time (compacted intermediate fetches), so
     # dispatch_s below stays pure launch/host time even when several
@@ -1028,7 +1078,8 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
         out = _solve_group_compacted(
             batch, pidx, params, _tables_of(params), window_rows,
             window_valid, n_passes, n_sweeps, warm, hypers, st,
-            mesh=mesh, flow_wait=flow_wait)
+            mesh=mesh, flow_wait=flow_wait,
+            tenant_col=tenant_col, tenant_table=tenant_table)
     else:
         if mesh is not None:
             import jax
@@ -1068,7 +1119,8 @@ def _tables_of(params: Dict) -> Tuple:
 
 
 def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
-                    mesh=None, flow_wait=None):
+                    mesh=None, flow_wait=None, tenant_col=None,
+                    tenant_table=None):
     """One solve pass as warm dispatch + compacted full redispatch.
 
     Returns the packed [B, E, W, 3+topk] output as a host array,
@@ -1125,6 +1177,16 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
     active = np.flatnonzero(~converged)
     st.add("compact_windows_total", float(converged.shape[0]))
     st.add("compact_windows_redispatched", float(active.size))
+    if tenant_col is not None and active.size:
+        # tenancy attribution of the straggler set: which tenant's
+        # windows are still burning redispatch cycles (the serve layer's
+        # per-tenant cost ledger; -1 rows are untagged/mesh padding)
+        ids, counts = np.unique(np.asarray(tenant_col)[active],
+                                return_counts=True)
+        for t_i, c in zip(ids, counts):
+            if t_i >= 0:
+                st.bucket("tenant_windows_redispatched",
+                          tenant_table[int(t_i)], float(c))
     if active.size == 0:
         return _fetch(out_warm, st, flow_wait)
 
@@ -1157,7 +1219,8 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
 
 def _solve_group_compacted(batch, pidx, params, tables, window_rows,
                            window_valid, n_passes, n_sweeps, warm, hypers,
-                           stats, mesh=None, flow_wait=None):
+                           stats, mesh=None, flow_wait=None,
+                           tenant_col=None, tenant_table=None):
     """Compacted replacement for one fused group dispatch: per-pass
     warm/redispatch compaction, with the two-pass EM's on-device refit as
     its own dispatch between the passes (same refit program
@@ -1167,7 +1230,8 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
     the donated window tensors safe to regather for the redispatch."""
     st = _as_stats(stats)
     out0 = _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, st,
-                           mesh=mesh, flow_wait=flow_wait)
+                           mesh=mesh, flow_wait=flow_wait,
+                           tenant_col=tenant_col, tenant_table=tenant_table)
     if n_passes == 1:
         return out0
     new_tables = refit_fleet_params(
@@ -1185,7 +1249,8 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
         new_tables = tuple(np.asarray(t) for t in new_tables)
     return _compacted_pass(batch, pidx, tables[:3] + tuple(new_tables),
                            n_sweeps, warm, hypers, st, mesh=mesh,
-                           flow_wait=flow_wait)
+                           flow_wait=flow_wait,
+                           tenant_col=tenant_col, tenant_table=tenant_table)
 
 
 def _decode_group(solver, pend, results, stats):
@@ -1205,6 +1270,10 @@ def _decode_group(solver, pend, results, stats):
     for i, item, prep, packed, n_w in per_item_pack:
         rows = o[row:row + n_w]
         row += n_w
+        if item.tenant is not None:
+            # tenancy column, decode end: packed == decoded per tenant is
+            # the conservation check the serve tests assert from stats
+            st.bucket("tenant_windows_decoded", item.tenant, float(n_w))
         assign = rows[..., 0]
         not_best = rows[..., 1].astype(bool)
         feas = rows[..., 2]

@@ -5,6 +5,7 @@ from traceweaver_tpu.ingest.jaeger import (  # noqa: F401
     MalformedSpan,
     load_corpus,
     parse_trace_file,
+    parse_trace_payload,
     time_ordered_trace_files,
 )
 from traceweaver_tpu.ingest.partition import (  # noqa: F401

@@ -345,6 +345,83 @@ def _assemble_trace(
 # Trace-level parsing (reference executor.py:755-793)
 # ---------------------------------------------------------------------------
 
+def parse_trace_payload(
+    payload: dict,
+    fix: int,
+    self_loop_map: Dict[str, List[str]],
+    service_loop_map: Dict[str, str],
+    strict: bool = False,
+    counters: Optional[Dict[str, int]] = None,
+) -> List[Optional[Tuple[str, Dict[SpanId, Span], Dict[str, str]]]]:
+    """Parse one Jaeger-JSON payload (``{"data": [...]}``) — the shared
+    core of :func:`parse_trace_file` and the serve layer's HTTP span
+    ingestion (``POST /api/v1/tenants/<id>/spans`` posts exactly this
+    shape, see docs/SERVING.md).
+
+    Returns one entry per ``data`` element: ``(trace_id, spans,
+    processes)`` for a rooted trace, or None when the trace was dropped
+    (time-containment violation in Alibaba mode, or no root span).
+    Malformed span records (missing ids/refs/timestamps, non-numeric
+    durations) are skipped and counted under
+    ``counters["malformed_spans"]`` — a dead-letter counter, never a
+    mid-stream crash; ``strict=True`` restores the raise.
+    """
+    if not isinstance(payload, dict) or not isinstance(
+            payload.get("data"), list):
+        raise MalformedSpan(
+            "payload is not a Jaeger-JSON trace object "
+            "({'data': [{traceID, spans, processes}]})")
+    results: List[Optional[Tuple[str, Dict[SpanId, Span],
+                                 Dict[str, str]]]] = []
+    for trace_json in payload["data"]:
+        try:
+            trace_id = trace_json["traceID"]
+            span_records = trace_json["spans"]
+        except (KeyError, TypeError):
+            if strict:
+                raise MalformedSpan(
+                    "trace object missing traceID/spans") from None
+            if counters is not None:
+                counters["malformed_traces"] = (
+                    counters.get("malformed_traces", 0) + 1)
+            results.append(None)
+            continue
+        records = []
+        for rec in span_records:
+            try:
+                records.append(_record_from_json(rec))
+            except MalformedSpan:
+                if strict:
+                    raise
+                if counters is not None:
+                    counters["malformed_spans"] = (
+                        counters.get("malformed_spans", 0) + 1)
+        raw_processes = {
+            pid: entry["serviceName"]
+            for pid, entry in trace_json.get("processes", {}).items()
+        }
+        assembled = _assemble_trace(records, fix, self_loop_map,
+                                    service_loop_map, raw_processes)
+        if assembled is None:
+            # Alibaba-mode time-containment violation: the trace is
+            # dropped (counted separately from rootless traces — the
+            # file loader treats a drop as poisoning its whole file)
+            if counters is not None:
+                counters["dropped_traces"] = (
+                    counters.get("dropped_traces", 0) + 1)
+            results.append(None)
+            continue
+        spans, processes, has_root = assembled
+        if not has_root:
+            if counters is not None:
+                counters["rootless_traces"] = (
+                    counters.get("rootless_traces", 0) + 1)
+            results.append(None)
+            continue
+        results.append((trace_id, spans, processes))
+    return results
+
+
 def parse_trace_file(
     path: str,
     fix: int,
@@ -364,35 +441,18 @@ def parse_trace_file(
     with open(path, "r") as f:
         payload = json.load(f)
 
-    results = []
-    processes: Dict[str, str] = {}
-    for trace_json in payload["data"]:
-        trace_id = trace_json["traceID"]
-        records = []
-        for rec in trace_json["spans"]:
-            try:
-                records.append(_record_from_json(rec))
-            except MalformedSpan:
-                if strict:
-                    raise
-                if counters is not None:
-                    counters["malformed_spans"] = (
-                        counters.get("malformed_spans", 0) + 1)
-        raw_processes = {
-            pid: entry["serviceName"]
-            for pid, entry in trace_json.get("processes", {}).items()
-        }
-        assembled = _assemble_trace(records, fix, self_loop_map,
-                                    service_loop_map, raw_processes)
-        if assembled is None:
-            return None
-        spans, processes, has_root = assembled
-        if has_root:
-            results.append((trace_id, spans))
-
+    c = counters if counters is not None else {}
+    dropped_before = c.get("dropped_traces", 0)
+    parsed = parse_trace_payload(payload, fix, self_loop_map,
+                                 service_loop_map, strict=strict,
+                                 counters=c)
+    if c.get("dropped_traces", 0) > dropped_before:
+        # a containment-dropped trace poisons its whole file (the
+        # reference's per-file semantics, executor.py:433-448)
+        return None
+    results = [p for p in parsed if p is not None]
     assert len(results) == 1, f"expected exactly one rooted trace in {path}"
-    trace_id, spans = results[0]
-    return trace_id, spans, processes
+    return results[0]
 
 
 # ---------------------------------------------------------------------------

@@ -12,11 +12,30 @@ src/query_engine/delay_culprit.py:19-28): over the ``e2e_*`` result pickles
 answered twice — once from ground-truth traces and once from the
 reconstruction — so reconstruction quality can be judged by whether the
 *query answers* agree, not just per-span accuracy.
+
+Two execution surfaces share this module:
+
+- **offline** (:func:`delay_culprit` / the ``query`` CLI subcommand):
+  the reference-shaped query over an ``e2e_*`` result pickle the batch
+  executor wrote, or over a JSONL file of emitted-trace records
+  (:func:`load_trace_records`);
+- **live** (:func:`live_delay_culprit`): the same bracket-then-attribute
+  query over the serve layer's in-memory ring of recently emitted traces
+  (``traceweaver_tpu/serve``, ``GET .../query/delay_culprit``) — the
+  paper's marquee use case running against a reconstruction service
+  instead of a result artifact. Attribution is by per-service mean
+  SELF time (span duration minus its children's durations), so a slow
+  downstream hop does not bill its whole subtree to the frontend.
+
+Empty inputs are legal everywhere: an empty bracket returns a counted
+zero-result (``empty: True``, ``worst_service: None``), never a crash —
+a tenant may be queried before its first window seals.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import pickle
 from typing import Dict, List, Optional, Tuple
 
@@ -68,6 +87,81 @@ def _worst_service(hops: Dict[int, List], all_spans=None):
     return best
 
 
+def live_delay_culprit(
+    records: List[dict],
+    percentile: float = 0.95,
+    after_us: Optional[float] = None,
+) -> dict:
+    """The live form of the query, over emitted-trace records.
+
+    ``records`` are the serve layer's ring records
+    (:func:`traceweaver_tpu.serve.ring.build_trace_records`): one dict per
+    reconstructed trace with ``e2e_us``, ``root_start_us``, and a
+    time-ordered ``spans`` list whose entries carry ``service``, ``kind``,
+    ``dur_us``, and ``self_us`` (duration minus children — the exclusive
+    time that makes "worst service" mean the service that *spent* the
+    latency, not the frontend that merely contained it).
+
+    Returns a counted zero-result (``empty: True``) for an empty bracket
+    instead of crashing — the query surface must tolerate a tenant whose
+    first window has not sealed yet.
+    """
+    usable = [r for r in records
+              if r.get("spans") and r.get("complete", True)]
+    ordered = sorted(usable, key=lambda r: float(r["e2e_us"]))
+    cut = int(percentile * len(ordered))
+    bracket = ordered[cut:]
+    if after_us is not None:
+        bracket = [r for r in bracket
+                   if float(r["root_start_us"]) > after_us]
+
+    per_service: Dict[str, List[float]] = {}
+    hops: Dict[int, List[float]] = {}
+    for rec in bracket:
+        for i, s in enumerate(rec["spans"]):
+            hops.setdefault(i, []).append(float(s["dur_us"]))
+            if s.get("kind") == "server":
+                per_service.setdefault(s["service"], []).append(
+                    float(s.get("self_us", s["dur_us"])))
+
+    service_means = {
+        svc: sum(v) / len(v) for svc, v in per_service.items() if v
+    }
+    worst_svc = max(service_means, key=service_means.get) \
+        if service_means else None
+    hop_means = {h: sum(v) / len(v) for h, v in hops.items() if v}
+    worst_hop = max(hop_means, key=hop_means.get) if hop_means else None
+    return {
+        "empty": not bracket,
+        "n_traces": len(usable),
+        "n_bracket": len(bracket),
+        "percentile": percentile,
+        "after_us": after_us,
+        "worst_service": worst_svc,
+        "worst_mean_self_us": (service_means[worst_svc]
+                               if worst_svc is not None else 0.0),
+        "per_service": {
+            svc: {"mean_self_us": service_means[svc],
+                  "n_spans": len(per_service[svc])}
+            for svc in sorted(service_means)
+        },
+        "worst_hop": ([worst_hop, hop_means[worst_hop]]
+                      if worst_hop is not None else [None, 0.0]),
+    }
+
+
+def load_trace_records(path: str) -> List[dict]:
+    """Read a JSONL file of emitted-trace records (one per line — the
+    serve ring's dump format), skipping blank lines."""
+    records = []
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
 def delay_culprit(
     e2e_pickle_path: str,
     percentile: float = 0.95,
@@ -102,6 +196,10 @@ def delay_culprit(
             "worst_pred": _worst_service(pred_hops),
             "n_true": len(true_bracket),
             "n_pred": len(pred_bracket),
+            # counted zero-result marker: an empty bracket (no complete
+            # traces, or a percentile/after filter that excludes all) is
+            # a legal answer, not an error
+            "empty": not true_bracket,
         }
         query_latency[method] = [
             [true_hops.get(i, []) for i in sorted(true_hops)],
@@ -116,20 +214,55 @@ def delay_culprit(
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
+        prog="python -m traceweaver_tpu.runtime.cli query",
         description="Identify the service contributing most delay to the "
-                    "hot path, from reconstructed vs true traces.")
-    p.add_argument("e2e_pickle", help="an e2e_* result pickle")
+                    "hot path, from reconstructed vs true traces "
+                    "(e2e_* result pickle) or from an emitted-trace "
+                    "JSONL record file (docs/SERVING.md).")
+    p.add_argument("traces", metavar="e2e_pickle|records.jsonl",
+                   help="an e2e_* result pickle, or a .jsonl file of "
+                        "emitted-trace records (the serve ring's format)")
     p.add_argument("--percentile", type=float, default=0.95)
     p.add_argument("--after_mus", type=float, default=None)
     p.add_argument("--out", default=None, help="write query_latency pickle")
     args = p.parse_args(argv)
-    results = delay_culprit(args.e2e_pickle, args.percentile, args.after_mus,
+
+    if args.traces.endswith((".jsonl", ".json")):
+        # offline form of the LIVE query: the paper's use case without a
+        # running server, straight off an emitted-trace record file
+        res = live_delay_culprit(load_trace_records(args.traces),
+                                 args.percentile, args.after_mus)
+        if res["empty"]:
+            print(f"{args.traces}: empty bracket "
+                  f"({res['n_traces']} traces, 0 in the "
+                  f"p{args.percentile * 100:g} bracket) — no culprit")
+            return 0
+        print(f"worst service: {res['worst_service']} "
+              f"(mean self {res['worst_mean_self_us']:.0f}µs over "
+              f"{res['n_bracket']} traces in the "
+              f"p{args.percentile * 100:g} bracket)")
+        for svc, r in res["per_service"].items():
+            print(f"  {svc}: mean self {r['mean_self_us']:.0f}µs "
+                  f"({r['n_spans']} spans)")
+        return 0
+
+    results = delay_culprit(args.traces, args.percentile, args.after_mus,
                             args.out)
+    if not results:
+        print(f"{args.traces}: no methods in the result pickle — "
+              "nothing to query")
+        return 0
     for method, r in results.items():
         wt, wp = r["worst_true"], r["worst_pred"]
+        if r.get("empty") or wt[0] is None:
+            print(f"{method}: empty bracket "
+                  f"[{r['n_pred']}/{r['n_true']} traces] — no culprit")
+            continue
         agree = "AGREE" if wt[0] == wp[0] else "DISAGREE"
+        wp_desc = (f"#{wp[0]} mean {wp[1]:.0f}µs" if wp[0] is not None
+                   else "none (no reconstructed traces in bracket)")
         print(f"{method}: worst hop (true) #{wt[0]} mean {wt[1]:.0f}µs | "
-              f"(pred) #{wp[0]} mean {wp[1]:.0f}µs -> {agree} "
+              f"(pred) {wp_desc} -> {agree} "
               f"[{r['n_pred']}/{r['n_true']} traces reconstructed]")
     return 0
 

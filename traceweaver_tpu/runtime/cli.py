@@ -239,6 +239,69 @@ def stream_main(argv) -> int:
     return 0
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    from traceweaver_tpu.runtime import knobs
+
+    p = argparse.ArgumentParser(
+        prog="python -m traceweaver_tpu.runtime.cli serve",
+        description="Multi-tenant reconstruction service: HTTP Jaeger-JSON "
+                    "span ingestion per tenant, shared fleet dispatches, "
+                    "live delay-culprit query API (docs/SERVING.md).")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=knobs.get_int("TW_SERVE_PORT"),
+                   help="listen port (TW_SERVE_PORT; 0 = ephemeral)")
+    p.add_argument("--state-dir", default=None,
+                   help="per-tenant sinks + checkpoints; with --resume, "
+                        "existing tenants resume from their checkpoints")
+    p.add_argument("--resume", action="store_true",
+                   help="resume every checkpointed tenant from --state-dir")
+    p.add_argument("--fix", type=int, default=5,
+                   help="ingest FIX mode for posted payloads (5 = Alibaba "
+                        "format, ingest every rooted trace)")
+    p.add_argument("--window_s", type=float, default=60.0)
+    p.add_argument("--overlap_s", type=float, default=5.0)
+    p.add_argument("--watermark_s", type=float, default=2.0)
+    p.add_argument("--grace_s", type=float, default=0.0)
+    p.add_argument("--max-tenants", type=int, default=None,
+                   help="tenant cap (default TW_SERVE_MAX_TENANTS)")
+    p.add_argument("--strict", action="store_true",
+                   help="malformed span records -> HTTP 400 instead of "
+                        "the skip-and-count dead-letter default")
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def serve_main(argv) -> int:
+    from traceweaver_tpu.serve import ServeConfig, TenantService, run_server
+
+    args = build_serve_parser().parse_args(argv)
+    cfg = ServeConfig(
+        window_us=args.window_s * 1e6,
+        overlap_us=args.overlap_s * 1e6,
+        ooo_bound_us=args.watermark_s * 1e6,
+        grace_us=args.grace_s * 1e6,
+        fix=args.fix,
+        strict=args.strict,
+        verbose=not args.quiet,
+        state_dir=args.state_dir,
+        max_tenants=args.max_tenants,
+    )
+    if args.resume:
+        if not (args.state_dir and os.path.isdir(args.state_dir)):
+            print(f"--resume: no state dir at {args.state_dir!r}",
+                  file=sys.stderr)
+            return 2
+        service = TenantService.resume(cfg)
+        if not args.quiet and service.tenants:
+            print("[serve] resumed %d tenant(s): %s"
+                  % (len(service.tenants),
+                     ", ".join(sorted(service.tenants))))
+    else:
+        service = TenantService(cfg)
+    run_server(service, args.host, args.port, verbose=not args.quiet)
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -247,6 +310,25 @@ def main(argv=None) -> int:
     from traceweaver_tpu.runtime import knobs
 
     knobs.warn_unknown()
+    if argv and argv[0] == "query":
+        # offline delay-culprit query (the paper's marquee use case,
+        # docs/SERVING.md): no JAX backend needed — pure host analytics
+        # over an e2e_* result pickle or an emitted-trace JSONL file
+        from traceweaver_tpu.query.delay_culprit import main as query_main
+
+        return query_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # network service mode: same backend discipline as `stream`
+        import jax
+
+        if os.environ.get("TW_BACKEND", "cpu") == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        from traceweaver_tpu.runtime.jax_cache import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
+        return serve_main(argv[1:])
     if argv and argv[0] == "stream":
         # online mode rides its own subcommand; the bare flag surface
         # below stays byte-compatible with the reference executor CLI

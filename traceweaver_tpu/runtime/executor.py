@@ -298,6 +298,18 @@ def _solve_fleet_method(cfg: ExecutorConfig, store: TraceStore, method: str,
               "(TW_PIPELINE=0 restores the serial flow)"
               % (method, int(fleet_stats["pipeline_groups"]),
                  int(fleet_stats.get("pipeline_depth", 0))))
+    tenant_packed = fleet_stats.get("tenant_windows_packed")
+    if tenant_packed:
+        # tenancy ledger (serve layer: tenant-tagged FleetItems rode this
+        # dispatch): per-tenant packed/decoded window buckets, plus any
+        # straggler redispatches the compaction attributed. Batch runs
+        # never tag tenants, so this line cannot appear in classic mode.
+        redisp = fleet_stats.get("tenant_windows_redispatched", {})
+        print("[fleet] %s: tenancy — %s"
+              % (method, ", ".join(
+                  "%s: %d windows (%d redispatched)"
+                  % (t, int(n), int(redisp.get(t, 0)))
+                  for t, n in sorted(tenant_packed.items()))))
     if fleet_stats.get("fault_retries") or fleet_stats.get("fault_quarantined"):
         # the solve survived real (or injected) device faults — say how
         # far down the degradation ladder it had to walk

@@ -192,19 +192,32 @@ def reset() -> None:
 
 
 @contextmanager
-def override(spec: str, seed: int = 0):
-    """Force a fault plan for the duration of the context, regardless of
-    the env (the bench chaos leg uses this so one process can run a
-    faulted and an unfaulted leg side by side). Yields the plan so the
-    caller can read its injection counters afterwards."""
+def override_plan(plan: Optional["FaultPlan"]):
+    """Force an EXISTING fault plan for the duration of the context.
+
+    Unlike :func:`override` (which parses a fresh plan, re-seeding the
+    RNG), this keeps the plan's draw position and injection counters
+    across entries — the serve layer's per-tenant fault storms re-enter
+    every pump with ONE persistent plan, so a ``p=0.5`` storm actually
+    fires on roughly half its draws instead of replaying the same first
+    draw forever."""
     global _OVERRIDE
-    plan = parse_faults(spec, seed=seed)
     prev = _OVERRIDE
     _OVERRIDE = plan
     try:
         yield plan
     finally:
         _OVERRIDE = prev
+
+
+@contextmanager
+def override(spec: str, seed: int = 0):
+    """Force a fault plan for the duration of the context, regardless of
+    the env (the bench chaos leg uses this so one process can run a
+    faulted and an unfaulted leg side by side). Yields the plan so the
+    caller can read its injection counters afterwards."""
+    with override_plan(parse_faults(spec, seed=seed)) as plan:
+        yield plan
 
 
 def maybe_fail(site: str) -> None:

@@ -93,6 +93,25 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        help="bounded redispatch retries before the ladder bisects"),
     _k("TW_RETRY_BACKOFF_S", "float", 0.02, lo=0.0, hi=30.0,
        help="base of the exponential retry backoff (seconds)"),
+    # --- serve: multi-tenant reconstruction service ----------------------
+    _k("TW_SERVE_PORT", "int", 8321, lo=0, hi=65535,
+       help="HTTP ingestion/query port (0 = ephemeral, the test mode)"),
+    _k("TW_SERVE_MAX_TENANTS", "int", 100, lo=1,
+       help="tenant cap; past it span POSTs for NEW tenants are refused"),
+    _k("TW_SERVE_PENDING", "int", 4, lo=1,
+       help="per-tenant sealed-window pending bound (backpressure: past "
+            "it windows spill, then shed with accounting)"),
+    _k("TW_SERVE_SPILL", "int", 64, lo=0,
+       help="per-tenant spill-queue bound before windows are shed"),
+    _k("TW_SERVE_RING", "int", 512, lo=1,
+       help="per-tenant emitted-trace ring capacity (the live query "
+            "window)"),
+    _k("TW_SERVE_DRAIN_S", "float", 30.0, lo=0.0,
+       help="graceful-drain budget: checkpoint-all-tenants time box on "
+            "SIGTERM before the process exits anyway"),
+    _k("TW_SERVE_PUMP_WINDOWS", "int", 8, lo=1,
+       help="auto-pump threshold: solve once this many sealed windows "
+            "are queued across tenants (flush forces it)"),
     # --- bench orchestration ---------------------------------------------
     _k("TW_BENCH_SUBSET", "int", 25, lo=1, help="subset spans per service"),
     _k("TW_BENCH_EXACT_ALARM", "int", 95, lo=1,

@@ -1,0 +1,43 @@
+"""Multi-tenant reconstruction service: the network-facing layer.
+
+The stream package (``traceweaver_tpu/stream``) made the batch solver an
+online service for ONE application; this package makes it a *service* —
+the ROADMAP's "heavy traffic from millions of users" precondition:
+
+- :mod:`tenancy` — per-tenant reconstruction pipelines (watermark,
+  windows, live store, carried warm-start state, sink/dead-letter,
+  emitted-trace ring) multiplexed into **shared** fleet dispatches: the
+  packer already batches ``[B, E, W, M]`` blocks across services, so
+  tenancy is one more id column carried through pack/compaction/decode
+  (``FleetItem.tenant``). Per-tenant backpressure (pending bound ->
+  spill -> counted shed), per-tenant quarantine/dead-letter accounting,
+  and isolated dispatches for fault-storming tenants keep one tenant's
+  trouble out of its neighbors' throughput.
+- :mod:`http` — the stdlib ``ThreadingHTTPServer`` front door: Jaeger-
+  JSON span POSTs per tenant (reusing the batch loader's parse + its
+  malformed-span dead-letter path), a live delay-culprit query API over
+  each tenant's ring of recently emitted traces, trace fetch/list,
+  stats, and graceful SIGTERM drain (checkpoint every tenant).
+- :mod:`ring` — the bounded per-tenant trace ring the query surface
+  reads.
+
+CLI: ``python -m traceweaver_tpu.runtime.cli serve --port 8321
+--state-dir state/`` (docs/SERVING.md has the endpoint reference, knob
+table, and a curl quickstart).
+"""
+
+from traceweaver_tpu.serve.ring import (  # noqa: F401
+    TraceRing,
+    build_trace_records,
+)
+from traceweaver_tpu.serve.tenancy import (  # noqa: F401
+    ServeConfig,
+    TenancyError,
+    Tenant,
+    TenantService,
+)
+from traceweaver_tpu.serve.http import (  # noqa: F401
+    ReconstructionServer,
+    make_server,
+    run_server,
+)

@@ -1,0 +1,233 @@
+"""HTTP front door for the multi-tenant reconstruction service.
+
+Stdlib only (``http.server.ThreadingHTTPServer`` — no new dependencies):
+span ingestion is a Jaeger-JSON POST per tenant, queries are GETs over
+the tenant's emitted-trace ring. One handler thread per connection; all
+state mutation happens inside :class:`TenantService`'s lock.
+
+Endpoint reference (full table + curl quickstart in docs/SERVING.md)::
+
+    POST /api/v1/tenants/<id>/spans                Jaeger-JSON {"data": [...]}
+    POST /api/v1/tenants/<id>/flush                seal+solve now (one tenant)
+    POST /api/v1/flush                             seal+solve now (all)
+    GET  /api/v1/tenants                           tenant list
+    GET  /api/v1/tenants/<id>/traces               recent trace ids (ring)
+    GET  /api/v1/tenants/<id>/traces/<trace_id>    one reconstructed trace
+    GET  /api/v1/tenants/<id>/query/delay_culprit  ?percentile=&after_us=
+    GET  /api/v1/tenants/<id>/stats                per-tenant ledger
+    GET  /api/v1/stats                             service-wide ledger
+    GET  /healthz                                  liveness
+
+Error mapping: bad JSON / malformed payloads (strict mode) -> 400,
+unknown tenant or trace -> 404, tenant cap / invalid tenant id -> 429 /
+400 (:class:`TenancyError`), everything else -> 500 with the exception
+name (never a silent hang).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from traceweaver_tpu.ingest.jaeger import MalformedSpan
+from traceweaver_tpu.serve.tenancy import TenancyError, TenantService
+
+_TENANT_PATH = re.compile(r"^/api/v1/tenants/([^/]+)(/.*)?$")
+
+#: request body cap (64 MB): a runaway POST must not OOM the service
+MAX_BODY_BYTES = 64 << 20
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    """Routes requests onto the owning :class:`TenantService`."""
+
+    server_version = "traceweaver-serve/1.0"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ---------------------------------------------------------
+    @property
+    def service(self) -> TenantService:
+        return self.server.service  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # noqa: D102 — quiet by default
+        if self.service.cfg.verbose:
+            super().log_message(fmt, *args)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload, sort_keys=True).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._reply(code, {"error": message})
+
+    def _read_json(self) -> Optional[dict]:
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            self._error(400, "bad Content-Length")
+            return None
+        if length > MAX_BODY_BYTES:
+            self._error(413, f"body exceeds {MAX_BODY_BYTES} bytes")
+            return None
+        raw = self.rfile.read(length) if length else b""
+        if not raw:
+            self._error(400, "empty body (expected Jaeger JSON)")
+            return None
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            self._error(400, f"invalid JSON: {e}")
+            return None
+
+    def _tenant_route(self) -> Tuple[Optional[str], str, dict]:
+        """(tenant_id | None, subpath, query) of the request path."""
+        parsed = urlparse(self.path)
+        query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+        m = _TENANT_PATH.match(parsed.path)
+        if m:
+            return m.group(1), (m.group(2) or ""), query
+        return None, parsed.path, query
+
+    # -- verbs ------------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        tenant_id, sub, _query = self._tenant_route()
+        try:
+            if tenant_id is not None and sub == "/spans":
+                payload = self._read_json()
+                if payload is None:
+                    return
+                self._reply(200, self.service.ingest(tenant_id, payload))
+            elif tenant_id is not None and sub == "/flush":
+                self.service.tenant(tenant_id, create=False)
+                self._reply(200, self.service.flush(tenant_id))
+            elif tenant_id is None and sub == "/api/v1/flush":
+                self._reply(200, self.service.flush())
+            else:
+                self._error(404, f"no such endpoint: POST {sub or self.path}")
+        except TenancyError as e:
+            self._error(429 if "cap" in str(e) else 400, str(e))
+        except MalformedSpan as e:
+            self._error(400, f"malformed payload: {e}")
+        except KeyError:
+            self._error(404, f"unknown tenant {tenant_id!r}")
+        except Exception as e:  # noqa: BLE001 — the 500 surface
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_GET(self) -> None:  # noqa: N802
+        tenant_id, sub, query = self._tenant_route()
+        try:
+            if tenant_id is None:
+                if sub == "/healthz":
+                    self._reply(200, {"ok": True,
+                                      "tenants": len(self.service.tenants)})
+                elif sub == "/api/v1/stats":
+                    self._reply(200, self.service.stats())
+                elif sub == "/api/v1/tenants":
+                    self._reply(200, {
+                        "tenants": sorted(self.service.tenants)})
+                else:
+                    self._error(404, f"no such endpoint: GET {self.path}")
+                return
+            if sub == "/stats":
+                self._reply(200, self.service.stats(tenant_id))
+            elif sub == "/traces":
+                ids = self.service.trace_ids(tenant_id)
+                limit = int(query.get("limit", "100"))
+                self._reply(200, {"n_traces": len(ids),
+                                  "trace_ids": ids[-limit:]})
+            elif sub.startswith("/traces/"):
+                trace_id = sub[len("/traces/"):]
+                rec = self.service.trace(tenant_id, trace_id)
+                if rec is None:
+                    self._error(404, f"trace {trace_id!r} not in the ring")
+                else:
+                    self._reply(200, rec)
+            elif sub == "/query/delay_culprit":
+                percentile = float(query.get("percentile", "0.95"))
+                after = query.get("after_us")
+                self._reply(200, self.service.query_delay_culprit(
+                    tenant_id, percentile,
+                    float(after) if after is not None else None))
+            else:
+                self._error(404, f"no such endpoint: GET {sub}")
+        except KeyError:
+            self._error(404, f"unknown tenant {tenant_id!r}")
+        except ValueError as e:
+            self._error(400, str(e))
+        except Exception as e:  # noqa: BLE001
+            self._error(500, f"{type(e).__name__}: {e}")
+
+
+class ReconstructionServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer bound to one :class:`TenantService`."""
+
+    daemon_threads = True
+
+    def __init__(self, service: TenantService, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.service = service
+        super().__init__((host, port), ServeHandler)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+
+def make_server(service: TenantService, host: str = "127.0.0.1",
+                port: int = 0) -> ReconstructionServer:
+    """Bind (port 0 = ephemeral, the test mode). Call ``serve_forever``
+    on a thread; the tier-1 smoke does exactly that."""
+    return ReconstructionServer(service, host, port)
+
+
+def run_server(service: TenantService, host: str, port: int,
+               verbose: bool = True) -> dict:
+    """The CLI's blocking entry: serve until SIGTERM/SIGINT, then
+    gracefully drain — stop accepting, checkpoint every tenant within
+    the drain budget (``TW_SERVE_DRAIN_S``), close sinks. Returns the
+    drain summary."""
+    server = make_server(service, host, port)
+    stop = threading.Event()
+
+    def _signal(signum, _frame):
+        if verbose:
+            print(f"[serve] signal {signum}: draining "
+                  f"({service.cfg.drain_timeout_s:.0f}s budget)")
+        stop.set()
+        # shutdown() must run off the serve_forever thread
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    prev = {s: signal.signal(s, _signal)
+            for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        if verbose:
+            print(f"[serve] listening on http://{host}:{server.port} "
+                  f"(max {service.cfg.max_tenants} tenants, "
+                  f"prec={service.precision}) — "
+                  "POST /api/v1/tenants/<id>/spans")
+        server.serve_forever(poll_interval=0.2)
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+        server.server_close()
+    summary = service.drain()
+    if verbose:
+        st = service.stats()
+        print("[serve] drained: %d tenants checkpointed, %d skipped, "
+              "%d past the drain budget; %d windows solved in %d shared "
+              "+ %d isolated fleet calls"
+              % (summary["checkpointed"], summary["skipped"],
+                 summary["timed_out"],
+                 st["dispatch"]["pumped_windows"],
+                 st["dispatch"]["shared_solves"],
+                 st["dispatch"]["isolated_solves"]))
+    return summary

@@ -1,0 +1,623 @@
+"""Shared-fleet tenancy: many tenants' windows, one dispatch stream.
+
+The stream subsystem is a single-app pipeline; this module multiplexes N
+of them. Each tenant owns a full per-tenant reconstruction pipeline —
+watermark, windowing engine, live span store, carried warm-start
+statistics, sink/dead-letter files, bounded emitted-trace ring — wrapped
+around an externally-pumped
+:class:`~traceweaver_tpu.stream.service.StreamingReconstructor`. What
+tenants SHARE is the device: the :class:`TenantService` pump collects
+every healthy tenant's sealed-window batches, builds their
+:class:`~traceweaver_tpu.algorithms.fleet.FleetItem` lists (tagged with
+the tenant id — the id column fleet's pack/compaction/decode carries),
+and rides them all through ONE :func:`solve_fleet` call, so tenants with
+similar window geometry land in the same padded shape class and the
+dispatch count stays O(shape classes), not O(tenants) — the whole point
+of serving from a fleet (the ``fleet_dispatches`` ledger proves it:
+fewer dispatch groups than a tenant-serial loop, tests/test_serve.py).
+
+Isolation is explicit, per tenant:
+
+- **backpressure**: each tenant has its own pending bound -> spill queue
+  -> counted shed (``TW_SERVE_PENDING`` / ``TW_SERVE_SPILL``); one
+  tenant's ingest burst fills one tenant's queues;
+- **fault storms**: a tenant with a ``fault_spec`` (or one the
+  supervisor quarantines repeatedly) solves in its OWN dispatches under
+  :func:`faults.override`, so its retries/bisections/quarantines never
+  occupy the shared dispatch stream — neighbors keep their throughput
+  (the bench ``--serve-tenants`` isolation leg measures exactly this);
+- **quarantine/dead-letter accounting**: a quarantined window
+  dead-letters into its OWN tenant's sidecar and counters, preserving
+  per-tenant conservation (emitted + dead-lettered == sealed windows);
+- **checkpoints**: per-tenant files under ``state_dir/<tenant>/``;
+  graceful drain checkpoints every tenant (time-boxed by
+  ``TW_SERVE_DRAIN_S``) and a restarted service resumes all of them with
+  zero lost windows — still-open window buffers ride the checkpoint, so
+  nothing depends on a replayable source (HTTP ingest has none).
+
+See docs/SERVING.md for the operator view and the HTTP surface
+(:mod:`traceweaver_tpu.serve.http`).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from traceweaver_tpu.ingest.jaeger import FIX_ROOT_OPS, parse_trace_payload
+from traceweaver_tpu.ops.precision import precision_from_env
+from traceweaver_tpu.query.delay_culprit import live_delay_culprit
+from traceweaver_tpu.runtime import knobs
+from traceweaver_tpu.serve.ring import TraceRing, build_trace_records
+from traceweaver_tpu.stream.checkpoint import load_checkpoint, save_checkpoint
+from traceweaver_tpu.stream.service import (
+    StreamConfig,
+    StreamingReconstructor,
+    TraceSink,
+)
+from traceweaver_tpu.stream.sources import SpanEvent
+
+_TENANT_ID_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
+
+
+class TenancyError(ValueError):
+    """A tenancy-layer refusal (bad tenant id, tenant cap reached) — the
+    HTTP layer maps these to 4xx responses instead of 500s."""
+
+
+@dataclass
+class ServeConfig:
+    """Multi-tenant service knobs. ``None`` fields resolve from the
+    ``TW_SERVE_*`` registry (:mod:`traceweaver_tpu.runtime.knobs`) at
+    construction, so a typo'd env value raises at startup, not
+    mid-serve."""
+
+    # per-tenant stream geometry (event-time microseconds)
+    window_us: float = 60e6
+    overlap_us: float = 5e6
+    ooo_bound_us: float = 2e6
+    grace_us: float = 0.0
+    fix: int = 5                   # ingest FIX mode for posted payloads
+    strict: bool = False           # malformed span records raise (HTTP 400)
+    warm_start: bool = True
+    verbose: bool = False
+    state_dir: Optional[str] = None  # per-tenant sinks + checkpoints
+    checkpoint_every: int = 8
+    # tenancy bounds; None -> TW_SERVE_* knob defaults
+    max_tenants: Optional[int] = None
+    max_pending: Optional[int] = None
+    spill_max: Optional[int] = None
+    ring_size: Optional[int] = None
+    drain_timeout_s: Optional[float] = None
+    pump_windows: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if self.max_tenants is None:
+            self.max_tenants = knobs.get_int("TW_SERVE_MAX_TENANTS")
+        if self.max_pending is None:
+            self.max_pending = knobs.get_int("TW_SERVE_PENDING")
+        if self.spill_max is None:
+            self.spill_max = knobs.get_int("TW_SERVE_SPILL")
+        if self.ring_size is None:
+            self.ring_size = knobs.get_int("TW_SERVE_RING")
+        if self.drain_timeout_s is None:
+            self.drain_timeout_s = knobs.get_float("TW_SERVE_DRAIN_S")
+        if self.pump_windows is None:
+            self.pump_windows = knobs.get_int("TW_SERVE_PUMP_WINDOWS")
+
+
+class Tenant:
+    """One tenant's full reconstruction pipeline (never shared)."""
+
+    def __init__(self, tenant_id: str, cfg: ServeConfig) -> None:
+        if not _TENANT_ID_RE.fullmatch(tenant_id):
+            raise TenancyError(
+                f"invalid tenant id {tenant_id!r}: expected "
+                "[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
+        self.id = tenant_id
+        self.cfg = cfg
+        self.dir = (os.path.join(cfg.state_dir, tenant_id)
+                    if cfg.state_dir else None)
+        self.ckpt_path = (os.path.join(self.dir, "ckpt.pkl")
+                          if self.dir else None)
+        sink = (TraceSink(os.path.join(self.dir, "traces.jsonl"))
+                if self.dir else None)
+        stream_cfg = StreamConfig(
+            window_us=cfg.window_us, overlap_us=cfg.overlap_us,
+            ooo_bound_us=cfg.ooo_bound_us, grace_us=cfg.grace_us,
+            max_pending=cfg.max_pending, spill_max=cfg.spill_max,
+            solve_min_batch=1, warm_start=cfg.warm_start,
+            grade=False, prune=True,
+            # the TENANT owns checkpointing (its checkpoint wraps the
+            # service state with ring/counter bookkeeping), so the inner
+            # service's own cadence is disabled
+            checkpoint_path=None,
+            verbose=cfg.verbose,
+        )
+        self.svc = StreamingReconstructor(None, stream_cfg, sink=sink)
+        self.ring = TraceRing(cfg.ring_size)
+        # Alibaba self-loop remap state must be stable across payloads
+        # (and across a resume) exactly like the batch loader's
+        # per-corpus map — it rides the tenant checkpoint
+        self._self_loop_map: Dict[str, List[str]] = {}
+        self.ingest_counters: Dict[str, int] = {}
+        self.counters: Dict[str, float] = {}
+        # per-tenant fault spec: a tenant under a configured fault storm
+        # (or operator quarantine) solves in ISOLATED dispatches so its
+        # ladder walks cannot slow the shared stream. The parsed plan is
+        # cached so draw position/injection counters persist across
+        # pumps (a fresh seeded plan per pump would replay the same
+        # first draw forever).
+        self.fault_spec: Optional[str] = None
+        self._fault_plan = None
+        self._fault_plan_spec: Optional[str] = None
+        # per-tenant fleet ledger for isolated solves (the shared solve
+        # ledgers on the manager, attributed via the tenant id column)
+        self.fleet_stats: Dict[str, float] = {}
+
+    # -- ingestion --------------------------------------------------------
+    def ingest_payload(self, payload: dict) -> Dict[str, int]:
+        """Fold one posted Jaeger-JSON payload into the tenant's stream.
+
+        Reuses the batch loader's parse pipeline
+        (:func:`parse_trace_payload`) including its malformed-span
+        dead-letter path; applies the FIX mode's root-operation filter
+        (rejected-and-counted, same rule as ``ingest_trace``); then
+        feeds every span as an arrival-ordered event through watermark ->
+        windowing -> scheduler, exactly the stream service's loop body.
+        """
+        self._bump("posts")
+        parsed = parse_trace_payload(
+            payload, self.cfg.fix, self._self_loop_map,
+            self.svc.live.service_loop_map, strict=self.cfg.strict,
+            counters=self.ingest_counters)
+        root_op = FIX_ROOT_OPS[self.cfg.fix]
+        n_traces = n_spans = rejected = 0
+        for entry in parsed:
+            if entry is None:
+                continue
+            trace_id, spans, processes = entry
+            root = next((s for s in spans.values() if s.IsRoot()), None)
+            if root is None or (root_op is not None
+                                and root.op_name != root_op):
+                rejected += 1
+                continue
+            n_traces += 1
+            ordered = sorted(spans.values(),
+                             key=lambda s: (float(s.start_mus), s.sid))
+            for span in ordered:
+                self._ingest_event(SpanEvent(
+                    span=span, event_us=float(span.start_mus),
+                    arrival_us=float(span.start_mus), trace_id=trace_id,
+                    processes=processes))
+                n_spans += 1
+        self._bump("ingested_traces", n_traces)
+        self._bump("ingested_spans", n_spans)
+        self._bump("rejected_traces", rejected)
+        return dict(
+            ingested_traces=n_traces,
+            ingested_spans=n_spans,
+            rejected_traces=rejected,
+            malformed_spans=self.ingest_counters.get("malformed_spans", 0),
+            backlog=self.backlog,
+        )
+
+    def _ingest_event(self, ev: SpanEvent) -> None:
+        svc = self.svc
+        svc.consumed += 1
+        svc.watermark.observe(ev.event_us)
+        span = svc.live.add(ev)
+        svc.windower.add(span, ev.event_us)
+        sealed = svc.windower.poll(svc.watermark.value)
+        for buf in sealed:
+            svc.scheduler.offer(buf)
+        if sealed and svc.cfg.prune:
+            self._prune()
+
+    def _prune(self) -> None:
+        # same retention rule as the stream run loop: two windows behind
+        # the watermark, never past the oldest backlog window
+        svc = self.svc
+        backlog = list(svc.scheduler.pending) + list(svc.scheduler.spill)
+        oldest = min((b.start_us for b in backlog),
+                     default=svc.watermark.value)
+        horizon = min(svc.watermark.value - 2 * svc.cfg.window_us,
+                      oldest - svc.cfg.window_us) - svc.cfg.grace_us
+        svc.live.prune(horizon)
+
+    def flush(self) -> int:
+        """Seal every still-open window (without poisoning future event
+        times: the sealing frontier advances only to the last open
+        window's end, not to infinity) and queue them for the next pump.
+        Returns how many windows were sealed."""
+        svc = self.svc
+        if not svc.windower.open:
+            return 0
+        frontier = max(b.end_us for b in svc.windower.open.values()) \
+            + svc.windower.grace_us
+        sealed = svc.windower.poll(frontier)
+        for buf in sealed:
+            svc.scheduler.offer(buf)
+        return len(sealed)
+
+    # -- solve plumbing (driven by the TenantService pump) ----------------
+    @property
+    def backlog(self) -> int:
+        return self.svc.scheduler.backlog
+
+    def pop_batch(self) -> List:
+        """Take the next micro-batch off the tenant's queues (the
+        scheduler's own refill-from-spill pump rule)."""
+        return self.svc.scheduler.pop_batch()
+
+    def emit_results(self, results) -> None:
+        """Emit one batch's solved windows: sink/dead-letter via the
+        stream service's own emission path, plus ring insertion for the
+        live query surface and per-tenant quarantine accounting."""
+        for res in results:
+            self.svc._emit(res)
+            if res.poisoned:
+                self._bump("quarantined_windows")
+                self._bump("quarantined_services",
+                           max(1, len(res.quarantined_services)))
+                continue
+            for rec in build_trace_records(res.traces, self.svc.live,
+                                           res.buf.k):
+                self.ring.add(rec)
+        self.svc.scheduler.solved_windows += len(results)
+
+    # -- checkpoint / resume ----------------------------------------------
+    def checkpoint(self) -> bool:
+        """Write this tenant's checkpoint (service state + ring +
+        tenancy counters). Same failure tolerance as the stream service:
+        a failed write is counted and the last good generation stays."""
+        if not self.ckpt_path:
+            return False
+        state = self.svc.state_dict()
+        state["serve"] = dict(
+            tenant=self.id,
+            ring=self.ring.records(),
+            ring_evicted=self.ring.evicted,
+            counters=dict(self.counters),
+            ingest_counters=dict(self.ingest_counters),
+            self_loop_map={k: list(v)
+                           for k, v in self._self_loop_map.items()},
+            fault_spec=self.fault_spec,
+            fleet_stats=dict(self.fleet_stats),
+        )
+        try:
+            save_checkpoint(self.ckpt_path, state)
+        except (OSError, RuntimeError) as e:
+            from traceweaver_tpu.runtime import faults
+
+            if not (isinstance(e, (OSError, faults.FaultError))
+                    or faults.is_transient_fault(e)):
+                raise
+            self._bump("checkpoint_failures")
+            return False
+        self.svc._since_checkpoint = 0
+        return True
+
+    @classmethod
+    def resume(cls, tenant_id: str, cfg: ServeConfig) -> "Tenant":
+        tenant = cls(tenant_id, cfg)
+        state = load_checkpoint(tenant.ckpt_path)
+        if state.pop("_recovered_from_prev", False):
+            tenant._bump("checkpoint_recovered")
+        tenant.svc.apply_state(state)
+        serve = state.get("serve", {})
+        tenant.ring.load(serve.get("ring", []))
+        tenant.ring.evicted = serve.get("ring_evicted", 0)
+        tenant.counters.update(serve.get("counters", {}))
+        tenant.ingest_counters.update(serve.get("ingest_counters", {}))
+        tenant._self_loop_map.update(serve.get("self_loop_map", {}))
+        tenant.fault_spec = serve.get("fault_spec")
+        tenant.fleet_stats.update(serve.get("fleet_stats", {}))
+        return tenant
+
+    def fault_plan(self):
+        """The tenant's persistent parsed fault plan (None when no storm
+        is configured); rebuilt only when ``fault_spec`` changes."""
+        from traceweaver_tpu.runtime import faults
+
+        if self._fault_plan_spec != self.fault_spec:
+            self._fault_plan = (
+                faults.parse_faults(self.fault_spec,
+                                    seed=knobs.get_int("TW_FAULTS_SEED"))
+                if self.fault_spec else None)
+            self._fault_plan_spec = self.fault_spec
+        return self._fault_plan
+
+    def close(self) -> None:
+        if self.svc.sink is not None:
+            self.svc.sink.close()
+        if self.svc.deadletter is not None:
+            self.svc.deadletter.close()
+
+    # -- accounting -------------------------------------------------------
+    def _bump(self, key: str, n: float = 1) -> None:
+        self.counters[key] = self.counters.get(key, 0) + n
+
+    def stats(self) -> Dict:
+        svc = self.svc
+        sched = svc.scheduler
+        return dict(
+            tenant=self.id,
+            consumed=svc.consumed,
+            emitted_windows=svc.emitted_windows,
+            spans_emitted=int(svc.stats.get("spans_emitted", 0)),
+            traces_emitted=int(svc.stats.get("traces_emitted", 0)),
+            backlog=sched.backlog,
+            solved_windows=sched.solved_windows,
+            shed_spilled=sched.shed_spilled,
+            shed_dropped_windows=sched.shed_dropped_windows,
+            shed_dropped_spans=sched.shed_dropped_spans,
+            late_rerouted=svc.windower.late_rerouted,
+            late_dropped=svc.windower.late_dropped,
+            deadletter_windows=int(svc.stats.get("deadletter_windows", 0)),
+            deadletter_spans=int(svc.stats.get("deadletter_spans", 0)),
+            quarantined_windows=int(
+                self.counters.get("quarantined_windows", 0)),
+            ring_traces=len(self.ring),
+            ring_evicted=self.ring.evicted,
+            fault_spec=self.fault_spec,
+            counters=dict(self.counters),
+            ingest=dict(self.ingest_counters),
+            faults=dict(
+                retries=int(self.fleet_stats.get("fault_retries", 0)),
+                bisections=int(self.fleet_stats.get("fault_bisections", 0)),
+                xla_fallbacks=int(
+                    self.fleet_stats.get("fault_xla_fallbacks", 0)),
+                host_fallbacks=int(
+                    self.fleet_stats.get("fault_host_fallbacks", 0)),
+                quarantined=int(
+                    self.fleet_stats.get("fault_quarantined", 0)),
+                injected=int(self.fleet_stats.get("faults_injected", 0)),
+            ),
+        )
+
+
+class TenantService:
+    """The multi-tenant reconstruction service (the HTTP layer's model).
+
+    All public methods are thread-safe (ThreadingHTTPServer handlers call
+    in concurrently); one re-entrant lock serializes tenancy state and
+    solves — the device is a serially-dispatched resource anyway, and the
+    fleet call itself pipelines internally.
+    """
+
+    def __init__(self, cfg: Optional[ServeConfig] = None) -> None:
+        self.cfg = cfg or ServeConfig()
+        if self.cfg.state_dir:
+            os.makedirs(self.cfg.state_dir, exist_ok=True)
+        self.tenants: Dict[str, Tenant] = {}
+        self._lock = threading.RLock()
+        self.precision = precision_from_env()
+        # shared-dispatch ledger: every healthy tenant's windows ride the
+        # solve_fleet calls accounted here; the tenant id column breaks
+        # the totals down per tenant (tenant_windows_* buckets)
+        self.fleet_stats: Dict[str, float] = {}
+        self.stats_counters: Dict[str, float] = dict(
+            shared_solves=0, tenant_batches=0, isolated_solves=0,
+            pumped_windows=0, drain_timeouts=0)
+
+    # -- tenancy ----------------------------------------------------------
+    def tenant(self, tenant_id: str, create: bool = True) -> Tenant:
+        with self._lock:
+            t = self.tenants.get(tenant_id)
+            if t is None:
+                if not create:
+                    raise KeyError(tenant_id)
+                if len(self.tenants) >= self.cfg.max_tenants:
+                    raise TenancyError(
+                        f"tenant cap reached ({self.cfg.max_tenants}, "
+                        "TW_SERVE_MAX_TENANTS): refusing new tenant "
+                        f"{tenant_id!r}")
+                t = Tenant(tenant_id, self.cfg)
+                self.tenants[tenant_id] = t
+            return t
+
+    def ingest(self, tenant_id: str, payload: dict) -> Dict[str, int]:
+        """Ingest one payload for one tenant, auto-pumping once enough
+        sealed windows are queued across tenants (so concurrent tenants'
+        windows accumulate into SHARED dispatches instead of each POST
+        solving alone)."""
+        with self._lock:
+            summary = self.tenant(tenant_id).ingest_payload(payload)
+            if self.total_backlog() >= self.cfg.pump_windows:
+                summary["pumped_windows"] = self.pump()
+            return summary
+
+    def total_backlog(self) -> int:
+        with self._lock:
+            return sum(t.backlog for t in self.tenants.values())
+
+    # -- the shared pump --------------------------------------------------
+    def pump(self) -> int:
+        """Solve every queued micro-batch: healthy tenants merged into
+        shared fleet dispatches, fault-spec'd tenants in isolated
+        dispatches under their own fault plan. Returns windows solved."""
+        with self._lock:
+            shared: List[Tuple[Tenant, List]] = []
+            isolated: List[Tuple[Tenant, List]] = []
+            for tid in sorted(self.tenants):
+                t = self.tenants[tid]
+                batch = t.pop_batch()
+                while batch:
+                    (isolated if t.fault_spec else shared).append((t, batch))
+                    batch = t.pop_batch()
+            n = 0
+            if shared:
+                n += self._solve_shared(shared)
+            for t, batch in isolated:
+                n += self._solve_isolated(t, batch)
+            for tid in sorted(self.tenants):
+                t = self.tenants[tid]
+                if t.ckpt_path and \
+                        t.svc._since_checkpoint >= self.cfg.checkpoint_every:
+                    t.checkpoint()
+            self.stats_counters["pumped_windows"] += n
+            return n
+
+    def _solve_shared(self, batches: List[Tuple[Tenant, List]]) -> int:
+        from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+        t0 = time.perf_counter()
+        prepared = []
+        items: List = []
+        for t, bufs in batches:
+            per_buf, t_items, t_owners = t.svc.prepare_batch_items(
+                bufs, tenant=t.id)
+            lo = len(items)
+            items.extend(t_items)
+            prepared.append((t, bufs, per_buf, t_owners, lo, len(items)))
+        quarantined: List[int] = []
+        outs: List = []
+        if items:
+            outs = solve_fleet(items, stats=self.fleet_stats,
+                               precision=self.precision,
+                               quarantined=quarantined)
+        solve_s = time.perf_counter() - t0
+        self.stats_counters["shared_solves"] += 1
+        self.stats_counters["tenant_batches"] += len(batches)
+        n = 0
+        for t, bufs, per_buf, t_owners, lo, hi in prepared:
+            share = solve_s * (hi - lo) / max(1, len(items))
+            t.svc.stats["solve_s"] = t.svc.stats.get("solve_s", 0.0) + share
+            results = t.svc.consume_batch_results(
+                bufs, per_buf, t_owners, outs[lo:hi],
+                [k - lo for k in quarantined if lo <= k < hi], share)
+            t.emit_results(results)
+            n += len(bufs)
+        return n
+
+    def _solve_isolated(self, t: Tenant, bufs: List) -> int:
+        """One fault-spec'd tenant's batch in its own dispatch, under its
+        own injected fault plan — the storm walks the supervisor's ladder
+        inside THIS tenant's solve; neighbors never see it."""
+        from traceweaver_tpu.algorithms.fleet import solve_fleet
+        from traceweaver_tpu.runtime import faults
+
+        t0 = time.perf_counter()
+        per_buf, items, owners = t.svc.prepare_batch_items(bufs, tenant=t.id)
+        quarantined: List[int] = []
+        outs: List = []
+        if items:
+            with faults.override_plan(t.fault_plan()):
+                outs = solve_fleet(items, stats=t.fleet_stats,
+                                   precision=self.precision,
+                                   quarantined=quarantined)
+        solve_s = time.perf_counter() - t0
+        t.svc.stats["solve_s"] = t.svc.stats.get("solve_s", 0.0) + solve_s
+        self.stats_counters["isolated_solves"] += 1
+        results = t.svc.consume_batch_results(bufs, per_buf, owners, outs,
+                                              quarantined, solve_s)
+        t.emit_results(results)
+        return len(bufs)
+
+    # -- flush / drain / resume -------------------------------------------
+    def flush(self, tenant_id: Optional[str] = None) -> Dict[str, int]:
+        """Seal every open window (one tenant, or all) and pump — the
+        deterministic "solve what you have now" hook tests and the drain
+        path use."""
+        with self._lock:
+            targets = ([self.tenant(tenant_id, create=False)]
+                       if tenant_id else list(self.tenants.values()))
+            sealed = sum(t.flush() for t in targets)
+            solved = self.pump()
+            return dict(sealed_windows=sealed, solved_windows=solved)
+
+    def checkpoint_all(self,
+                       timeout_s: Optional[float] = None) -> Dict[str, int]:
+        """Checkpoint every tenant, time-boxed (``TW_SERVE_DRAIN_S``): a
+        drain must not hold SIGTERM forever — tenants past the box are
+        counted, their last good checkpoint stays on disk."""
+        budget = (self.cfg.drain_timeout_s
+                  if timeout_s is None else timeout_s)
+        t0 = time.monotonic()
+        done = skipped = timed_out = 0
+        with self._lock:
+            for tid in sorted(self.tenants):
+                if time.monotonic() - t0 > budget:
+                    timed_out += 1
+                    self.stats_counters["drain_timeouts"] += 1
+                    continue
+                if self.tenants[tid].checkpoint():
+                    done += 1
+                else:
+                    skipped += 1
+        return dict(checkpointed=done, skipped=skipped,
+                    timed_out=timed_out)
+
+    def drain(self) -> Dict[str, int]:
+        """Graceful drain (the SIGTERM path): checkpoint every tenant
+        within the drain budget, then close sinks. Open windows ride the
+        checkpoints — a restart resumes every tenant with zero lost
+        windows (tests/test_stream.py pins byte-identical per-tenant
+        resume)."""
+        with self._lock:
+            out = self.checkpoint_all()
+            for t in self.tenants.values():
+                t.close()
+            return out
+
+    @classmethod
+    def resume(cls, cfg: ServeConfig) -> "TenantService":
+        """Restart from ``cfg.state_dir``: every subdirectory with a
+        checkpoint becomes a resumed tenant."""
+        svc = cls(cfg)
+        if cfg.state_dir and os.path.isdir(cfg.state_dir):
+            for name in sorted(os.listdir(cfg.state_dir)):
+                ckpt = os.path.join(cfg.state_dir, name, "ckpt.pkl")
+                if os.path.isfile(ckpt):
+                    with svc._lock:
+                        svc.tenants[name] = Tenant.resume(name, cfg)
+        return svc
+
+    # -- query surface ----------------------------------------------------
+    def query_delay_culprit(self, tenant_id: str, percentile: float = 0.95,
+                            after_us: Optional[float] = None) -> Dict:
+        with self._lock:
+            t = self.tenant(tenant_id, create=False)
+            return live_delay_culprit(t.ring.records(), percentile,
+                                      after_us)
+
+    def trace_ids(self, tenant_id: str) -> List[str]:
+        with self._lock:
+            return self.tenant(tenant_id, create=False).ring.ids()
+
+    def trace(self, tenant_id: str, trace_id: str) -> Optional[Dict]:
+        with self._lock:
+            return self.tenant(tenant_id, create=False).ring.get(trace_id)
+
+    def stats(self, tenant_id: Optional[str] = None) -> Dict:
+        with self._lock:
+            if tenant_id is not None:
+                return self.tenant(tenant_id, create=False).stats()
+            fleet = {k: v for k, v in self.fleet_stats.items()
+                     if not isinstance(v, list)}
+            return dict(
+                precision=self.precision,
+                n_tenants=len(self.tenants),
+                max_tenants=self.cfg.max_tenants,
+                total_backlog=sum(t.backlog for t in self.tenants.values()),
+                dispatch=dict(
+                    fleet_dispatches=int(
+                        self.fleet_stats.get("fleet_dispatches", 0)),
+                    shared_solves=int(
+                        self.stats_counters["shared_solves"]),
+                    tenant_batches=int(
+                        self.stats_counters["tenant_batches"]),
+                    isolated_solves=int(
+                        self.stats_counters["isolated_solves"]),
+                    pumped_windows=int(
+                        self.stats_counters["pumped_windows"]),
+                ),
+                fleet=fleet,
+                tenants={tid: t.stats()
+                         for tid, t in sorted(self.tenants.items())},
+            )

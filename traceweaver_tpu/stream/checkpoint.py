@@ -36,6 +36,15 @@ Everything in the state dict is plain pickle material (Span dataclasses,
 numpy arrays inside EdgeDists, networkx-free); sharing is preserved
 because the whole dict rides one pickle (the live store's span objects
 and the window buffers reference the same copies).
+
+The serve layer's per-tenant checkpoints (``traceweaver_tpu/serve``)
+ride the same ``save_checkpoint``/``load_checkpoint`` machinery — one
+file per tenant, wrapping the service's ``state_dict()`` with tenancy
+bookkeeping (trace ring, counters, the Alibaba self-loop map). Those
+checkpoints have no replayable source, so the still-open window buffers
+in the pickled windower ARE the durability story: a drained-and-resumed
+tenant loses zero windows (tests/test_stream.py,
+``test_multi_tenant_checkpoint_kill_resume_no_leakage``).
 """
 
 from __future__ import annotations

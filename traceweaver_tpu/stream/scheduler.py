@@ -102,6 +102,19 @@ class MicroBatchScheduler:
     def backlog(self) -> int:
         return len(self.pending) + len(self.spill)
 
+    def pop_batch(self) -> List[WindowBuffer]:
+        """Take the next micro-batch off the queues: refill pending from
+        spill (oldest first) up to the pending bound, then hand the whole
+        pending queue over. One definition shared by :meth:`pump` and the
+        serve layer's tenancy manager (which merges several tenants'
+        popped batches into one shared fleet dispatch and counts
+        ``solved_windows`` itself once the shared solve lands)."""
+        while self.spill and len(self.pending) < self.max_pending:
+            self.pending.append(self.spill.popleft())
+        batch = list(self.pending)
+        self.pending.clear()
+        return batch
+
     # -- consumer side ----------------------------------------------------
     def _solve_once(self, batch: List[WindowBuffer]) -> List:
         """One solve attempt, under the watchdog when configured. The
@@ -158,10 +171,7 @@ class MicroBatchScheduler:
         while self.pending or self.spill:
             if max_batches is not None and batches >= max_batches:
                 break
-            while self.spill and len(self.pending) < self.max_pending:
-                self.pending.append(self.spill.popleft())
-            batch = list(self.pending)
-            self.pending.clear()
+            batch = self.pop_batch()
             out = self._solve_guarded(batch)
             if len(out) != len(batch):
                 raise RuntimeError(

@@ -219,11 +219,18 @@ class StreamingReconstructor:
         return problems
 
     # -- solve ------------------------------------------------------------
-    def _solve_batch(self, bufs: List[WindowBuffer]) -> List[WindowResult]:
-        from traceweaver_tpu.algorithms import timing
-        from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+    def prepare_batch_items(self, bufs: List[WindowBuffer], tenant=None):
+        """Build the fleet items for a micro-batch of sealed windows.
 
-        t0 = time.perf_counter()
+        Returns ``(per_buf, items, owners)`` — the per-window problem
+        lists, the flat :class:`FleetItem` list, and each item's owning
+        buffer index. Split out of :meth:`_solve_batch` so the serve
+        layer's tenancy manager can merge several tenants' batches into
+        ONE shared :func:`solve_fleet` dispatch (``tenant`` tags the
+        items with their owning tenant id; the single-tenant stream path
+        leaves it None — the pinned no-tenant default)."""
+        from traceweaver_tpu.algorithms.fleet import FleetItem
+
         per_buf: List[List[_WindowProblem]] = []
         items, owners = [], []
         for b, buf in enumerate(bufs):
@@ -234,8 +241,16 @@ class StreamingReconstructor:
                         if self.cfg.warm_start else None)
                 items.append(FleetItem(
                     wp.service, {wp.in_ep: wp.in_spans}, wp.out_parts,
-                    wp.truth, wp.dag, store=self.live, warm_dists=warm))
+                    wp.truth, wp.dag, store=self.live, warm_dists=warm,
+                    tenant=tenant))
                 owners.append(b)
+        return per_buf, items, owners
+
+    def _solve_batch(self, bufs: List[WindowBuffer]) -> List[WindowResult]:
+        from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+        t0 = time.perf_counter()
+        per_buf, items, owners = self.prepare_batch_items(bufs)
         outs = []
         quarantined: List[int] = []
         if items:
@@ -267,6 +282,21 @@ class StreamingReconstructor:
                          delta["persistent_cache_misses"]))
         solve_s = time.perf_counter() - t0
         self.stats["solve_s"] = self.stats.get("solve_s", 0.0) + solve_s
+        return self.consume_batch_results(bufs, per_buf, owners, outs,
+                                          quarantined, solve_s)
+
+    def consume_batch_results(self, bufs: List[WindowBuffer], per_buf,
+                              owners: List[int], outs,
+                              quarantined: List[int],
+                              solve_s: float) -> List[WindowResult]:
+        """Decode one micro-batch's fleet results into
+        :class:`WindowResult`\\ s (the second half of :meth:`_solve_batch`,
+        split out for the serve layer's shared multi-tenant dispatches:
+        the manager splits a shared ``solve_fleet`` call's outputs back
+        per tenant and hands each tenant its slice here). ``quarantined``
+        indexes into THIS batch's item list; carried-state/grader updates
+        skip quarantined items exactly as the single-tenant path does."""
+        from traceweaver_tpu.algorithms import timing
 
         results: List[WindowResult] = []
         by_buf_outs: List[List] = [[] for _ in bufs]
@@ -469,10 +499,14 @@ class StreamingReconstructor:
         self.stats[key] = self.stats.get(key, 0) + n
 
     # -- checkpointing ----------------------------------------------------
-    def _checkpoint(self) -> None:
-        if not self.cfg.checkpoint_path:
-            return
-        state = dict(
+    def state_dict(self) -> Dict:
+        """Everything a checkpoint must capture to rebuild this service:
+        offsets, windowing/watermark state (including still-open window
+        buffers), the live span store, carried statistics, the grader,
+        and every counter. One definition shared by :meth:`_checkpoint`
+        and the serve layer's per-tenant checkpoints (which wrap this
+        dict with tenant bookkeeping)."""
+        return dict(
             cfg=self.cfg,
             precision=self.precision,
             consumed=self.consumed,
@@ -500,8 +534,12 @@ class StreamingReconstructor:
                                 self.scheduler.solve_retried,
                                 self.scheduler.poisoned_windows),
         )
+
+    def _checkpoint(self) -> None:
+        if not self.cfg.checkpoint_path:
+            return
         try:
-            save_checkpoint(self.cfg.checkpoint_path, state)
+            save_checkpoint(self.cfg.checkpoint_path, self.state_dict())
         except (OSError, RuntimeError) as e:
             from traceweaver_tpu.runtime import faults
 
@@ -552,6 +590,16 @@ class StreamingReconstructor:
             # the summary says the run survived a checkpoint corruption
             state["stats"]["checkpoint_recovered"] = (
                 state["stats"].get("checkpoint_recovered", 0) + 1)
+        svc.apply_state(state)
+        return svc
+
+    def apply_state(self, state: Dict) -> None:
+        """Restore a :meth:`state_dict` onto this service (the field half
+        of :meth:`resume`, shared with the serve layer's per-tenant
+        resume): offsets, windowing state, live store, carried stats,
+        counters, scheduler queues, and the sink/dead-letter truncation
+        splice."""
+        svc = self
         svc.consumed = state["consumed"]
         svc.emitted_windows = state["emitted_windows"]
         svc.watermark = state["watermark"]
@@ -579,7 +627,6 @@ class StreamingReconstructor:
             # dead-lettered after the checkpoint re-poison (or emit) from
             # identical state on the resumed run
             svc.deadletter.truncate(state.get("deadletter_offset", 0))
-        return svc
 
     # -- main loop --------------------------------------------------------
     def run(self, max_windows: Optional[int] = None) -> Dict:

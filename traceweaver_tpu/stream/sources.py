@@ -34,6 +34,11 @@ class SpanEvent:
     delivered it. The gap between the two is what watermarks bound.
     ``processes`` is the owning trace's ``process_id -> service`` table
     (Jaeger ships it per trace; collectors forward it with each span).
+
+    This is also the serve layer's ingress unit: the HTTP front door
+    (``traceweaver_tpu/serve``) parses each posted Jaeger-JSON payload
+    and feeds every span as one SpanEvent into the owning tenant's
+    pipeline, so network ingestion and replay share one event contract.
     """
 
     span: Span
