@@ -726,6 +726,169 @@ def serve_fields(n_tenants: int, clean: dict, storm: dict) -> dict:
     }
 
 
+def ingest_fields(n_spans: int, n_windows: int, col_s: float,
+                  obj_s: float) -> dict:
+    """Ingest-only leg ledger -> report fields (unit-tested like
+    chaos_fields/serve_fields, tests/test_bench.py).
+
+    ``col_s``/``obj_s`` are the wall seconds of one full host pack pass
+    (partition sort -> perfect-cut windows -> candidate ranges -> skip
+    caps -> packed window tensors, ZERO device involvement) under
+    ``TW_COLUMNAR=1`` / ``=0`` on identical spans. The headline
+    ``pack_spans_per_s`` is the columnar number; the object-path rate
+    and the ratio make ROADMAP item 2's ≥10× claim measured, not
+    asserted."""
+    def rate(s):
+        return round(n_spans / s, 1) if s and s > 0 else None
+
+    return {
+        "ingest_spans": int(n_spans),
+        "ingest_windows": int(n_windows),
+        "pack_spans_per_s": rate(col_s),
+        "pack_s_per_window": (round(col_s / n_windows, 6)
+                              if n_windows else None),
+        "pack_spans_per_s_object": rate(obj_s),
+        "pack_columnar_speedup": (round(obj_s / col_s, 2)
+                                  if col_s and col_s > 0 and obj_s else None),
+    }
+
+
+def run_ingest_leg(n_spans: int) -> dict:
+    """bench.py --ingest-only N: host pack throughput, no device at all.
+
+    Synthesizes a ~N-span single-service corpus (bursty arrivals ->
+    perfect-cut windows of realistic width) and its ingest-time columnar
+    store (the ``TraceStore.build_columns`` handoff: SpanArray columns
+    with an endpoint id column, built once at parse — untimed here, as
+    in production), then times the parsed-store -> packed-blocks host
+    pass under BOTH ``TW_COLUMNAR`` settings on identical spans:
+
+    - **columnar**: per-endpoint partition = boolean-mask gather on the
+      endpoint column + one lexsort, then perfect-cut windows, candidate
+      ranges, water-filled skip caps and the dense window-tensor fill —
+      all array work, zero span-object touches;
+    - **object** (``TW_COLUMNAR=0``): the pre-columnar per-span walk
+      (partition sort by key tuple, per-window list comprehensions).
+
+    No JAX backend is initialized and nothing is dispatched — this is
+    the host half of the solve in isolation, the quantity the columnar
+    refactor exists to move (ROADMAP item 2, "measured, not asserted").
+    The two paths' packed tensors are byte-compared
+    (``pack_parity_ok``) so the throughput ratio can never come from
+    diverging work.
+    """
+    import numpy as np
+
+    from traceweaver_tpu.algorithms import weaver_tpu as wt
+    from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
+    from traceweaver_tpu.ingest.partition import partition_spans_by_endpoint
+    from traceweaver_tpu.spans import Span, SpanArray
+
+    E = 4
+    n_traces = max(8, n_spans // (1 + E))
+    rng = np.random.default_rng(7)
+    in_spans, out_flat = [], []
+    t = 0.0
+    for i in range(n_traces):
+        # bursts of 8 overlapping requests, then a gap: perfect cuts land
+        # every ~8 traces, giving windows wide enough to be realistic
+        t += 40.0 if i % 8 else 5000.0
+        s_in = Span(f"t{i}", "in", t, 600.0, "op", [], "svc", "server")
+        in_spans.append(s_in)
+        prev = t + 10.0
+        for e in range(E):
+            start = prev + 15.0 + float(rng.normal(0, 2))
+            s_out = Span(f"t{i}", f"out{e}", start, 50.0, f"op{e}", [],
+                         "svc", "client")
+            s_out.ep = f"EP{e}"
+            out_flat.append(s_out)
+            prev = start + 50.0
+    total = len(in_spans) + len(out_flat)
+    # the ingest-time columnar store (built at parse in production —
+    # load_corpus -> build_columns; untimed, like the JSON parse itself)
+    ep_table = sorted({s.ep for s in out_flat})
+    ep_of = {ep: i for i, ep in enumerate(ep_table)}
+    out_all = SpanArray.from_spans(out_flat)
+    out_all.endpoint = np.fromiter((ep_of[s.ep] for s in out_flat),
+                                   np.int32, len(out_flat))
+    out_all.endpoint_table = ep_table
+    in_all = SpanArray.from_spans(in_spans)
+    log(f"ingest leg: {total} synthetic spans, {n_traces} traces, E={E}")
+
+    def columnar_pass():
+        os.environ["TW_COLUMNAR"] = "1"
+        t0 = time.perf_counter()
+        order = np.lexsort((in_all.end, in_all.start))
+        in_cols = in_all.take(order)
+        out_cols = {}
+        for e_idx, ep in enumerate(ep_table):
+            arr = out_all.take(np.flatnonzero(out_all.endpoint == e_idx))
+            arr = arr.take(np.lexsort((arr.end, arr.start)))
+            out_cols[ep] = arr
+        windows = wt.perfect_cut_windows_cols(in_cols,
+                                              wt.DEFAULT_MAX_WINDOW)
+        out_starts = {ep: out_cols[ep].start for ep in ep_table}
+        ranges = wt.candidate_ranges([], windows, ep_table, out_starts,
+                                     in_cols=in_cols)
+        caps = water_fill_skip_caps(
+            windows, ranges, len(in_cols),
+            [len(out_cols[ep]) for ep in ep_table])
+        # span lists are never walked when the columns are supplied —
+        # placeholders prove it
+        packed = wt.pack_problem(
+            [], {ep: [] for ep in ep_table}, ep_table, {}, "IN", None,
+            parallel=True, windows=windows, ranges=ranges, skip_caps=caps,
+            in_cols=in_cols, out_cols=out_cols)
+        return packed, windows, time.perf_counter() - t0
+
+    def object_pass():
+        os.environ["TW_COLUMNAR"] = "0"
+        t0 = time.perf_counter()
+        out_parts = partition_spans_by_endpoint(list(out_flat),
+                                                lambda s: s.ep)
+        ins = sorted(in_spans, key=lambda s: (s.start_mus, s.end_mus))
+        out_eps = sorted(out_parts)
+        windows = wt.perfect_cut_windows(ins, wt.DEFAULT_MAX_WINDOW)
+        out_starts = {
+            ep: np.array(sorted(float(s.start_mus) for s in out_parts[ep]))
+            for ep in out_eps
+        }
+        ranges = wt.candidate_ranges(ins, windows, out_eps, out_starts)
+        caps = water_fill_skip_caps(
+            windows, ranges, len(ins),
+            [len(out_parts[ep]) for ep in out_eps])
+        packed = wt.pack_problem(
+            ins, out_parts, out_eps, {}, "IN", None, parallel=True,
+            windows=windows, ranges=ranges, skip_caps=caps)
+        return packed, windows, time.perf_counter() - t0
+
+    saved = os.environ.get("TW_COLUMNAR")
+    try:
+        # two timed passes per path, best-of (first pass pays allocator /
+        # code warmup); object first so any shared warmup favors IT —
+        # the reported ratio is the conservative one
+        p_obj, w_obj, s_obj = object_pass()
+        _, _, s_obj2 = object_pass()
+        p_col, w_col, s_col = columnar_pass()
+        _, _, s_col2 = columnar_pass()
+    finally:
+        if saved is None:
+            os.environ.pop("TW_COLUMNAR", None)
+        else:
+            os.environ["TW_COLUMNAR"] = saved
+    obj_s, col_s = min(s_obj, s_obj2), min(s_col, s_col2)
+    parity = (w_obj == w_col) and all(
+        p_obj.arrays[k].tobytes() == p_col.arrays[k].tobytes()
+        and p_obj.arrays[k].dtype == p_col.arrays[k].dtype
+        for k in p_obj.arrays)
+    report = dict(mode="ingest", pack_parity_ok=bool(parity),
+                  **ingest_fields(total, len(w_col), col_s, obj_s))
+    log(f"ingest leg: columnar {report['pack_spans_per_s']} spans/s, "
+        f"object {report['pack_spans_per_s_object']} spans/s "
+        f"({report['pack_columnar_speedup']}x, parity={parity})")
+    return report
+
+
 def _serve_trace(i, prefix, base_us, spacing_us=10_000.0, slow_every=6):
     """One synthetic frontend->search->geo Jaeger trace (fix=2 root op);
     every ``slow_every``-th trace plants its latency in search."""
@@ -1375,6 +1538,14 @@ if __name__ == "__main__":
                          "under injected faults (default spec "
                          "dispatch:0.2) and report the supervisor "
                          "ledger + accuracy delta vs the unfaulted leg")
+    ap.add_argument("--ingest-only", type=int, nargs="?", const=131072,
+                    default=None, metavar="N",
+                    help="standalone host-pack leg: ~N synthetic spans "
+                         "from parsed store to packed window blocks with "
+                         "ZERO device involvement, timed under both "
+                         "TW_COLUMNAR settings on identical inputs "
+                         "(reports pack_spans_per_s, pack_s_per_window, "
+                         "and the columnar-vs-object speedup)")
     ap.add_argument("--serve-tenants", type=int, default=None, metavar="N",
                     help="standalone multi-tenant service leg: N "
                          "synthetic tenants at mixed rates through one "
@@ -1386,6 +1557,14 @@ if __name__ == "__main__":
     if args.faults:
         # env, so the solver CHILD (where the leg runs) inherits it
         os.environ["TW_BENCH_FAULTS"] = args.faults
+    if args.ingest_only:
+        ingest_report = run_ingest_leg(args.ingest_only)
+        line = json.dumps(ingest_report)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        sys.exit(0)
     if args.serve_tenants:
         serve_report = run_serve_leg(args.serve_tenants)
         line = json.dumps(serve_report)

@@ -252,3 +252,34 @@ def test_serve_fields_ledger_and_isolation_delta(bench):
     empty = bench.serve_fields(0, {}, {})
     assert empty["serve_spans_per_s"] is None
     assert empty["serve_isolation_delta_pct"] is None
+
+
+def test_ingest_fields_ledger_and_ratio(bench):
+    """The --ingest-only leg's report builder: pack timings under both
+    TW_COLUMNAR settings -> the pack_* field set (spans/s, s/window, and
+    the columnar-vs-object speedup the >=10x acceptance bar reads)."""
+    out = bench.ingest_fields(100_000, 500, col_s=0.05, obj_s=1.0)
+    assert out["ingest_spans"] == 100_000
+    assert out["ingest_windows"] == 500
+    assert out["pack_spans_per_s"] == 2_000_000.0
+    assert out["pack_s_per_window"] == 0.0001
+    assert out["pack_spans_per_s_object"] == 100_000.0
+    assert out["pack_columnar_speedup"] == 20.0
+    # empty/zero inputs degrade to None, never divide-by-zero
+    empty = bench.ingest_fields(0, 0, 0.0, 0.0)
+    assert empty["pack_spans_per_s"] is None
+    assert empty["pack_s_per_window"] is None
+    assert empty["pack_columnar_speedup"] is None
+
+
+def test_ingest_leg_small_run_parity_and_fields(bench, monkeypatch):
+    """A tiny end-to-end --ingest-only run: both paths pack byte-identical
+    blocks and every ledger field lands in the report."""
+    report = bench.run_ingest_leg(2000)
+    assert report["mode"] == "ingest"
+    assert report["pack_parity_ok"] is True
+    assert report["ingest_spans"] >= 1900
+    assert report["ingest_windows"] > 0
+    assert report["pack_spans_per_s"] > 0
+    assert report["pack_spans_per_s_object"] > 0
+    assert report["pack_columnar_speedup"] > 0

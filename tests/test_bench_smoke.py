@@ -282,6 +282,48 @@ def test_serve_smoke_two_tenants_http_roundtrip(tmp_path):
     service.drain()
 
 
+@pytest.mark.columnar
+def test_fleet_solve_exercises_the_columnar_pack_path(monkeypatch):
+    """Tier-1 columnar smoke: under JAX_PLATFORMS=cpu a default fleet
+    solve must pack through the COLUMNAR path (no silent fallback to the
+    object walk — the kill switch is TW_COLUMNAR=0, nothing else), and
+    the object packer must not run at all."""
+    from test_pipeline import _mixed_items
+
+    import traceweaver_tpu.algorithms.weaver_tpu as wt
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+    monkeypatch.delenv("TW_COLUMNAR", raising=False)
+    col_calls, obj_calls = [], []
+    real_col = wt._pack_problem_columnar
+    real_obj = wt._pack_problem_objects
+
+    def col_spy(*a, **k):
+        col_calls.append(1)
+        return real_col(*a, **k)
+
+    def obj_spy(*a, **k):
+        obj_calls.append(1)
+        return real_obj(*a, **k)
+
+    monkeypatch.setattr(wt, "_pack_problem_columnar", col_spy)
+    monkeypatch.setattr(wt, "_pack_problem_objects", obj_spy)
+    out = solve_fleet(_mixed_items(), stats={})
+    assert len(out) == 3 and all(r is not None for r in out)
+    assert col_calls, (
+        "fleet solve silently fell back to the object pack path")
+    assert not obj_calls, (
+        "object packer ran under the default TW_COLUMNAR=1")
+
+    # the kill switch restores the object path — and only it
+    monkeypatch.setenv("TW_COLUMNAR", "0")
+    col_calls.clear()
+    out_obj = solve_fleet(_mixed_items(), stats={})
+    assert obj_calls and not col_calls
+    for a, b in zip(out, out_obj):
+        assert a[0] == b[0] and a[1] == b[1] and a[2:] == b[2:]
+
+
 @pytest.mark.pipeline
 def test_pipelined_fleet_runs_and_second_solve_is_compile_free():
     """Tier-1 pipeline smoke: under JAX_PLATFORMS=cpu the fleet solve

@@ -1,0 +1,284 @@
+"""Golden parity: the columnar host pack path vs the object path.
+
+The columnar span store (spans.SpanArray) and the vectorized pack path
+(weaver_tpu._pack_problem_columnar) must be BIT-IDENTICAL to the
+per-span object walk they replace (``TW_COLUMNAR=0``, kept verbatim as
+the kill switch): same perfect-cut windows, byte-identical packed window
+tensors across randomized geometries / forced skips / precomputed
+ranges+skip_caps / padded axes, identical decode-time id resolution, and
+identical end-to-end solve outputs under both switch positions and both
+score precisions (the bf16 path stores 2-byte score blocks downstream of
+the pack — the packed f32 tensors themselves must not depend on it).
+
+Everything here is synthetic (no dataset dependency) and runs under
+JAX_PLATFORMS=cpu — tier-1.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+
+import traceweaver_tpu.algorithms.weaver_tpu as wt
+from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+from traceweaver_tpu.runtime import knobs
+from traceweaver_tpu.spans import (
+    SKIP,
+    Span,
+    SpanArray,
+    is_skip_span,
+    make_skip_span,
+    skip_span_wire,
+)
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.columnar
+
+
+def _random_problem(seed=0, n_traces=60, eps=("A", "B"), burst=6,
+                    drop_every=0, dup_times=False):
+    """One service's partitions with randomized geometry: bursty
+    arrivals (window structure), optional dropped outgoing spans (skip
+    budget / forced-skip rows), optional duplicated timestamps (sort
+    tie-stability)."""
+    import networkx as nx
+
+    rng = np.random.default_rng(seed)
+    in_spans, out_spans, ta = [], {ep: [] for ep in eps}, {ep: {} for ep in eps}
+    t = 0.0
+    for i in range(n_traces):
+        t += float(rng.integers(20, 60)) if i % burst else 4000.0
+        if dup_times and i % 7 == 0:
+            t = float(int(t))  # mint exact ties across traces
+        s_in = Span(f"t{i}", "in", t, 350.0 + 30.0 * len(eps), "op", [],
+                    "svc", "server")
+        in_spans.append(s_in)
+        dropped = drop_every and (i % drop_every == 0)
+        prev = t + 8.0
+        for ep in eps:
+            if dropped:
+                ta[ep][s_in.GetId()] = SKIP
+                continue
+            start = prev + 12.0 + float(rng.normal(0, 3))
+            s_out = Span(f"t{i}", f"out-{ep}", start, 40.0, f"op{ep}", [],
+                         "svc", "client")
+            out_spans[ep].append(s_out)
+            ta[ep][s_in.GetId()] = s_out.GetId()
+            prev = start + 40.0
+    dag = nx.DiGraph()
+    for a, b in zip(eps, eps[1:]):
+        dag.add_edge(a, b)
+    if len(eps) == 1:
+        dag.add_node(eps[0])
+    in_spans = sorted(in_spans, key=lambda s: (s.start_mus, s.end_mus))
+    for part in out_spans.values():
+        part.sort(key=lambda s: (s.start_mus, s.end_mus))
+    return in_spans, out_spans, list(eps), ta, dag
+
+
+def _pack_both(monkeypatch, in_spans, out_parts, out_eps, dists, in_ep,
+               dag, **kw):
+    packs = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("TW_COLUMNAR", flag)
+        packs[flag] = wt.pack_problem(in_spans, out_parts, out_eps, dists,
+                                      in_ep, dag, **kw)
+    return packs["0"], packs["1"]
+
+
+def _assert_pack_identical(po, pc):
+    assert po.windows == pc.windows
+    assert set(po.arrays) == set(pc.arrays)
+    for k in po.arrays:
+        a, b = po.arrays[k], pc.arrays[k]
+        assert a.dtype == b.dtype and a.shape == b.shape, k
+        assert a.tobytes() == b.tobytes(), f"array {k!r} not byte-identical"
+    assert list(po.in_ids) == list(pc.in_ids)
+    assert po.n_in == pc.n_in
+    for e in range(len(po.out_eps)):
+        ao, ac = po.out_id_array(e), pc.out_id_array(e)
+        assert ao.shape == ac.shape
+        assert all(x == y for x, y in zip(ao, ac)), f"id map {e} differs"
+
+
+@pytest.mark.parametrize("seed,eps,burst,drop,dup", [
+    (0, ("A", "B"), 6, 0, False),
+    (1, ("A", "B", "C", "D"), 12, 0, False),
+    (2, ("A",), 3, 0, True),
+    (3, ("A", "B", "C"), 9, 5, False),   # skip budget > 0
+])
+def test_pack_problem_byte_parity_randomized(monkeypatch, seed, eps, burst,
+                                             drop, dup):
+    in_spans, out_parts, out_eps, ta, dag = _random_problem(
+        seed=seed, eps=eps, burst=burst, drop_every=drop, dup_times=dup)
+    plan = wt.plan_find_assignments({"IN": in_spans}, out_parts, out_eps,
+                                    dag, ta)
+    po, pc = _pack_both(monkeypatch, in_spans, out_parts, out_eps,
+                        plan["dists"], "IN", dag)
+    _assert_pack_identical(po, pc)
+
+
+def test_pack_parity_with_forced_skips_and_padding(monkeypatch):
+    """The true-skips oracle's forced rows and the fleet packer's padded
+    axes (pad_w/pad_m/pad_e + precomputed ranges/skip_caps) must pack
+    identically on both paths."""
+    from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
+
+    in_spans, out_parts, out_eps, ta, dag = _random_problem(
+        seed=4, eps=("A", "B"), burst=8, drop_every=4)
+    plan = wt.plan_find_assignments({"IN": in_spans}, out_parts, out_eps,
+                                    dag, ta, true_skips=True)
+    assert any(plan["force_skip_ids"][ep] for ep in out_eps)
+
+    monkeypatch.setenv("TW_COLUMNAR", "0")
+    windows = wt.perfect_cut_windows(in_spans, 16)  # force cap splits too
+    out_starts = {
+        ep: np.array(sorted(float(s.start_mus) for s in out_parts[ep]))
+        for ep in out_eps
+    }
+    ranges = wt.candidate_ranges(in_spans, windows, out_eps, out_starts)
+    caps = water_fill_skip_caps(windows, ranges, len(in_spans),
+                                [len(out_parts[ep]) for ep in out_eps])
+    po, pc = _pack_both(
+        monkeypatch, in_spans, out_parts, out_eps, plan["dists"], "IN", dag,
+        force_skip_ids=plan["force_skip_ids"], windows=windows,
+        ranges=ranges, skip_caps=caps, pad_w=32, pad_m=64, pad_e=4)
+    assert po.arrays["force_skip"].any()
+    _assert_pack_identical(po, pc)
+
+
+def test_perfect_cut_windows_parity_including_cap_splits(monkeypatch):
+    for seed, cap in ((0, 4), (1, 7), (2, 1024), (5, 2)):
+        in_spans, *_ = _random_problem(seed=seed, burst=11, dup_times=True)
+        obj = wt.perfect_cut_windows(in_spans, cap)
+        cols = wt.in_columns(in_spans)
+        assert wt.perfect_cut_windows_cols(cols, cap) == obj
+
+
+def test_candidate_ranges_parity(monkeypatch):
+    in_spans, out_parts, out_eps, _, _ = _random_problem(seed=6, burst=9)
+    out_starts = {
+        ep: np.array(sorted(float(s.start_mus) for s in out_parts[ep]))
+        for ep in out_eps
+    }
+    windows = wt.perfect_cut_windows(in_spans, 8)
+    monkeypatch.setenv("TW_COLUMNAR", "0")
+    obj = wt.candidate_ranges(in_spans, windows, out_eps, out_starts)
+    monkeypatch.setenv("TW_COLUMNAR", "1")
+    col = wt.candidate_ranges(in_spans, windows, out_eps, out_starts)
+    col2 = wt.candidate_ranges(in_spans, windows, out_eps, out_starts,
+                               in_cols=wt.in_columns(in_spans))
+    assert np.array_equal(obj, col) and obj.dtype == col.dtype
+    assert np.array_equal(obj, col2)
+
+
+def test_endpoint_ids_rows_truncation_matches_object_slicing(monkeypatch):
+    """The fleet packer drops pack_problem's power-of-two B padding;
+    EndpointIds.rows must keep the id maps aligned exactly as the object
+    path's flat-list slice did."""
+    in_spans, out_parts, out_eps, ta, dag = _random_problem(seed=7)
+    plan = wt.plan_find_assignments({"IN": in_spans}, out_parts, out_eps,
+                                    dag, ta)
+    po, pc = _pack_both(monkeypatch, in_spans, out_parts, out_eps,
+                        plan["dists"], "IN", dag)
+    n_w = len(po.windows)
+    M = po.arrays["out_start"].shape[2]
+    po.truncate_rows(n_w)
+    pc.truncate_rows(n_w)
+    for e in range(len(out_eps)):
+        ao, ac = po.out_id_array(e), pc.out_id_array(e)
+        assert len(ao) == len(ac) == n_w * M
+        assert all(x == y for x, y in zip(ao, ac))
+
+
+@pytest.mark.parametrize("precision", ["f32", "bf16"])
+def test_fleet_solve_identical_under_both_switches(monkeypatch, precision):
+    """End-to-end: solve_fleet outputs (assignments, top-k, counters)
+    must be identical under TW_COLUMNAR=0 and =1, at both score-block
+    itemsizes (f32 and bf16)."""
+    def items():
+        built = []
+        for seed, eps, drop in ((0, ("A", "B"), 0), (1, ("A", "B", "C"), 5)):
+            in_spans, out_parts, out_eps, ta, dag = _random_problem(
+                seed=seed, n_traces=40, eps=eps, drop_every=drop)
+            built.append(FleetItem(f"svc{seed}", {"IN": in_spans},
+                                   out_parts, ta, dag))
+        return built
+
+    outs = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("TW_COLUMNAR", flag)
+        outs[flag] = solve_fleet(items(), stats={}, precision=precision)
+    for a, b in zip(outs["0"], outs["1"]):
+        assert a[0] == b[0]   # assignments
+        assert a[1] == b[1]   # top-k
+        assert a[2:] == b[2:]  # counters
+
+
+def test_skip_span_nan_sentinels_survive_the_round_trip():
+    """make_skip_span now carries NaN floats in its float fields (no
+    more the string "None" type-lied into start/duration): the columnar
+    store represents it as NaN column entries, float arithmetic works
+    (end_mus is NaN, not the string "NoneNone"), and the reference's
+    all-"None" wire shape appears ONLY at emission via skip_span_wire."""
+    sk = make_skip_span("s1")
+    assert is_skip_span(sk)
+    assert isinstance(sk.start_mus, float) and math.isnan(sk.start_mus)
+    assert isinstance(sk.duration_mus, float) and math.isnan(sk.duration_mus)
+    assert math.isnan(sk.end_mus)  # was "None" + "None" == "NoneNone"
+
+    real = Span("t0", "r1", 100.0, 50.0, "op", [], "p", "client")
+    arr = SpanArray.from_spans([real, sk])
+    assert np.isnan(arr.start[1]) and np.isnan(arr.end[1])
+    assert arr.start[0] == 100.0 and arr.end[0] == 150.0
+    assert arr.ids[1] == ("None", "s1")
+
+    wire = skip_span_wire(sk)
+    assert wire["start_mus"] == "None" and wire["duration_mus"] == "None"
+    assert wire["trace_id"] == "None" and wire["process_id"] == "None"
+    # a real span's wire record keeps its numbers
+    wire_real = skip_span_wire(real)
+    assert wire_real["start_mus"] == 100.0
+    assert wire_real["duration_mus"] == 50.0
+
+
+def test_tw_columnar_knob_registered_and_kill_switch_semantics(monkeypatch):
+    assert "TW_COLUMNAR" in knobs.REGISTRY
+    monkeypatch.delenv("TW_COLUMNAR", raising=False)
+    assert knobs.get_bool("TW_COLUMNAR") is True       # default: columnar
+    assert wt.columnar_enabled() is True
+    for off in ("0", "false", ""):
+        monkeypatch.setenv("TW_COLUMNAR", off)
+        assert wt.columnar_enabled() is False
+    monkeypatch.setenv("TW_COLUMNAR", "1")
+    assert wt.columnar_enabled() is True
+
+
+def test_ingest_time_store_columns_match_span_lists(monkeypatch):
+    """TraceStore.build_columns must mirror the in/out span lists
+    exactly: same order, same ids, same f64 times, service id column
+    attached."""
+    from traceweaver_tpu.spans import TraceStore
+
+    store = TraceStore()
+    for i in range(5):
+        sp = Span(f"t{i}", f"s{i}", 10.0 * i + 0.5, 3.0, "op", [], "p",
+                  "server")
+        store.in_spans_by_process.setdefault("svc", []).append(sp)
+        cl = Span(f"t{i}", f"c{i}", 10.0 * i + 1.0, 1.0, "op", [], "p",
+                  "client")
+        store.out_spans_by_process.setdefault("svc", []).append(cl)
+    cols = store.build_columns()
+    assert set(cols) == {"svc"}
+    for key, src in (("in", store.in_spans_by_process["svc"]),
+                     ("out", store.out_spans_by_process["svc"])):
+        arr = cols["svc"][key]
+        assert len(arr) == len(src)
+        assert list(arr.ids) == [s.GetId() for s in src]
+        assert np.array_equal(arr.start,
+                              [float(s.start_mus) for s in src])
+        assert arr.service_table == ["svc"]
+        assert np.all(arr.service == 0)

@@ -47,8 +47,12 @@ from traceweaver_tpu.algorithms.weaver_tpu import (
     WeaverTPU,
     _bucket,
     candidate_ranges,
+    columnar_enabled,
+    in_columns,
+    out_columns,
     pack_problem,
     perfect_cut_windows,
+    perfect_cut_windows_cols,
     plan_find_assignments,
     refit_fleet_params,
     scatter_window_span_stats,
@@ -246,7 +250,7 @@ class FleetItem:
     def __init__(self, svc, in_span_partitions, out_span_partitions,
                  true_assignments, dag=None,
                  method="MaxScoreBatchSubsetWithSkips", store=None,
-                 warm_dists=None, tenant=None):
+                 warm_dists=None, tenant=None, in_cols=None, out_cols=None):
         self.svc = svc
         self.in_span_partitions = in_span_partitions
         self.out_span_partitions = out_span_partitions
@@ -270,6 +274,13 @@ class FleetItem:
         # dispatched programs byte-identical — the column never ships to
         # the device.
         self.tenant = tenant
+        # optional pre-built SpanArray columns over the SORTED partitions
+        # (in: (start, end) order; out: per-endpoint ascending-start) —
+        # the stream micro-batch builder hands windows over as column
+        # slices so the fleet pack never re-walks span objects. Absent
+        # (batch callers), _prepare converts once at the solve boundary.
+        self.in_cols = in_cols
+        self.out_cols = out_cols
 
 
 def _prepare(item: FleetItem, solver: WeaverTPU):
@@ -307,10 +318,24 @@ def _prepare(item: FleetItem, solver: WeaverTPU):
         # joins the single-pass dispatch groups (unseen edges fall back
         # to pack_problem's near-flat wide Gaussian)
         dists, n_passes = item.warm_dists, 1
+    # columnar handoff (TW_COLUMNAR, default): reuse the item's pre-built
+    # columns (stream/serve hand their sorted window slices over) or
+    # convert ONCE here — downstream windowing/ranges/pack is array work
+    in_cols = out_cols = None
+    if columnar_enabled():
+        in_cols = (item.in_cols
+                   if item.in_cols is not None
+                   and len(item.in_cols) == len(in_spans)
+                   else in_columns(in_spans))
+        out_cols = (item.out_cols
+                    if item.out_cols is not None
+                    and all(ep in item.out_cols for ep in out_eps)
+                    else out_columns(item.out_span_partitions, out_eps))
     return dict(in_ep=in_ep, in_spans=in_spans, out_eps=out_eps,
                 skip_budget=plan["skip_budget"], dists=dists,
                 n_in=plan["n_in"], n_passes=n_passes,
-                force_skip_ids=plan["force_skip_ids"])
+                force_skip_ids=plan["force_skip_ids"],
+                in_cols=in_cols, out_cols=out_cols)
 
 
 def _raw_cells(item: FleetItem, max_window: int) -> float:
@@ -481,13 +506,20 @@ def solve_fleet(
     plans = []
     for i, item, prep in prepared:
         in_spans, out_eps = prep["in_spans"], prep["out_eps"]
-        windows = perfect_cut_windows(in_spans, max_window)
-        out_starts_np = {
-            ep: np.array(sorted(float(s.start_mus)
-                                for s in item.out_span_partitions[ep]))
-            for ep in out_eps
-        }
-        ranges = candidate_ranges(in_spans, windows, out_eps, out_starts_np)
+        in_cols, out_cols = prep["in_cols"], prep["out_cols"]
+        if in_cols is not None:
+            # columnar: windowing + ranges from the partition columns
+            windows = perfect_cut_windows_cols(in_cols, max_window)
+            out_starts_np = {ep: out_cols[ep].start for ep in out_eps}
+        else:
+            windows = perfect_cut_windows(in_spans, max_window)
+            out_starts_np = {
+                ep: np.array(sorted(float(s.start_mus)
+                                    for s in item.out_span_partitions[ep]))
+                for ep in out_eps
+            }
+        ranges = candidate_ranges(in_spans, windows, out_eps, out_starts_np,
+                                  in_cols=in_cols)
         skip_caps = water_fill_skip_caps(
             windows, ranges, len(in_spans),
             [len(item.out_span_partitions[ep]) for ep in out_eps])
@@ -925,6 +957,7 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
             parallel=False, windows=windows,
             pad_w=W_pad, pad_m=M_pad, pad_e=E_pad,
             ranges=ranges, skip_caps=skip_caps,
+            in_cols=prep.get("in_cols"), out_cols=prep.get("out_cols"),
         )
         a = packed.arrays
         n_w = len(windows)
@@ -933,9 +966,9 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
             # is exact, and decode indexes out_ids by original row b which
             # is preserved under row slicing
             arrays_cat.setdefault(key, []).append(a[key][:n_w])
-        # keep the id tables consistent with the sliced row count
+        # keep the id maps consistent with the sliced row count
         # (_decode sizes its gather table from the assign rows it is given)
-        packed.out_ids = [col[:n_w * M_pad] for col in packed.out_ids]
+        packed.truncate_rows(n_w)
         for key in param_rows:
             param_rows[key].append(a[key])
         param_idx.extend([p] * n_w)
@@ -1279,7 +1312,9 @@ def _decode_group(solver, pend, results, stats):
         feas = rows[..., 2]
         topk_cols = rows[..., 3:]
         out_eps = prep["out_eps"]
-        in_ids = [s.GetId() for s in prep["in_spans"]]
+        in_ids = (prep["in_cols"].ids.tolist()
+                  if prep.get("in_cols") is not None
+                  else [s.GetId() for s in prep["in_spans"]])
         n_in = prep["n_in"]
 
         all_assignments = {ep: {} for ep in out_eps}

@@ -66,7 +66,8 @@ from traceweaver_tpu.ops.precision import (
     validate_precision,
 )
 from traceweaver_tpu.ops.scores import mixture_logpdf, pair_scores
-from traceweaver_tpu.spans import NA, SKIP, Span
+from traceweaver_tpu.runtime import knobs as _knobs
+from traceweaver_tpu.spans import NA, SKIP, Span, SpanArray
 
 NEG = -1.0e9
 SKIP_MARGIN = 4.0    # log-space margin a real candidate must beat to avoid skip
@@ -769,6 +770,32 @@ def solve_em_fleet(
 # Host-side problem packing
 # ---------------------------------------------------------------------------
 
+def columnar_enabled() -> bool:
+    """``TW_COLUMNAR=0`` kills the columnar host pack path, restoring the
+    per-span object walk (the bit-identical pre-columnar flow — kept as
+    the kill switch and the golden-parity reference). Read at call time,
+    same discipline as every other knob."""
+    return _knobs.get_bool("TW_COLUMNAR")
+
+
+def in_columns(in_spans: List[Span]) -> SpanArray:
+    """Columns of a sorted incoming partition (one O(n) conversion — the
+    ingest → solver boundary; everything after is array work)."""
+    return SpanArray.from_spans(in_spans)
+
+
+def out_columns(out_span_partitions: Dict[str, List[Span]],
+                out_eps: List[str]) -> Dict[str, SpanArray]:
+    """Ascending-start columns per outgoing endpoint — the exact
+    permutation of the object path's ``sorted(spans, key=s.start_mus)``
+    (stable), so candidate slices and id-table gathers line up with the
+    object path element for element."""
+    return {
+        ep: SpanArray.from_spans(out_span_partitions[ep]).sorted_by_start()
+        for ep in out_eps
+    }
+
+
 def perfect_cut_windows(in_spans: List[Span], max_size: int) -> List[Tuple[int, int]]:
     """Segment sorted incoming spans at points where every earlier span has
     ended (candidate sets provably disjoint), capping segment length.
@@ -791,6 +818,32 @@ def perfect_cut_windows(in_spans: List[Span], max_size: int) -> List[Tuple[int, 
                               + float(in_spans[i].duration_mus))
     if seg_start < n:
         windows.append((seg_start, n))
+    return windows
+
+
+def perfect_cut_windows_cols(cols: SpanArray,
+                             max_size: int) -> List[Tuple[int, int]]:
+    """Columnar :func:`perfect_cut_windows`: the running-max-of-ends cut
+    condition never resets across cuts, so the perfect cut points are a
+    pure function of the global end-time cummax — one vectorized pass —
+    and the ``max_size`` cap then splits each perfect segment into
+    fixed-stride chunks (exactly the positions the sequential loop's
+    ``i - seg_start >= max_size`` check fires at). Same [start, end)
+    pairs as the object version on the same sorted spans (parity-tested).
+    """
+    n = len(cols)
+    if n == 0:
+        return []
+    cut = np.zeros(n, dtype=bool)
+    if n > 1:
+        cut[1:] = np.maximum.accumulate(cols.end)[:-1] <= cols.start[1:]
+    bounds = [0, *np.flatnonzero(cut).tolist(), n]
+    windows: List[Tuple[int, int]] = []
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        if a == b:
+            continue
+        for s in range(a, b, max_size):
+            windows.append((s, min(s + max_size, b)))
     return windows
 
 
@@ -827,18 +880,57 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def _window_bounds(windows: List[Tuple[int, int]], start: np.ndarray,
+                   end: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-window [first in start, max in end] bounds from columns: the
+    end-time segment maxes ride ONE ``np.maximum.reduceat`` over the
+    interleaved (lo, hi) boundary list instead of a Python max() per
+    window."""
+    B = len(windows)
+    los = np.fromiter((lo for lo, _ in windows), np.int64, B)
+    his = np.fromiter((hi for _, hi in windows), np.int64, B)
+    idx = np.empty(2 * B, dtype=np.int64)
+    idx[0::2] = los
+    idx[1::2] = his
+    n = start.shape[0]
+    if idx[-1] >= n:  # reduceat indices must be < n; the last segment
+        seg = np.maximum.reduceat(end, idx[:-1])  # runs to the end anyway
+    else:
+        seg = np.maximum.reduceat(end, idx)
+    return start[los], seg[0::2]
+
+
 def candidate_ranges(
     in_spans: List[Span],
     windows: List[Tuple[int, int]],
     out_eps: List[str],
     out_starts_np: Dict[str, np.ndarray],
+    in_cols: Optional[SpanArray] = None,
 ) -> np.ndarray:
     """[B, E, 2] candidate index ranges: per window and endpoint, the slice
     of that endpoint's time-sorted out-spans starting within the window's
     [first in start, last in end] bound (the tensor analogue of the
     reference's per-endpoint binary-search cutoffs, traceweaver_v3.py:182-217).
     Single source of truth for both packing and the dispatch-size budget.
+
+    Columnar (``TW_COLUMNAR``, default; or ``in_cols`` given): the window
+    bounds come from the start/end columns and each endpoint's two
+    cutoffs are ONE vectorized ``searchsorted`` over all windows — no
+    per-window Python. The object loop below is the ``TW_COLUMNAR=0``
+    reference; both produce identical int64 ranges (parity-tested).
     """
+    if in_cols is not None or columnar_enabled():
+        if in_cols is None:
+            in_cols = in_columns(in_spans)
+        if not windows:
+            return np.zeros((0, len(out_eps), 2), dtype=np.int64)
+        w_t0, w_t1 = _window_bounds(windows, in_cols.start, in_cols.end)
+        ranges = np.zeros((len(windows), len(out_eps), 2), dtype=np.int64)
+        for e, ep in enumerate(out_eps):
+            starts = out_starts_np[ep]
+            ranges[:, e, 0] = np.searchsorted(starts, w_t0, side="left")
+            ranges[:, e, 1] = np.searchsorted(starts, w_t1, side="right")
+        return ranges
     ranges = np.zeros((len(windows), len(out_eps), 2), dtype=np.int64)
     for b, (lo, hi) in enumerate(windows):
         w_t0 = float(in_spans[lo].start_mus)
@@ -851,16 +943,134 @@ def candidate_ranges(
     return ranges
 
 
+class EndpointIds:
+    """Decode-time id map for one endpoint of a packed batch: instead of
+    materializing a ``[None] * (B * M)`` Python list at pack time (the
+    object path's layout — B·M object slots, mostly None), the columnar
+    path keeps the endpoint's sorted id TABLE plus each window row's
+    ``(r0, count)`` candidate range and gathers ids only when the decode
+    actually needs them — one fancy-index gather per endpoint per batch.
+    """
+
+    __slots__ = ("table", "r0", "count", "M")
+
+    def __init__(self, table: np.ndarray, r0: np.ndarray, count: np.ndarray,
+                 M: int) -> None:
+        self.table = table      # [n_ep_spans] object — ascending-start ids
+        self.r0 = r0            # [B] int64 — first candidate per window row
+        self.count = count      # [B] int64 — candidates per window row
+        self.M = M              # padded column count
+
+    def rows(self, n: int) -> "EndpointIds":
+        """First ``n`` window rows (the fleet packer's row truncation)."""
+        return EndpointIds(self.table, self.r0[:n], self.count[:n], self.M)
+
+    def gather(self) -> np.ndarray:
+        """Materialize the object path's ``[B * M]`` id layout (None in
+        empty slots) — same indexing contract (``b * M + j``), produced
+        by one table gather instead of per-span list writes."""
+        B, M = self.r0.shape[0], self.M
+        j = np.arange(M)
+        valid = j[None, :] < self.count[:, None]
+        src = np.where(valid, self.r0[:, None] + j[None, :], 0)
+        out = np.full((B, M), None, dtype=object)
+        out[valid] = self.table[src[valid]]
+        return out.reshape(B * M)
+
+
 @dataclass
 class PackedProblem:
-    """Dense window tensors + the index maps to decode device output."""
+    """Dense window tensors + the index maps to decode device output.
+
+    ``out_ids`` holds, per endpoint, either the object path's flat
+    ``[B * M]`` id list or the columnar path's :class:`EndpointIds`
+    (id-table + ranges, gathered at decode time);
+    :meth:`out_id_array` is the single accessor decode reads through.
+    """
 
     arrays: Dict[str, np.ndarray]
     out_eps: List[str]
     windows: List[Tuple[int, int]]
     in_ids: List  # [n_in] span ids, window order == original sort order
-    out_ids: List[List]  # per ep, candidate span id per (window, slot)
+    out_ids: List  # per ep: [B*M] id list OR EndpointIds
     n_in: int
+
+    def out_id_array(self, e: int) -> np.ndarray:
+        """[B * M] object array of candidate ids for endpoint ``e``."""
+        col = self.out_ids[e]
+        if isinstance(col, EndpointIds):
+            return col.gather()
+        ids = np.empty(len(col), dtype=object)
+        ids[:] = col
+        return ids
+
+    def truncate_rows(self, n_rows: int) -> None:
+        """Drop the power-of-two B padding from the id maps (the fleet
+        packer slices every batch tensor to its exact window count; the
+        id maps must follow so decode's ``b * M + j`` indexing stays
+        aligned)."""
+        M = self.arrays["out_start"].shape[2]
+        self.out_ids = [
+            col.rows(n_rows) if isinstance(col, EndpointIds)
+            else col[:n_rows * M]
+            for col in self.out_ids
+        ]
+
+
+def _problem_tables(out_eps: List[str], E_pad: int,
+                    dists: Dict[Tuple[str, str], EdgeDist], in_ep: str,
+                    dag: Optional[nx.DiGraph],
+                    parallel: bool) -> Dict[str, np.ndarray]:
+    """DAG structure masks + distribution param tables of one problem —
+    identical for the columnar and object pack paths (one definition, so
+    the golden parity holds by construction on everything that is not a
+    window tensor)."""
+    E = len(out_eps)
+    pred_mask = np.zeros((E_pad, E_pad), dtype=bool)
+    root_mask = np.zeros((E_pad,), dtype=bool)
+    is_last = np.zeros((E_pad,), dtype=bool)
+    if parallel or dag is None:
+        root_mask[:E] = True
+    else:
+        for e, ep in enumerate(out_eps):
+            preds = timing.primary_pred_edges(dag, ep)
+            if len(dag.in_edges(ep)) == 0 or in_ep in preds:
+                root_mask[e] = True
+            for p in preds:
+                if p != in_ep and p in out_eps:
+                    pred_mask[e, out_eps.index(p)] = True
+        is_last[E - 1] = True
+
+    K = MAX_COMPONENTS
+    wide = EdgeDist.gaussian(0.0, 1e7)  # near-flat fallback for unseen edges
+
+    def params_of(key) -> EdgeDist:
+        return dists.get(key, wide)
+
+    edge_wt = np.zeros((E_pad, E_pad, K), dtype=np.float32)
+    edge_mu = np.zeros((E_pad, E_pad, K), dtype=np.float32)
+    edge_sd = np.ones((E_pad, E_pad, K), dtype=np.float32)
+    in_wt = np.zeros((E_pad, K), dtype=np.float32)
+    in_mu = np.zeros((E_pad, K), dtype=np.float32)
+    in_sd = np.ones((E_pad, K), dtype=np.float32)
+    ret_wt = np.zeros((E_pad, K), dtype=np.float32)
+    ret_mu = np.zeros((E_pad, K), dtype=np.float32)
+    ret_sd = np.ones((E_pad, K), dtype=np.float32)
+    for e, ep in enumerate(out_eps):
+        d = params_of((in_ep, ep))
+        in_wt[e], in_mu[e], in_sd[e] = d.weights, d.means, d.stds
+        d = params_of((ep, in_ep))
+        ret_wt[e], ret_mu[e], ret_sd[e] = d.weights, d.means, d.stds
+        for p, pep in enumerate(out_eps):
+            d = params_of((pep, ep))
+            edge_wt[e, p], edge_mu[e, p], edge_sd[e, p] = d.weights, d.means, d.stds
+
+    return dict(
+        pred_mask=pred_mask, root_mask=root_mask, is_last=is_last,
+        edge_wt=edge_wt, edge_mu=edge_mu, edge_sd=edge_sd,
+        in_wt=in_wt, in_mu=in_mu, in_sd=in_sd,
+        ret_wt=ret_wt, ret_mu=ret_mu, ret_sd=ret_sd,
+    )
 
 
 def pack_problem(
@@ -880,6 +1090,8 @@ def pack_problem(
     pad_e: Optional[int] = None,
     ranges: Optional[np.ndarray] = None,
     skip_caps: Optional[np.ndarray] = None,  # [len(windows), E] water-filled
+    in_cols: Optional[SpanArray] = None,
+    out_cols: Optional[Dict[str, SpanArray]] = None,
 ) -> PackedProblem:
     """Build the dense [B, ...] window tensors for :func:`solve_windows`.
 
@@ -891,7 +1103,156 @@ def pack_problem(
     axis (fleet packing: services share one dispatch at the fleet-max E;
     padded endpoints carry no valid columns, a false root/pred/last mask and
     unit-σ zero-weight params, so the solve ignores them).
+
+    Two implementations behind one contract (byte-identical tensors,
+    identical decode — the golden parity suite pins it):
+
+    - **columnar** (``TW_COLUMNAR=1``, the default): window rows are
+      strided slices of the partition's :class:`SpanArray` columns
+      (``in_cols``/``out_cols``, converted here when the caller did not
+      hand them over), candidate blocks are fancy-index gathers, and the
+      id maps stay :class:`EndpointIds` tables resolved at decode time —
+      no per-span Python anywhere in the fill;
+    - **object** (``TW_COLUMNAR=0``): the original per-window span-object
+      walk, kept verbatim as the kill switch and parity reference.
     """
+    if columnar_enabled():
+        return _pack_problem_columnar(
+            in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
+            force_skip_ids=force_skip_ids, max_window=max_window,
+            parallel=parallel, windows=windows, pad_w=pad_w, pad_b=pad_b,
+            pad_m=pad_m, pad_e=pad_e, ranges=ranges, skip_caps=skip_caps,
+            in_cols=in_cols, out_cols=out_cols)
+    return _pack_problem_objects(
+        in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
+        force_skip_ids=force_skip_ids, max_window=max_window,
+        parallel=parallel, windows=windows, pad_w=pad_w, pad_b=pad_b,
+        pad_m=pad_m, pad_e=pad_e, ranges=ranges, skip_caps=skip_caps)
+
+
+def _pack_problem_columnar(
+    in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
+    force_skip_ids=None, max_window=DEFAULT_MAX_WINDOW, parallel=False,
+    windows=None, pad_w=None, pad_b=None, pad_m=None, pad_e=None,
+    ranges=None, skip_caps=None, in_cols=None, out_cols=None,
+) -> PackedProblem:
+    """Columnar :func:`pack_problem` body: every window tensor is filled
+    by array slicing/gather over the partition columns. The per-span
+    Python of the object path — ``[float(s.start_mus) for s in ...]`` per
+    window per endpoint, an id-list write per candidate slot — becomes
+    O(1) NumPy statements per endpoint, so pack cost scales with array
+    size, not span-object count (the 0.39% MFU host stall of
+    PROFILE_r05, docs/PERF.md "Columnar host path")."""
+    E = len(out_eps)
+    E_pad = max(E, pad_e or E)
+    if in_cols is None:
+        in_cols = in_columns(in_spans)
+    if out_cols is None:
+        out_cols = out_columns(out_span_partitions, out_eps)
+    if windows is None:
+        windows = perfect_cut_windows_cols(in_cols, max_window)
+    n_windows = len(windows)
+    B = _bucket(max(n_windows, pad_b or 1), minimum=1)
+    W = _bucket(max(max(hi - lo for lo, hi in windows), pad_w or 1))
+
+    if ranges is None:  # caller may pass precomputed rows (same helper)
+        out_starts_np = {ep: out_cols[ep].start for ep in out_eps}
+        ranges = candidate_ranges(in_spans, windows, out_eps, out_starts_np,
+                                  in_cols=in_cols)
+    M = _bucket(max(int((ranges[:, :, 1] - ranges[:, :, 0]).max(initial=1)),
+                    pad_m or 1))
+
+    in_start = np.zeros((B, W), dtype=np.float32)
+    in_end = np.zeros((B, W), dtype=np.float32)
+    in_valid = np.zeros((B, W), dtype=bool)
+    out_start = np.zeros((B, E_pad, M), dtype=np.float32)
+    out_end = np.zeros((B, E_pad, M), dtype=np.float32)
+    out_valid = np.zeros((B, E_pad, M), dtype=bool)
+    skip_cap = np.zeros((B, E_pad), dtype=np.float32)
+    force_skip = np.zeros((B, E_pad, W), dtype=bool)
+
+    los = np.fromiter((lo for lo, _ in windows), np.int64, n_windows)
+    his = np.fromiter((hi for _, hi in windows), np.int64, n_windows)
+    n_w = his - los
+    origins = in_cols.start[los]                       # [Bw] f64
+
+    # incoming rows: one strided gather for the whole batch
+    jw = np.arange(W)
+    w_valid = jw[None, :] < n_w[:, None]               # [Bw, W]
+    w_src = np.where(w_valid, los[:, None] + jw[None, :], 0)
+    in_start[:n_windows][w_valid] = (
+        in_cols.start[w_src] - origins[:, None])[w_valid]
+    in_end[:n_windows][w_valid] = (
+        in_cols.end[w_src] - origins[:, None])[w_valid]
+    in_valid[:n_windows] = w_valid
+
+    # candidate blocks: one gather per endpoint
+    jm = np.arange(M)
+    r0 = ranges[:, :, 0]                               # [Bw, E]
+    m_w = ranges[:, :, 1] - r0                         # [Bw, E]
+    out_ids: List[EndpointIds] = []
+    for e, ep in enumerate(out_eps):
+        cols = out_cols[ep]
+        c_valid = jm[None, :] < m_w[:, e][:, None]     # [Bw, M]
+        c_src = np.where(c_valid, r0[:, e][:, None] + jm[None, :], 0)
+        out_start[:n_windows, e][c_valid] = (
+            cols.start[c_src] - origins[:, None])[c_valid]
+        out_end[:n_windows, e][c_valid] = (
+            cols.end[c_src] - origins[:, None])[c_valid]
+        out_valid[:n_windows, e] = c_valid
+        # id map resolved at decode time: table + per-row ranges, padded
+        # to the bucketed B so gather() reproduces the [B*M] layout
+        r0_pad = np.zeros(B, dtype=np.int64)
+        cnt_pad = np.zeros(B, dtype=np.int64)
+        r0_pad[:n_windows] = r0[:, e]
+        cnt_pad[:n_windows] = m_w[:, e]
+        out_ids.append(EndpointIds(cols.ids, r0_pad, cnt_pad, M))
+
+    # skip capacity: water-filled budget when provided (reference
+    # TallySkipSpans semantics); the solver still grants window-local
+    # slack max(rows - cols, 0) on device for feasibility
+    if skip_caps is not None:
+        skip_cap[:n_windows, :E] = skip_caps
+    else:
+        skip_cap[:n_windows, :E] = np.maximum(n_w[:, None] - m_w, 0)
+
+    if force_skip_ids:
+        in_ids_arr = in_cols.ids
+        for e, ep in enumerate(out_eps):
+            fs = force_skip_ids.get(ep, set())
+            if not fs:
+                continue
+            for b in range(n_windows):
+                lo, hi = int(los[b]), int(his[b])
+                mask = np.fromiter((i in fs for i in in_ids_arr[lo:hi]),
+                                   bool, hi - lo)
+                n_forced = int(mask.sum())
+                if n_forced:
+                    force_skip[b, e, :hi - lo] = mask
+                # every forced row needs skip capacity even when candidate
+                # ranges inflated by neighbouring windows hide the slack
+                skip_cap[b, e] = max(skip_cap[b, e], n_forced)
+
+    arrays = dict(
+        in_start=in_start, in_end=in_end, in_valid=in_valid,
+        out_start=out_start, out_end=out_end, out_valid=out_valid,
+        skip_cap=skip_cap, force_skip=force_skip,
+        **_problem_tables(out_eps, E_pad, dists, in_ep, dag, parallel),
+    )
+    return PackedProblem(arrays=arrays, out_eps=out_eps, windows=windows,
+                         in_ids=in_cols.ids, out_ids=out_ids,
+                         n_in=len(in_cols))
+
+
+def _pack_problem_objects(
+    in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
+    force_skip_ids=None, max_window=DEFAULT_MAX_WINDOW, parallel=False,
+    windows=None, pad_w=None, pad_b=None, pad_m=None, pad_e=None,
+    ranges=None, skip_caps=None,
+) -> PackedProblem:
+    """Object-walk :func:`pack_problem` body (``TW_COLUMNAR=0``): the
+    pre-columnar per-window span loops, kept verbatim as the kill switch
+    and the golden-parity reference."""
     E = len(out_eps)
     E_pad = max(E, pad_e or E)
     if windows is None:
@@ -961,55 +1322,11 @@ def pack_problem(
                 # ranges inflated by neighbouring windows hide the slack
                 skip_cap[b, e] = max(skip_cap[b, e], n_forced)
 
-    # --- DAG structure masks ---------------------------------------------
-    pred_mask = np.zeros((E_pad, E_pad), dtype=bool)
-    root_mask = np.zeros((E_pad,), dtype=bool)
-    is_last = np.zeros((E_pad,), dtype=bool)
-    if parallel or dag is None:
-        root_mask[:E] = True
-    else:
-        for e, ep in enumerate(out_eps):
-            preds = timing.primary_pred_edges(dag, ep)
-            if len(dag.in_edges(ep)) == 0 or in_ep in preds:
-                root_mask[e] = True
-            for p in preds:
-                if p != in_ep and p in out_eps:
-                    pred_mask[e, out_eps.index(p)] = True
-        is_last[E - 1] = True
-
-    # --- distribution params ---------------------------------------------
-    K = MAX_COMPONENTS
-    wide = EdgeDist.gaussian(0.0, 1e7)  # near-flat fallback for unseen edges
-
-    def params_of(key) -> EdgeDist:
-        return dists.get(key, wide)
-
-    edge_wt = np.zeros((E_pad, E_pad, K), dtype=np.float32)
-    edge_mu = np.zeros((E_pad, E_pad, K), dtype=np.float32)
-    edge_sd = np.ones((E_pad, E_pad, K), dtype=np.float32)
-    in_wt = np.zeros((E_pad, K), dtype=np.float32)
-    in_mu = np.zeros((E_pad, K), dtype=np.float32)
-    in_sd = np.ones((E_pad, K), dtype=np.float32)
-    ret_wt = np.zeros((E_pad, K), dtype=np.float32)
-    ret_mu = np.zeros((E_pad, K), dtype=np.float32)
-    ret_sd = np.ones((E_pad, K), dtype=np.float32)
-    for e, ep in enumerate(out_eps):
-        d = params_of((in_ep, ep))
-        in_wt[e], in_mu[e], in_sd[e] = d.weights, d.means, d.stds
-        d = params_of((ep, in_ep))
-        ret_wt[e], ret_mu[e], ret_sd[e] = d.weights, d.means, d.stds
-        for p, pep in enumerate(out_eps):
-            d = params_of((pep, ep))
-            edge_wt[e, p], edge_mu[e, p], edge_sd[e, p] = d.weights, d.means, d.stds
-
     arrays = dict(
         in_start=in_start, in_end=in_end, in_valid=in_valid,
         out_start=out_start, out_end=out_end, out_valid=out_valid,
         skip_cap=skip_cap, force_skip=force_skip,
-        pred_mask=pred_mask, root_mask=root_mask, is_last=is_last,
-        edge_wt=edge_wt, edge_mu=edge_mu, edge_sd=edge_sd,
-        in_wt=in_wt, in_mu=in_mu, in_sd=in_sd,
-        ret_wt=ret_wt, ret_mu=ret_mu, ret_sd=ret_sd,
+        **_problem_tables(out_eps, E_pad, dists, in_ep, dag, parallel),
     )
     return PackedProblem(arrays=arrays, out_eps=out_eps, windows=windows,
                          in_ids=in_ids, out_ids=out_ids, n_in=len(in_spans))
@@ -1143,20 +1460,30 @@ class WeaverTPU:
 
         Returns a list of ``(packed, (assign, topk, not_best, feas))``.
         """
-        all_windows = perfect_cut_windows(in_spans, self.max_window)
         E = max(1, len(out_eps))
         n_sweeps = 1 if E == 1 else self.n_sweeps
 
+        # columnar host path (TW_COLUMNAR, default): ONE object -> column
+        # conversion per partition here; windowing, candidate ranges, and
+        # every pack below are array work over these columns
+        in_cols = out_cols = None
+        if columnar_enabled():
+            in_cols = in_columns(in_spans)
+            out_cols = out_columns(out_span_partitions, out_eps)
+            all_windows = perfect_cut_windows_cols(in_cols, self.max_window)
+            out_starts_np = {ep: out_cols[ep].start for ep in out_eps}
+        else:
+            all_windows = perfect_cut_windows(in_spans, self.max_window)
+            out_starts_np = {
+                ep: np.array(sorted(float(s.start_mus)
+                                    for s in out_span_partitions[ep]))
+                for ep in out_eps
+            }
         # candidate ranges computed ONCE for all windows (the same rows the
         # packer consumes), so padding costs and the chunk budget reflect
         # the true [B, W, M] block without re-running searchsorted per class
-        out_starts_np = {
-            ep: np.array(sorted(float(s.start_mus)
-                                for s in out_span_partitions[ep]))
-            for ep in out_eps
-        }
         ranges_all = candidate_ranges(
-            in_spans, all_windows, out_eps, out_starts_np)
+            in_spans, all_windows, out_eps, out_starts_np, in_cols=in_cols)
         # per-endpoint global skip budget spread across windows by
         # water-filling (reference TallySkipSpans, traceweaver_v3.py:853-989)
         skip_caps_all = water_fill_skip_caps(
@@ -1246,6 +1573,7 @@ class WeaverTPU:
                 pad_m=m_est if n_chunks > 1 else None,
                 ranges=ranges_all[[row_of[w] for w in chunk]],
                 skip_caps=skip_caps_all[[row_of[w] for w in chunk]],
+                in_cols=in_cols, out_cols=out_cols,
             )
             stats["pack_s"] = stats.get("pack_s", 0.0) + (
                 _time.perf_counter() - t0)
@@ -1352,15 +1680,17 @@ class WeaverTPU:
         i_of = np.concatenate(
             [np.arange(hi - lo) for lo, hi in packed.windows]
         )
-        span_ids = [
-            packed.in_ids[lo + i]
-            for lo, hi in packed.windows
-            for i in range(hi - lo)
-        ]
+        pos = np.concatenate([np.arange(lo, hi) for lo, hi in packed.windows])
+        if isinstance(packed.in_ids, np.ndarray):
+            # columnar: the id column gathers by position in one step
+            span_ids = packed.in_ids[pos].tolist()
+        else:
+            span_ids = [packed.in_ids[p] for p in pos]
 
         for e, ep in enumerate(packed.out_eps):
-            ids = np.empty(B * M, dtype=object)
-            ids[:] = packed.out_ids[e]
+            # id maps resolve HERE (EndpointIds.gather on the columnar
+            # path): pack never materializes B*M Python id slots
+            ids = packed.out_id_array(e)
 
             cols = assign[w_of, e, i_of]                       # [n]
             chosen = ids[w_of * M + np.clip(cols, 0, M - 1)]

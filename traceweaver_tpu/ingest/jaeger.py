@@ -605,7 +605,21 @@ def load_corpus(
     ``strict``: malformed span records raise (:class:`MalformedSpan`)
     instead of the default skip-and-count; either way the dead-letter
     count lands on ``store.ingest_malformed_spans``.
+
+    Every exit finalizes the store's COLUMNAR partitions
+    (:meth:`~traceweaver_tpu.spans.TraceStore.build_columns`, under
+    ``TW_COLUMNAR``): per-service SpanArray columns built once here at
+    ingest, alongside the Span dicts the CPU baselines keep — both parse
+    front-ends (pure-Python and native C++) land on the same Span
+    objects, so their columns are identical by construction.
     """
+    def finalize(store: TraceStore) -> TraceStore:
+        from traceweaver_tpu.runtime import knobs as _knobs
+
+        if _knobs.get_bool("TW_COLUMNAR"):
+            store.build_columns()
+        return store
+
     store = TraceStore()
     counters = store.ingest_counters
     self_loop_map: Dict[str, List[str]] = {}
@@ -636,9 +650,9 @@ def load_corpus(
                 trace_id, spans, processes = parsed
                 cnt += ingest_trace(store, trace_id, spans, processes, fix)
                 if cnt > max_traces:
-                    return store
+                    return finalize(store)
         else:
-            return store
+            return finalize(store)
     for path in files:
         parsed = parse_trace_file(path, fix, self_loop_map,
                                   store.service_loop_map,
@@ -649,4 +663,4 @@ def load_corpus(
         cnt += ingest_trace(store, trace_id, spans, processes, fix)
         if cnt > max_traces:
             break
-    return store
+    return finalize(store)

@@ -5,6 +5,14 @@ its outgoing (client) spans by downstream endpoint, sorted by
 ``(start, end)`` (reference: src/trace_reconstructor/ports/python/
 executor.py:931-950). Services with more than one incoming partition are
 skipped by the executor, matching the reference.
+
+Columnar host path (``TW_COLUMNAR``, default): the partition sort keys
+come from :class:`~traceweaver_tpu.spans.SpanArray` float columns (one
+``lexsort`` per partition instead of a Python key tuple per span), and
+:meth:`ServiceProblem.columns` hands the solver the columnar view of the
+partitions — the ingest → solver handoff the packed path consumes
+(docs/PERF.md "Columnar host path"). Deliberately import-light (no JAX):
+the bench parent partitions corpora without touching a backend.
 """
 
 from __future__ import annotations
@@ -13,7 +21,18 @@ import copy
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from traceweaver_tpu.spans import Span, TraceStore
+import numpy as np
+
+from traceweaver_tpu.spans import Span, SpanArray, TraceStore
+
+
+def _columnar_on() -> bool:
+    # lazy: importing the runtime package at module-import time would
+    # cycle (runtime/__init__ -> executor -> ingest -> partition); at
+    # call time everything is initialized
+    from traceweaver_tpu.runtime import knobs
+
+    return knobs.get_bool("TW_COLUMNAR")
 
 
 def partition_spans_by_endpoint(
@@ -22,6 +41,16 @@ def partition_spans_by_endpoint(
     partitions: Dict[str, List[Span]] = {}
     for span in spans:
         partitions.setdefault(endpoint_of(span), []).append(span)
+    if _columnar_on():
+        # sort each partition by its float columns: same (start, end)
+        # stable order as the key-tuple sort below, computed by one
+        # lexsort over the column pair instead of per-span key calls
+        for ep, part in partitions.items():
+            arr = SpanArray.from_spans(part)
+            order = np.lexsort((arr.end, arr.start))
+            if not np.array_equal(order, np.arange(len(part))):
+                partitions[ep] = [part[i] for i in order]
+        return partitions
     for part in partitions.values():
         part.sort(key=lambda s: (s.start_mus, s.start_mus + s.duration_mus))
     return partitions
@@ -40,6 +69,19 @@ class ServiceProblem:
     out_span_partitions: Dict[str, List[Span]]
     skipped: bool = False
     skip_reason: Optional[str] = None
+
+    def columns(self) -> Dict[str, Dict[str, SpanArray]]:
+        """Columnar view of the partitions, built fresh at call time —
+        call AFTER any in-place span transform (load compression,
+        cache-hit injection), since columns snapshot span times. Keys:
+        ``in``/``out`` → per-endpoint :class:`SpanArray` in the
+        partition lists' sort order."""
+        return {
+            "in": {ep: SpanArray.from_spans(part)
+                   for ep, part in self.in_span_partitions.items()},
+            "out": {ep: SpanArray.from_spans(part)
+                    for ep, part in self.out_span_partitions.items()},
+        }
 
 
 def build_service_problem(store: TraceStore, process: str,

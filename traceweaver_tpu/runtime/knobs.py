@@ -63,6 +63,9 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        help="shape-class merge budget override (0 = never merge)"),
     _k("TW_PRECISION", "enum", "f32", choices=("f32", "bf16"),
        help="score-block storage precision (ops/precision.py validates)"),
+    _k("TW_COLUMNAR", "bool", True,
+       help="0 kills the columnar host pack path (object-walk packing, "
+            "the bit-identical pre-columnar flow)"),
     _k("TW_SCORE_GEMM", "str", None, help="score GEMM path override"),
     _k("TW_JAX_GMM", "str", None, help="GMM refit path override"),
     # --- Pallas ----------------------------------------------------------

@@ -9,7 +9,10 @@ around an externally-pumped
 tenants SHARE is the device: the :class:`TenantService` pump collects
 every healthy tenant's sealed-window batches, builds their
 :class:`~traceweaver_tpu.algorithms.fleet.FleetItem` lists (tagged with
-the tenant id — the id column fleet's pack/compaction/decode carries),
+the tenant id — the id column fleet's pack/compaction/decode carries —
+and carrying each window's pre-built :class:`SpanArray` column slices,
+so a pump's pack path is pure array work: the shared micro-batch
+builder hands windows over columnar, ``TW_COLUMNAR``, docs/PERF.md),
 and rides them all through ONE :func:`solve_fleet` call, so tenants with
 similar window geometry land in the same padded shape class and the
 dispatch count stays O(shape classes), not O(tenants) — the whole point

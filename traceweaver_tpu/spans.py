@@ -9,13 +9,19 @@ Two representations:
   ``"client_" + op_name`` parent) and ``GetChildProcess`` (a client span's
   single child's service).
 
-- :class:`SpanArray` — a struct-of-arrays view over a list of spans
-  (start/end times rebased to a local origin so they fit comfortably in
-  float32 on device). This is the representation the TPU solver consumes:
-  everything downstream of partitioning is dense arrays, not Python objects.
+- :class:`SpanArray` — a struct-of-arrays (columnar) partition: float64
+  start/end columns plus object-array id tables, built once per partition
+  at the ingest → solver handoff. This is the representation the packed
+  host path consumes (``TW_COLUMNAR``, the default): window assembly is
+  ``searchsorted`` + strided slices + fancy-index gathers over these
+  columns instead of per-span Python attribute walks, and device argmax
+  indices decode back to wire-format ids through the same tables
+  (docs/PERF.md "Columnar host path").
 """
 
 from __future__ import annotations
+
+import math
 
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -109,41 +115,135 @@ class Span:
 def make_skip_span(sid: str) -> Span:
     """A placeholder span representing a skipped (cache-served) call.
 
-    Mirrors the reference's skip spans: every field is the string "None"
-    and ``trace_id == "None"`` marks it (reference traceweaver_v3.py:953-963).
+    ``trace_id == "None"`` marks it (the reference's sentinel,
+    traceweaver_v3.py:953-963). The *time* fields are NaN — float
+    sentinels in float fields, so skip spans flow through the columnar
+    store (where a NaN start/end column entry is the skip sentinel) and
+    through float arithmetic (``end_mus``) without the stringly-typed
+    ``"None"`` the reference stuffs into them. The reference's all-"None"
+    wire shape is produced only at serialization time
+    (:func:`skip_span_wire`), never stored in the in-memory model.
     """
-    return Span("None", sid, "None", "None", None, [], "None", None, None)  # type: ignore[arg-type]
+    return Span("None", sid, float("nan"), float("nan"), None, [], "None",
+                None, None)
 
 
 def is_skip_span(span: Span) -> bool:
     return span.trace_id == "None"
 
 
+def skip_span_wire(span: Span) -> Dict[str, object]:
+    """The reference's wire/pickle shape for a skip span: every field the
+    string ``"None"`` (traceweaver_v3.py:953-963). The in-memory model
+    keeps NaN time sentinels (:func:`make_skip_span`); this is the ONLY
+    place the NaN → ``"None"`` conversion happens, at result-pickle /
+    emission time."""
+    def wire(v):
+        return "None" if isinstance(v, float) and math.isnan(v) else v
+
+    return dict(
+        trace_id=span.trace_id, sid=span.sid,
+        start_mus=wire(float(span.start_mus)),
+        duration_mus=wire(float(span.duration_mus)),
+        op_name=span.op_name, references=list(span.references),
+        process_id=span.process_id, span_kind=span.span_kind,
+    )
+
+
 @dataclass
 class SpanArray:
-    """Struct-of-arrays packing of a span partition for device compute.
+    """Struct-of-arrays (columnar) partition of spans.
 
-    ``start``/``end`` are float64 microseconds rebased by ``origin_mus``
-    (so that a later cast to float32 preserves sub-microsecond structure
-    within any realistic window). ``ids`` retains the (trace_id, sid) pairs
-    for translating device argmax indices back to wire-format assignments.
+    The host-path representation the packed solve consumes
+    (``TW_COLUMNAR=1``, the default): ``start``/``end`` are float64
+    microseconds (absolute unless ``origin_mus`` rebased them — window
+    packing subtracts its own per-window origin before the float32
+    downcast, so sub-microsecond structure survives), and the id columns
+    are object arrays supporting the fancy-index gathers window assembly
+    and decode are built from:
+
+    - ``ids``        [n] object — (trace_id, sid) tuples, the decode table
+      device argmax indices translate through;
+    - ``trace_ids`` / ``sids`` [n] object — the split id tables (lazy
+      views over ``ids``);
+    - ``service`` / ``endpoint`` [n] int32 (optional) — indices into
+      ``service_table`` / ``endpoint_table``, populated by the store-level
+      columns (:meth:`TraceStore.build_columns`);
+    - ``tenant`` [n] int32 (optional) — the serve layer's tenant id
+      column (−1 = untagged).
+
+    Skip spans (:func:`make_skip_span`) carry NaN start/end — the float
+    sentinel, kept out of wire formats by :func:`skip_span_wire`.
     """
 
-    start: np.ndarray          # [n] float64, rebased
-    end: np.ndarray            # [n] float64, rebased
-    ids: List[SpanId] = field(default_factory=list)
+    start: np.ndarray          # [n] float64
+    end: np.ndarray            # [n] float64
+    ids: np.ndarray = field(default_factory=lambda: np.empty(0, dtype=object))
     origin_mus: float = 0.0
+    service: Optional[np.ndarray] = None         # [n] int32
+    endpoint: Optional[np.ndarray] = None        # [n] int32
+    tenant: Optional[np.ndarray] = None          # [n] int32
+    service_table: Optional[List[str]] = None
+    endpoint_table: Optional[List[str]] = None
 
     @classmethod
-    def from_spans(cls, spans: Sequence[Span], origin_mus: Optional[float] = None) -> "SpanArray":
-        if origin_mus is None:
-            origin_mus = min((float(s.start_mus) for s in spans), default=0.0)
-        start = np.array([float(s.start_mus) - origin_mus for s in spans], dtype=np.float64)
-        end = np.array(
-            [float(s.start_mus) + float(s.duration_mus) - origin_mus for s in spans],
-            dtype=np.float64,
+    def from_spans(cls, spans: Sequence[Span],
+                   origin_mus: float = 0.0) -> "SpanArray":
+        """One O(n) pass over span objects — the single object → column
+        conversion point. Everything downstream (windowing, candidate
+        ranges, tensor fill, decode) is array slicing/gather."""
+        n = len(spans)
+        start = np.fromiter((s.start_mus for s in spans),
+                            dtype=np.float64, count=n)
+        end = start + np.fromiter((s.duration_mus for s in spans),
+                                  dtype=np.float64, count=n)
+        if origin_mus:
+            # subtraction order matches the object pack path exactly:
+            # start - o and (start + dur) - o
+            start = start - origin_mus
+            end = end - origin_mus
+        ids = np.empty(n, dtype=object)
+        ids[:] = [(s.trace_id, s.sid) for s in spans]
+        return cls(start=start, end=end, ids=ids, origin_mus=origin_mus)
+
+    @property
+    def trace_ids(self) -> np.ndarray:
+        out = np.empty(len(self), dtype=object)
+        out[:] = [i[0] for i in self.ids]
+        return out
+
+    @property
+    def sids(self) -> np.ndarray:
+        out = np.empty(len(self), dtype=object)
+        out[:] = [i[1] for i in self.ids]
+        return out
+
+    def sorted_by_start(self) -> "SpanArray":
+        """Stable ascending-start reorder — the exact permutation of the
+        object path's ``sorted(spans, key=lambda s: s.start_mus)``."""
+        order = np.argsort(self.start, kind="stable")
+        if np.array_equal(order, np.arange(len(self))):
+            return self
+        return self.take(order)
+
+    def sorted_by_start_end(self) -> "SpanArray":
+        """Stable ``(start, end)`` reorder — the partition sort order
+        (``partition_spans_by_endpoint`` / the stream's window sort)."""
+        order = np.lexsort((self.end, self.start))
+        if np.array_equal(order, np.arange(len(self))):
+            return self
+        return self.take(order)
+
+    def take(self, idx: np.ndarray) -> "SpanArray":
+        return SpanArray(
+            start=self.start[idx], end=self.end[idx], ids=self.ids[idx],
+            origin_mus=self.origin_mus,
+            service=None if self.service is None else self.service[idx],
+            endpoint=None if self.endpoint is None else self.endpoint[idx],
+            tenant=None if self.tenant is None else self.tenant[idx],
+            service_table=self.service_table,
+            endpoint_table=self.endpoint_table,
         )
-        return cls(start=start, end=end, ids=[s.GetId() for s in spans], origin_mus=origin_mus)
 
     def __len__(self) -> int:
         return int(self.start.shape[0])
@@ -169,6 +269,12 @@ class TraceStore:
         # ingestion dead-letter counters (ingest/jaeger.py bumps these:
         # malformed records are skipped-and-counted, never silently lost)
         self.ingest_counters: Dict[str, int] = {}
+        # columnar handoff (TW_COLUMNAR host path): per-service SpanArray
+        # partitions over the same spans as the in/out lists above, built
+        # once at corpus-load finalize (build_columns). The Span dicts
+        # stay — CPU baselines and repair/transform passes keep the
+        # object model; the packed solve path reads these columns.
+        self.columns: Dict[str, Dict[str, SpanArray]] = {}
 
     @property
     def ingest_malformed_spans(self) -> int:
@@ -177,3 +283,27 @@ class TraceStore:
 
     def services(self) -> List[str]:
         return list(self.out_spans_by_process.keys())
+
+    def build_columns(self) -> Dict[str, Dict[str, SpanArray]]:
+        """Finalize the columnar handoff: one ``{"in": ..., "out": ...}``
+        pair of :class:`SpanArray` partitions per service, in list order
+        (unsorted — per-endpoint partitions sort their own slices), with
+        the service id column/table attached. Called by the corpus
+        loaders (batch + native front-ends both land here, so the two
+        parse paths produce identical columns by construction)."""
+        service_table = sorted(set(self.in_spans_by_process)
+                               | set(self.out_spans_by_process))
+        sid_of = {s: i for i, s in enumerate(service_table)}
+        self.columns = {}
+        for svc in service_table:
+            cols = {}
+            for key, spans in (
+                ("in", self.in_spans_by_process.get(svc, [])),
+                ("out", self.out_spans_by_process.get(svc, [])),
+            ):
+                arr = SpanArray.from_spans(spans)
+                arr.service = np.full(len(arr), sid_of[svc], dtype=np.int32)
+                arr.service_table = service_table
+                cols[key] = arr
+            self.columns[svc] = cols
+        return self.columns

@@ -41,6 +41,11 @@ class WindowBuffer:
     end_us: float
     spans: List[Span] = field(default_factory=list)
     owned_ids: Set[Tuple[str, str]] = field(default_factory=set)
+    # owned server-side roots, collected at add() time so emission-side
+    # consumers (trace stitching) never re-scan the whole buffer to find
+    # them — the columnar-host-path rule: per-span Python work happens
+    # once, where the span already is in hand
+    roots: List[Span] = field(default_factory=list)
     # stamped at seal time by the engine: watermark delay when sealed
     seal_delay_us: float = 0.0
 
@@ -48,6 +53,8 @@ class WindowBuffer:
         self.spans.append(span)
         if owned:
             self.owned_ids.add(span.GetId())
+            if span.span_kind == "server" and span.IsRoot():
+                self.roots.append(span)
 
     @property
     def n_spans(self) -> int:
