@@ -74,21 +74,23 @@ import sys
 import tempfile
 import time
 
+from traceweaver_tpu.runtime import knobs as _knobs
+
 DATASETS = (
     # (app, path, fix)
     ("hotel", "/root/reference/data/hotel_reservation/hotel_load150", 2),
     ("media", "/root/reference/data/media_microservices/media_load150", 1),
 )
 COMPRESS = 10.0
-SUBSET_SPANS = int(os.environ.get("TW_BENCH_SUBSET", "25"))
+SUBSET_SPANS = _knobs.get_int("TW_BENCH_SUBSET")
 # per-service safety alarm for the same-input exact solves. NOT every
 # service fits it (the committed recording has media rating/text at
 # ~130 s each on a 1-core host): services whose recorded cost exceeds
 # the alarm carry the recording instead of burning the alarm for nothing
-EXACT_ALARM_SECONDS = int(os.environ.get("TW_BENCH_EXACT_ALARM", "95"))
+EXACT_ALARM_SECONDS = _knobs.get_int("TW_BENCH_EXACT_ALARM")
 # the whole bench must fit this envelope (the round-3 artifact died by
 # exceeding the driver's budget; this is the single knob that bounds us)
-DEADLINE = int(os.environ.get("TW_BENCH_DEADLINE", "780"))
+DEADLINE = _knobs.get_int("TW_BENCH_DEADLINE")
 # How long the solver child may sit inside backend init before the
 # parent declares the remote backend down. Evidence base: a DOWN axon
 # does not init slowly — it blocks 25-40 min and then raises UNAVAILABLE
@@ -100,12 +102,12 @@ DEADLINE = int(os.environ.get("TW_BENCH_DEADLINE", "780"))
 # FULL two-app CPU leg fits the envelope on a 1-core host (round-5 host:
 # warm full leg ~280 s measured). Raise via env on relay-saturated
 # deployments.
-BACKEND_UP_BUDGET = int(os.environ.get("TW_BENCH_BACKEND_UP", "120"))
+BACKEND_UP_BUDGET = _knobs.get_int("TW_BENCH_BACKEND_UP")
 # reserves the parent holds back when budgeting earlier phases
-CPU_FALLBACK_RESERVE = int(os.environ.get("TW_BENCH_CPU_RESERVE", "170"))
-BASELINE_RESERVE = int(os.environ.get("TW_BENCH_BASELINE_RESERVE", "110"))
+CPU_FALLBACK_RESERVE = _knobs.get_int("TW_BENCH_CPU_RESERVE")
+BASELINE_RESERVE = _knobs.get_int("TW_BENCH_BASELINE_RESERVE")
 MERGE_SLACK = 20
-TPU_TIMEOUT_CAP = int(os.environ.get("TW_BENCH_TPU_TIMEOUT", "480"))
+TPU_TIMEOUT_CAP = _knobs.get_int("TW_BENCH_TPU_TIMEOUT")
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 RECORDED_PATH = os.path.join(
@@ -144,10 +146,10 @@ def build_problems(apps=None):
     from traceweaver_tpu.synth import compress_spans
 
     # smoke-test knobs (unset in driver runs): restrict apps / corpus size
-    env_apps = os.environ.get("TW_BENCH_APPS")
+    env_apps = _knobs.get("TW_BENCH_APPS")
     if apps is None and env_apps:
         apps = set(env_apps.split(","))
-    max_traces = int(os.environ.get("TW_BENCH_MAX_TRACES", "1000"))
+    max_traces = _knobs.get_int("TW_BENCH_MAX_TRACES")
 
     bundles = []
     for app, path, fix in DATASETS:
@@ -343,7 +345,7 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         f"{warmup_counters['backend_compiles']} compiles, "
         f"{warmup_counters['persistent_cache_hits']} cache hits)")
 
-    profile_dir = os.environ.get("TW_BENCH_PROFILE_DIR")
+    profile_dir = _knobs.get("TW_BENCH_PROFILE_DIR")
     auto_profile_dir = profile_dir is None
     if auto_profile_dir:
         profile_dir = tempfile.mkdtemp(prefix="tw_profile_")
@@ -491,14 +493,14 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     # (retries/bisections/fallbacks/quarantined/deadletter bytes) and the
     # chaos-vs-clean accuracy delta (must stay ≤ 1 pt) ship in the
     # report. ----------------------------------------------------------
-    chaos_spec = os.environ.get("TW_BENCH_FAULTS")
+    chaos_spec = _knobs.get("TW_BENCH_FAULTS")
     if chaos_spec:
         from traceweaver_tpu.runtime import faults as faults_mod
 
         t0 = time.perf_counter()
         chaos_stats: dict = {}
         chaos_q: list = []
-        chaos_seed = int(os.environ.get("TW_FAULTS_SEED", "0"))
+        chaos_seed = _knobs.get_int("TW_FAULTS_SEED")
         log(f"child: chaos leg under TW_BENCH_FAULTS={chaos_spec!r} "
             f"(seed {chaos_seed})")
         with faults_mod.override(chaos_spec, seed=chaos_seed) as plan:
@@ -602,7 +604,7 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     # all solver work (measured passes AND host-CPU enrichment) is done:
     # the baseline child may now run uncontended
     write_json_atomic(out_path + ".timing.done", {"ok": True})
-    profile_json = os.environ.get("TW_BENCH_PROFILE_JSON")
+    profile_json = _knobs.get("TW_BENCH_PROFILE_JSON")
     if profile_json:
         write_json_atomic(profile_json, {
             "backend": backend,
@@ -862,6 +864,9 @@ def run_ingest_leg(n_spans: int) -> dict:
             windows=windows, ranges=ranges, skip_caps=caps)
         return packed, windows, time.perf_counter() - t0
 
+    # twlint: disable=TW001 — raw save/restore of the literal env string
+    # (not a parsed knob read): the finally block must put back exactly
+    # what was set, including "unset"
     saved = os.environ.get("TW_COLUMNAR")
     try:
         # two timed passes per path, best-of (first pass pays allocator /
@@ -927,12 +932,12 @@ def run_serve_leg(n_tenants: int) -> dict:
     dispatches."""
     import jax
 
-    if os.environ.get("TW_BACKEND", "cpu") == "cpu":
+    if _knobs.get("TW_BACKEND") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     os.environ.setdefault("TW_RETRY_BACKOFF_S", "0")
     from traceweaver_tpu.serve import ServeConfig, TenantService
 
-    spec = os.environ.get("TW_BENCH_FAULTS") or "dispatch:0.5"
+    spec = _knobs.get("TW_BENCH_FAULTS") or "dispatch:0.5"
 
     def one_run(storm_spec=None):
         svc = TenantService(ServeConfig(
@@ -1045,9 +1050,9 @@ def run_baseline_child(bundle_path: str, out_path: str) -> None:
 
     jax.config.update("jax_platforms", "cpu")
 
-    budget = float(os.environ.get("TW_BENCH_BASELINE_BUDGET", "110"))
+    budget = _knobs.get_float("TW_BENCH_BASELINE_BUDGET")
     deadline_ts = time.time() + budget
-    record_path = os.environ.get("TW_BENCH_RECORD")
+    record_path = _knobs.get("TW_BENCH_RECORD")
 
     with open(bundle_path, "rb") as f:
         bundles = pickle.load(f)
@@ -1325,12 +1330,12 @@ def main() -> None:
             else:
                 os.environ["JAX_PLATFORMS"] = saved
         cpu_cache = os.path.join(
-            os.environ.get("TW_JAX_CACHE_DIR", DEFAULT_CACHE_DIR), cpu_key)
+            _knobs.get("TW_JAX_CACHE_DIR") or DEFAULT_CACHE_DIR, cpu_key)
         cache_primed = os.path.isdir(cpu_cache) and bool(os.listdir(cpu_cache))
-        full_needs = int(os.environ.get(
-            "TW_BENCH_CPU_FULL_NEEDS", "320" if cache_primed else "430"))
-        retry_reserve = int(os.environ.get("TW_BENCH_CPU_RETRY_RESERVE",
-                                           "130"))
+        env_needs = _knobs.get_int("TW_BENCH_CPU_FULL_NEEDS")
+        full_needs = env_needs if env_needs is not None else (
+            320 if cache_primed else 430)
+        retry_reserve = _knobs.get_int("TW_BENCH_CPU_RETRY_RESERVE")
         scopes = []
         if (remaining(deadline_ts) - BASELINE_RESERVE - MERGE_SLACK
                 - retry_reserve > full_needs):

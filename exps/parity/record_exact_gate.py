@@ -33,6 +33,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, REPO)
 
+from traceweaver_tpu.runtime import knobs as _knobs  # noqa: E402
+
 GATE_SPANS = 100
 COMPRESS = 10.0
 DATASETS = (
@@ -40,7 +42,7 @@ DATASETS = (
     ("media", "/root/reference/data/media_microservices/media_load25", 1),
 )
 OUT = os.path.join(REPO, "tests", "data", "exact_gate_recorded.json")
-ALARM_S = int(os.environ.get("TW_GATE_ALARM", "1200"))
+ALARM_S = _knobs.get_int("TW_GATE_ALARM")
 
 
 class _Timeout(Exception):

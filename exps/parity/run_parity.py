@@ -225,7 +225,9 @@ def main():
     # told otherwise (the sandbox sitecustomize force-selects the remote
     # "axon" TPU whose init can stall for minutes; env vars alone cannot
     # override it — the config update can).
-    if os.environ.get("TW_PARITY_BACKEND", "cpu") == "cpu":
+    from traceweaver_tpu.runtime import knobs as _knobs
+
+    if _knobs.get("TW_PARITY_BACKEND") == "cpu":
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,596 @@
+"""twlint (traceweaver_tpu/analysis) tests.
+
+Engine mechanics (suppressions, baseline, fingerprints), per-rule
+fixture snippets (positive + suppressed + clean), the knob-registry
+mirror pins, the TW002 regression tests (env changes take effect
+without reimport — the two import-time freezes this PR removed), and
+the tier-1 repo-wide zero-violation gate.
+
+Everything here is synthetic/in-memory except the gate, which walks the
+real repo with the real baseline — pure stdlib ``ast``, no JAX backend
+work, so the whole file is tier-1 fast.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from traceweaver_tpu.analysis import engine
+from traceweaver_tpu.analysis.engine import META_RULE
+
+pytestmark = pytest.mark.lint
+
+
+def lint(src, path="traceweaver_tpu/mod.py", extra=()):
+    sources = [(path, textwrap.dedent(src))] + [
+        (p, textwrap.dedent(s)) for p, s in extra]
+    return engine.analyze_sources(sources)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# a minimal stand-in for runtime/knobs.py: the TW001 cross-module
+# reconciliation parses _k(...) declarations out of whatever module sits
+# at that path
+KNOBS_FIXTURE = ("traceweaver_tpu/runtime/knobs.py", """
+    def _k(name, type, default):
+        return (name, type, default)
+
+    REGISTRY = {k[0]: k for k in [
+        _k("TW_ALPHA", "int", 1),
+        _k("TW_ORPHAN", "int", 2),
+    ]}
+""")
+
+
+# ---------------------------------------------------------------------------
+# engine: suppressions, baseline, fingerprints
+# ---------------------------------------------------------------------------
+
+RAW_READ = """
+    import os
+
+    def f():
+        return os.environ.get("TW_FOO", "1")
+"""
+
+
+def test_suppression_same_line():
+    src = RAW_READ.replace(
+        'os.environ.get("TW_FOO", "1")',
+        'os.environ.get("TW_FOO", "1")  # twlint: disable=TW001 — test')
+    findings, suppressed = lint(src)
+    assert findings == [] and suppressed == 1
+
+
+def test_suppression_on_preceding_comment_line():
+    src = """
+        import os
+
+        def f():
+            # twlint: disable=TW001 — justified here
+            return os.environ.get("TW_FOO", "1")
+    """
+    findings, suppressed = lint(src)
+    assert findings == [] and suppressed == 1
+
+
+def test_suppression_file_wide():
+    src = "# twlint: disable-file=TW001\n" + textwrap.dedent(RAW_READ)
+    findings, suppressed = engine.analyze_sources(
+        [("traceweaver_tpu/mod.py", src)])
+    assert findings == [] and suppressed == 1
+
+
+def test_suppression_with_unknown_rule_id_is_itself_a_finding():
+    src = RAW_READ.replace(
+        'os.environ.get("TW_FOO", "1")',
+        'os.environ.get("TW_FOO", "1")  # twlint: disable=TW999')
+    findings, _ = lint(src)
+    assert META_RULE in rules_of(findings)      # the typo'd waiver
+    assert "TW001" in rules_of(findings)        # ...did not waive
+
+
+def test_unsuppressed_raw_read_is_flagged():
+    findings, suppressed = lint(RAW_READ)
+    assert rules_of(findings) == ["TW001"] and suppressed == 0
+    assert "TW_FOO" in findings[0].message
+
+
+def test_fingerprint_stable_across_line_drift():
+    a, _ = lint(RAW_READ)
+    b, _ = lint("\n\n\n" + textwrap.dedent(RAW_READ))
+    assert a[0].line != b[0].line
+    assert a[0].fingerprint() == b[0].fingerprint()
+
+
+def test_baseline_roundtrip_and_staleness(tmp_path):
+    root = tmp_path / "repo"
+    (root / "traceweaver_tpu").mkdir(parents=True)
+    mod = root / "traceweaver_tpu" / "mod.py"
+    mod.write_text(textwrap.dedent(RAW_READ))
+    report = engine.run(root=str(root), baseline_path=None)
+    (f,) = report.findings
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(f"{f.rule} {f.path} {f.fingerprint()}  # grandfathered\n")
+    report = engine.run(root=str(root), baseline_path=str(bl))
+    assert report.ok and report.baselined == 1
+    # fix the violation -> the baseline entry goes stale -> TW000
+    mod.write_text("def f():\n    return 1\n")
+    report = engine.run(root=str(root), baseline_path=str(bl))
+    assert [f.rule for f in report.findings] == [META_RULE]
+    assert "stale" in report.findings[0].message
+
+
+def test_baseline_entry_requires_justification(tmp_path):
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("TW001 traceweaver_tpu/mod.py abcdef123456\n")
+    with pytest.raises(engine.BaselineError):
+        engine.load_baseline(str(bl))
+
+
+# ---------------------------------------------------------------------------
+# TW001 — knob discipline
+# ---------------------------------------------------------------------------
+
+def test_tw001_subscript_read_flagged_write_allowed():
+    findings, _ = lint("""
+        import os
+
+        def f():
+            os.environ["TW_FOO"] = "1"          # a write: launch config
+            os.environ.setdefault("TW_BAR", "0")  # also a write
+            return os.environ["TW_FOO"]          # the read is the hazard
+    """)
+    assert rules_of(findings) == ["TW001"]
+    assert findings[0].line_text.strip().startswith("return")
+
+
+def test_tw001_getenv_flagged_and_knobs_module_exempt():
+    findings, _ = lint("""
+        import os
+
+        def f():
+            return os.getenv("TW_FOO")
+    """)
+    assert rules_of(findings) == ["TW001"]
+    findings, _ = lint(RAW_READ, path="traceweaver_tpu/runtime/knobs.py")
+    assert findings == []
+    findings, _ = lint(RAW_READ, path="traceweaver_tpu/runtime/faults.py")
+    assert findings == []
+
+
+def test_tw001_non_tw_env_reads_are_not_this_linters_business():
+    findings, _ = lint("""
+        import os
+
+        def f():
+            return os.environ.get("JAX_PLATFORMS", "cpu")
+    """)
+    assert findings == []
+
+
+def test_tw001_registry_read_of_undeclared_knob():
+    findings, _ = lint("""
+        from traceweaver_tpu.runtime import knobs
+
+        def f():
+            # reading every declared knob keeps the fixture registry
+            # clean, isolating the undeclared-read finding
+            return (knobs.get_int("TW_ALPHA"), knobs.get_int("TW_ORPHAN"),
+                    knobs.get_int("TW_GHOST"))
+    """, extra=[KNOBS_FIXTURE])
+    assert rules_of(findings) == ["TW001"]
+    assert "never declared" in findings[0].message
+    assert "TW_GHOST" in findings[0].message
+
+
+def test_tw001_registered_but_never_read():
+    findings, _ = lint("""
+        from traceweaver_tpu.runtime import knobs as _knobs
+
+        def f():
+            return _knobs.get_int("TW_ALPHA")
+    """, extra=[KNOBS_FIXTURE])
+    (f,) = findings
+    assert f.rule == "TW001" and "TW_ORPHAN" in f.message
+    assert f.path == "traceweaver_tpu/runtime/knobs.py"
+
+
+# ---------------------------------------------------------------------------
+# TW002 — import-time freeze
+# ---------------------------------------------------------------------------
+
+def test_tw002_module_scope_reads_flagged_call_time_clean():
+    findings, _ = lint("""
+        import os
+        from traceweaver_tpu.runtime import knobs
+
+        FROZEN_RAW = os.environ.get("TW_FOO", "1")
+        FROZEN_TYPED = knobs.get_int("TW_BAR")
+
+        def f():
+            return knobs.get_int("TW_BAR")
+    """)
+    tw002 = [f for f in findings if f.rule == "TW002"]
+    assert len(tw002) == 2 and {f.line for f in tw002} == {5, 6}
+
+
+def test_tw002_scripts_outside_the_library_are_exempt():
+    findings, _ = lint("""
+        from traceweaver_tpu.runtime import knobs
+
+        DEADLINE = knobs.get_int("TW_BENCH_DEADLINE")
+    """, path="bench.py")
+    assert rules_of(findings) == []
+
+
+def test_tw002_class_body_counts_as_import_time():
+    findings, _ = lint("""
+        from traceweaver_tpu.runtime import knobs
+
+        class C:
+            BUDGET = knobs.get_int("TW_FOO")
+    """)
+    assert "TW002" in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# TW003 — host-sync hazard
+# ---------------------------------------------------------------------------
+
+HOT = "traceweaver_tpu/algorithms/fleet.py"
+
+
+def test_tw003_direct_conversion_of_dispatch_result():
+    findings, _ = lint("""
+        import numpy as np
+
+        def f(x):
+            out = solve_windows_fleet(x)
+            return np.asarray(out)
+    """, path=HOT)
+    assert rules_of(findings) == ["TW003"]
+
+
+def test_tw003_fetch_helper_is_the_allowed_site():
+    findings, _ = lint("""
+        import numpy as np
+
+        def _fetch(handle):
+            return np.asarray(handle)
+
+        def f(x):
+            out = solve_windows_fleet(x)
+            return _fetch(out)
+    """, path=HOT)
+    assert findings == []
+
+
+def test_tw003_taint_through_unpack_container_and_float():
+    findings, _ = lint("""
+        import numpy as np
+
+        def f(xs):
+            pending = []
+            for x in xs:
+                packed, out = solve_em_fleet(x)
+                pending.append((packed, out))
+            return [np.asarray(o) for _, o in pending]
+
+        def g(x):
+            out = refit_fleet_params(x)
+            v = out[0]
+            return float(v)
+
+        def h(x):
+            out = solve_windows(x)
+            return out.sum().item()
+    """, path=HOT)
+    assert rules_of(findings) == ["TW003", "TW003", "TW003"]
+
+
+def test_tw003_host_values_and_cold_modules_are_clean():
+    src = """
+        import numpy as np
+
+        def f(spans):
+            a = np.array([s.start for s in spans])
+            return np.asarray(a), float(a[0])
+    """
+    findings, _ = lint(src, path=HOT)
+    assert findings == []
+    # device-looking code outside the hot modules: not this rule's scope
+    findings, _ = lint("""
+        import numpy as np
+
+        def f(x):
+            return np.asarray(solve_windows(x))
+    """, path="traceweaver_tpu/parallel/mesh.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# TW004 — jit / recompile discipline
+# ---------------------------------------------------------------------------
+
+def test_tw004_sensitive_params_must_be_static():
+    findings, _ = lint("""
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, static_argnames=("precision", "pallas"))
+        def ok(x, precision, pallas):
+            return x
+
+        @partial(jax.jit, static_argnames=("n",))
+        def bad(x, n, precision):
+            return x
+
+        @jax.jit
+        def bad2(x, pallas):
+            return x
+    """)
+    assert rules_of(findings) == ["TW004", "TW004"]
+    assert "precision" in findings[0].message
+    assert "pallas" in findings[1].message
+
+
+def test_tw004_call_form_and_argnums_mapping():
+    findings, _ = lint("""
+        import jax
+
+        def plain(x, precision):
+            return x
+
+        ok = jax.jit(plain, static_argnums=(1,))
+        bad = jax.jit(plain)
+    """)
+    assert rules_of(findings) == ["TW004"]
+    assert findings[0].line_text.strip().startswith("bad")
+
+
+def test_tw004_inline_pow2_bucketing():
+    src = """
+        def pad(n):
+            return 1 << (n - 1).bit_length()
+    """
+    findings, _ = lint(src, path="traceweaver_tpu/algorithms/timing.py")
+    assert rules_of(findings) == ["TW004"]
+    # the one place allowed to implement it
+    findings, _ = lint(src, path="traceweaver_tpu/runtime/bucketing.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# TW005 — lock discipline
+# ---------------------------------------------------------------------------
+
+def test_tw005_guarded_attr_written_without_lock():
+    findings, _ = lint("""
+        import threading
+
+        class Stats:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.d = {}
+                self.events = []
+
+            def add(self, k):
+                with self._lock:
+                    self.d[k] = self.d.get(k, 0) + 1
+                    self.events.append(k)
+
+            def racy(self, k):
+                self.d[k] = 0
+
+            def racy_mutator(self, k):
+                self.events.append(k)
+
+            def fine(self):
+                self.unguarded_elsewhere = 1
+    """)
+    assert rules_of(findings) == ["TW005", "TW005"]
+    assert {f.line for f in findings} == {16, 19}
+
+
+def test_tw005_closure_bodies_do_not_inherit_the_lock():
+    findings, _ = lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.d = {}
+
+            def locked(self, k):
+                with self._lock:
+                    self.d[k] = 1
+
+                    def cb():
+                        self.d[k] = 2  # runs later, outside the lock
+                    return cb
+    """)
+    assert rules_of(findings) == ["TW005"]
+
+
+def test_tw005_lockless_classes_are_out_of_scope():
+    findings, _ = lint("""
+        class Plain:
+            def __init__(self):
+                self.d = {}
+
+            def set(self, k):
+                self.d[k] = 1
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# TW006 — precision discipline
+# ---------------------------------------------------------------------------
+
+OPS = "traceweaver_tpu/ops/mod.py"
+
+
+def test_tw006_accumulating_over_bf16():
+    findings, _ = lint("""
+        import jax.numpy as jnp
+
+        def f(x):
+            s = x.astype(jnp.bfloat16)
+            return jnp.sum(s)
+
+        def g(x):
+            return x.astype(jnp.bfloat16).sum()
+    """, path=OPS)
+    assert rules_of(findings) == ["TW006", "TW006"]
+
+
+def test_tw006_f32_upcast_or_accumulator_is_the_contract():
+    findings, _ = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            s = x.astype(jnp.bfloat16)
+            return jnp.sum(s.astype(jnp.float32))
+
+        def g(a, b):
+            logits = jax.lax.dot_general(
+                a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return jnp.logsumexp(logits)
+    """, path=OPS)
+    assert findings == []
+
+
+def test_tw006_outside_ops_is_out_of_scope():
+    findings, _ = lint("""
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sum(x.astype(jnp.bfloat16))
+    """, path="traceweaver_tpu/stream/window.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# registry mirrors + TW002 regressions (the two unfrozen knobs)
+# ---------------------------------------------------------------------------
+
+def test_vmem_registry_bounds_mirror_kernel_constants():
+    from traceweaver_tpu.ops import pallas_sinkhorn as ps
+    from traceweaver_tpu.runtime.knobs import REGISTRY
+
+    k = REGISTRY["TW_PALLAS_VMEM_CAP"]
+    assert k.default == ps._VMEM_CAP_DEFAULT_BYTES
+    assert k.lo == ps._VMEM_FLOOR_BYTES
+    assert k.hi == ps._VMEM_HW_BYTES_V5E
+
+
+def test_score_gemm_env_takes_effect_without_reimport(monkeypatch):
+    """The old import-time ``_USE_GEMM`` froze TW_SCORE_GEMM before a
+    fixture could export it; the call-time registry read must route the
+    very next (eager) evaluation."""
+    import numpy as np
+
+    import traceweaver_tpu.ops.scores as scores
+
+    calls = []
+    real = scores.mixture_logpdf_gemm
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(scores, "mixture_logpdf_gemm", spy)
+    t_prev = np.array([0.0, 10.0], dtype=np.float32)
+    out_start = np.array([5.0, 15.0, 25.0], dtype=np.float32)
+    w = np.array([1.0], dtype=np.float32)
+    mu = np.array([10.0], dtype=np.float32)
+    sd = np.array([3.0], dtype=np.float32)
+
+    monkeypatch.delenv("TW_SCORE_GEMM", raising=False)
+    base = np.asarray(scores.pair_scores(t_prev, out_start, w, mu, sd))
+    assert not calls
+    monkeypatch.setenv("TW_SCORE_GEMM", "1")
+    gemm = np.asarray(scores.pair_scores(t_prev, out_start, w, mu, sd))
+    assert calls, "TW_SCORE_GEMM=1 set after import must reach pair_scores"
+    np.testing.assert_allclose(gemm, base, rtol=1e-5, atol=1e-5)
+
+
+def test_fleet_budget_env_takes_effect_between_two_solves(monkeypatch):
+    """TW_FLEET_BUDGET exported between two solve_fleet calls (same
+    process, no reimport) must flip the second solve onto the budget-
+    fallback path — the old import-time FLEET_BUDGET_ELEMS constant
+    could not see it."""
+    import traceweaver_tpu.algorithms.fleet as fleet
+    from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+    from test_columnar import _random_problem
+
+    assert fleet.FLEET_BUDGET_ELEMS is None  # env-driven unless patched
+
+    def items():
+        in_spans, out_parts, _, ta, dag = _random_problem(
+            seed=3, n_traces=24, eps=("A", "B"))
+        return [FleetItem("svc", {"IN": in_spans}, out_parts, ta, dag)]
+
+    monkeypatch.delenv("TW_FLEET_BUDGET", raising=False)
+    stats_default = {}
+    fused = solve_fleet(items(), stats=stats_default)
+    assert stats_default.get("fleet_fallback_budget", 0) == 0
+
+    monkeypatch.setenv("TW_FLEET_BUDGET", "1")
+    stats_tiny = {}
+    fell_back = solve_fleet(items(), stats=stats_tiny)
+    assert stats_tiny.get("fleet_fallback_budget", 0) >= 1.0
+    for a, b in zip(fused, fell_back):
+        assert a[0] == b[0]  # budget path is result-equivalent
+
+    # the test-override hook still wins over the env
+    monkeypatch.setattr(fleet, "FLEET_BUDGET_ELEMS", 123)
+    assert fleet._fleet_budget_bytes() == 123 * 4
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing + the tier-1 repo gate
+# ---------------------------------------------------------------------------
+
+def test_module_entry_point_and_cli_subcommand_list_rules(capsys):
+    from traceweaver_tpu.analysis.__main__ import main as lint_main
+    from traceweaver_tpu.runtime import cli
+
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("TW001", "TW002", "TW003", "TW004", "TW005", "TW006"):
+        assert rid in out
+    assert cli.main(["lint", "--list-rules"]) == 0
+
+
+def test_repo_is_lint_clean():
+    """THE GATE: the full rule set over the whole repo, against the
+    checked-in baseline (kept empty — violations get fixed, not
+    grandfathered). A finding here blocks the merge; fix it, or if it
+    truly cannot be fixed yet, baseline it WITH a justification."""
+    report = engine.run()
+    assert report.files > 100  # the walk really saw the repo
+    assert report.ok, "\n" + report.render()
+
+
+def test_repo_gate_via_subprocess_exit_code():
+    """`python -m traceweaver_tpu.analysis` is what CI/operators run;
+    pin the exit-code contract end to end."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "traceweaver_tpu.analysis"],
+        capture_output=True, text=True, cwd=engine.REPO_ROOT, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout

@@ -380,9 +380,13 @@ def test_pallas_vmem_gate(monkeypatch):
     # a sub-floor override clamps up to the floor the kernel budgets
     monkeypatch.setenv("TW_PALLAS_VMEM_CAP", "1024")
     assert ps._vmem_cap_bytes() == ps._VMEM_FLOOR_BYTES
-    # unparsable values fall back to the default rather than crashing
+    # unparsable values now RAISE (the registry's raise-on-typo rule,
+    # PR 8 — previously a silent fall-back to the default)
+    from traceweaver_tpu.runtime.knobs import KnobError
+
     monkeypatch.setenv("TW_PALLAS_VMEM_CAP", "lots")
-    assert ps._vmem_cap_bytes() == ps._VMEM_CAP_DEFAULT_BYTES
+    with pytest.raises(KnobError):
+        ps._vmem_cap_bytes()
 
 
 def test_sinkhorn_dispatch_oversized_block_takes_jnp_path(monkeypatch):

@@ -33,7 +33,6 @@ path.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -75,11 +74,22 @@ from traceweaver_tpu.spans import NA
 # dispatches instead. Group costs are counted in BYTES at the score
 # precision (ops/precision.py), so a TW_PRECISION=bf16 solve fits ~2x
 # the windows per dispatch and ~2x the pipeline depth under one budget.
-FLEET_BUDGET_ELEMS = int(os.environ.get("TW_FLEET_BUDGET", 1 << 28))
+#
+# None = read TW_FLEET_BUDGET from the registry at CALL time (an env
+# change between two solves takes effect without reimport —
+# tests/test_analysis.py pins this); tests monkeypatch this attribute to
+# force a budget directly.
+FLEET_BUDGET_ELEMS: Optional[int] = None
+
+
+def _fleet_budget_elems() -> int:
+    if FLEET_BUDGET_ELEMS is not None:
+        return FLEET_BUDGET_ELEMS
+    return _knobs.get_int("TW_FLEET_BUDGET")
 
 
 def _fleet_budget_bytes() -> int:
-    return FLEET_BUDGET_ELEMS * 4
+    return _fleet_budget_elems() * 4
 
 # window-axis keys of a packed fleet batch, dispatch argument order
 _BATCH_KEYS = ("in_start", "in_end", "in_valid", "out_start", "out_end",
@@ -105,7 +115,7 @@ def _compaction_warm() -> int:
 def _compaction_on() -> bool:
     """``TW_COMPACT=0`` kills convergence compaction (single fused
     dispatch per group, the pre-compaction shape)."""
-    return os.environ.get("TW_COMPACT", "1") not in ("0", "false", "")
+    return _knobs.get_bool("TW_COMPACT")
 
 
 def _pipeline_on() -> bool:
@@ -113,7 +123,7 @@ def _pipeline_on() -> bool:
     dispatch, and decode strictly sequentially on the calling thread
     (the pre-pipeline flow, kept as the bit-identical reference path and
     as the kill switch)."""
-    return os.environ.get("TW_PIPELINE", "1") not in ("0", "false", "")
+    return _knobs.get_bool("TW_PIPELINE")
 
 
 def _decode_workers() -> int:
@@ -540,9 +550,9 @@ def solve_fleet(
     # on TPU padded cells are nearly-free VPU work and a saved dispatch
     # is ~100 ms of tunnel latency (merge aggressively); on the CPU
     # stand-in padded cells are real core-seconds (merge conservatively).
-    merge_env = os.environ.get("TW_FLEET_MERGE")
-    if merge_env:
-        merge_budget = int(merge_env)  # 0 = never merge shape classes
+    merge_env = _knobs.get_int("TW_FLEET_MERGE")
+    if merge_env is not None:
+        merge_budget = merge_env  # 0 = never merge shape classes
     else:
         import jax
 
@@ -1278,8 +1288,10 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
         params["ret_wt"], params["ret_mu"], params["ret_sd"])
     if mesh is not None:
         # pass 1 re-places everything itself; hand it host tables so the
-        # replicated device_put starts from committed-free arrays
-        new_tables = tuple(np.asarray(t) for t in new_tables)
+        # replicated device_put starts from committed-free arrays — a
+        # LEDGERED fetch (the refit tables are small, but the block on
+        # the refit program's execution is real device wait)
+        new_tables = tuple(_fetch(t, st, flow_wait) for t in new_tables)
     return _compacted_pass(batch, pidx, tables[:3] + tuple(new_tables),
                            n_sweeps, warm, hypers, st, mesh=mesh,
                            flow_wait=flow_wait,

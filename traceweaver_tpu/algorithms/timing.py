@@ -32,6 +32,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import networkx as nx
 import numpy as np
 
+from traceweaver_tpu.runtime import knobs as _knobs
+from traceweaver_tpu.runtime.bucketing import pow2_bucket
 from traceweaver_tpu.spans import NA, SKIP, Span
 
 MAX_COMPONENTS = 5
@@ -409,9 +411,7 @@ def fit_edge_gmms(samples_by_edge: Dict[EdgeKey, List[float]],
     (:func:`traceweaver_tpu.ops.gmm.fit_gmm_batched`); degenerate edges
     (constant or < 4 samples) take the closed-form host path, and
     ``TW_JAX_GMM=0`` falls back to the per-edge sklearn fit entirely."""
-    import os
-
-    use_device = os.environ.get("TW_JAX_GMM", "1") not in ("0", "false", "")
+    use_device = _knobs.get_bool("TW_JAX_GMM")
     dists: Dict[EdgeKey, EdgeDist] = {}
     device_keys: List[EdgeKey] = []
     device_samples: List[np.ndarray] = []
@@ -426,8 +426,8 @@ def fit_edge_gmms(samples_by_edge: Dict[EdgeKey, List[float]],
         from traceweaver_tpu.ops.gmm import fit_gmm_batched
 
         n = max(len(a) for a in device_samples)
-        n_pad = 1 << (n - 1).bit_length()
-        e_pad = 1 << (len(device_keys) - 1).bit_length()
+        n_pad = pow2_bucket(n)
+        e_pad = pow2_bucket(len(device_keys))
         # f64 all the way to fit_gmm_batched's host-side standardization —
         # packing in f32 here would forfeit the precision it preserves
         x = np.zeros((e_pad, n_pad), dtype=np.float64)

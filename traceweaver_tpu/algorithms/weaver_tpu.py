@@ -67,6 +67,7 @@ from traceweaver_tpu.ops.precision import (
 )
 from traceweaver_tpu.ops.scores import mixture_logpdf, pair_scores
 from traceweaver_tpu.runtime import knobs as _knobs
+from traceweaver_tpu.runtime.bucketing import pow2_bucket
 from traceweaver_tpu.spans import NA, SKIP, Span, SpanArray
 
 NEG = -1.0e9
@@ -873,11 +874,10 @@ def scatter_window_span_stats(windows, not_best, feas,
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
-    """Round up to a power of two (bounds jit recompilation variants)."""
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
+    """Round up to a power of two (bounds jit recompilation variants).
+    Wraps the shared :func:`traceweaver_tpu.runtime.bucketing.pow2_bucket`
+    with the sublane-tile minimum the dispatch shapes want."""
+    return pow2_bucket(n, minimum)
 
 
 def _window_bounds(windows: List[Tuple[int, int]], start: np.ndarray,
@@ -1644,6 +1644,10 @@ class WeaverTPU:
         results = []
         t0 = _time.perf_counter()
         for packed, out in pending:
+            # twlint: disable=TW003 — ledgered fetch site: the whole
+            # loop is billed to wait_s below (the copy_to_host_async
+            # pass above started every transfer; fleet-path fetches go
+            # through fleet._fetch instead)
             o = np.asarray(out)
             assign = o[..., 0]
             not_best = o[..., 1].astype(bool)

@@ -1,0 +1,32 @@
+"""twlint — repo-native static analysis for the traceweaver contracts.
+
+The codebase runs on invariants that grep can't hold: every ``TW_*``
+knob goes through the typed registry (PR 5), bf16 is storage-only with
+f32 accumulation (PR 4), dispatch shapes stay pow2-bucketed so the
+second solve costs zero compiles (PR 2/3), and shared pipeline state is
+mutated under locks (PR 3/6). This package mechanizes them as an
+import-light, stdlib-``ast`` rule engine with per-line suppression and
+a checked-in baseline, run as a tier-1 gate (tests/test_analysis.py)
+and on demand::
+
+    python -m traceweaver_tpu.analysis            # whole repo
+    python -m traceweaver_tpu.analysis ops/       # one subtree
+    python -m traceweaver_tpu.runtime.cli lint    # CLI spelling
+
+Rule catalog, suppression grammar, and how to add a rule:
+docs/ANALYSIS.md.
+"""
+
+from traceweaver_tpu.analysis.engine import (  # noqa: F401
+    DEFAULT_BASELINE,
+    BaselineError,
+    Finding,
+    Module,
+    Report,
+    analyze_sources,
+    format_baseline,
+    iter_python_files,
+    load_baseline,
+    run,
+)
+from traceweaver_tpu.analysis.rules import RULE_CLASSES  # noqa: F401

@@ -1,0 +1,781 @@
+"""twlint rules: the repo's cross-cutting contracts, mechanized.
+
+Each rule encodes an invariant the codebase already relies on but until
+now enforced only by convention (and violated silently — see the PR-8
+issue). Rules are deliberately narrow: they pattern-match the concrete
+hazard that has actually bitten, not a style preference, so a finding is
+actionable and a clean run means the contract holds. docs/ANALYSIS.md
+is the operator-facing catalog (rationale, examples, suppression
+guidance); this module is the source of truth for what each rule flags.
+
+Rule ids are stable (baseline entries and suppressions reference them):
+
+- TW001 knob discipline      — every TW_* env access goes through
+  runtime/knobs.py; registry and readers reconciled both ways
+- TW002 import-time freeze   — no module-scope TW_* reads in the library
+- TW003 host-sync hazard     — device→host conversions in hot-path
+  modules only at ledgered fetch sites
+- TW004 recompile discipline — precision/pallas-style jit args declared
+  static; pow2 bucketing never re-implemented inline
+- TW005 lock discipline      — attributes guarded by a class's lock are
+  guarded everywhere
+- TW006 precision discipline — no accumulation over bf16 storage blocks
+  without an explicit f32 accumulator
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from traceweaver_tpu.analysis.engine import Finding, Module
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression: ``os.environ.get`` for
+    the matching Attribute chain, ``""`` when not a plain name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _tw_name(node: ast.AST) -> Optional[str]:
+    s = const_str(node)
+    return s if s is not None and s.startswith("TW_") else None
+
+
+def outer_functions(tree: ast.AST) -> List[ast.FunctionDef]:
+    """FunctionDefs not nested inside another function (methods count;
+    their nested helpers are visited via ``ast.walk`` on the outer def)."""
+    out: List[ast.FunctionDef] = []
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(child)
+            else:
+                visit(child)
+
+    visit(tree)
+    return out
+
+
+_GETTERS = {"get", "get_int", "get_float", "get_bool"}
+
+
+def registry_read(node: ast.Call) -> Optional[str]:
+    """The TW_* name read through the knob registry by this call, if any
+    (``knobs.get_int("TW_X")``, ``_knobs.get("TW_X")``, bare
+    from-imported ``get_bool("TW_X")``)."""
+    name = dotted(node.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    if parts[-1] not in _GETTERS:
+        return None
+    if len(parts) > 1 and parts[-2] not in ("knobs", "_knobs"):
+        return None
+    if not node.args:
+        return None
+    return _tw_name(node.args[0])
+
+
+def raw_env_read(node: ast.AST) -> Optional[Tuple[str, ast.AST]]:
+    """(TW_* name, site) for a raw environment READ: ``os.environ.get``,
+    ``os.getenv``, or a Load-context ``os.environ[...]`` subscript.
+    Writes (``os.environ[k] = v``, ``setdefault``, ``pop``) are how
+    launchers configure children and are not reads."""
+    if isinstance(node, ast.Call):
+        name = dotted(node.func)
+        if name in ("os.environ.get", "environ.get", "os.getenv", "getenv"):
+            if node.args:
+                tw = _tw_name(node.args[0])
+                if tw:
+                    return tw, node
+    elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+        if dotted(node.value) in ("os.environ", "environ"):
+            tw = _tw_name(node.slice)
+            if tw:
+                return tw, node
+    return None
+
+
+def _env_touch(node: ast.AST) -> Optional[str]:
+    """Any TW_* name this node reads OR writes through the environment —
+    usage evidence for the registered-but-never-read reconciliation."""
+    got = raw_env_read(node)
+    if got:
+        return got[0]
+    if isinstance(node, ast.Subscript) and dotted(node.value) in (
+            "os.environ", "environ"):
+        return _tw_name(node.slice)
+    if isinstance(node, ast.Call):
+        name = dotted(node.func)
+        if name in ("os.environ.setdefault", "environ.setdefault",
+                    "os.environ.pop", "environ.pop") and node.args:
+            return _tw_name(node.args[0])
+    return None
+
+
+def _path_in(mod: Module, suffixes: Sequence[str]) -> bool:
+    return any(mod.path.endswith(s) for s in suffixes)
+
+
+# ---------------------------------------------------------------------------
+# TW001 — knob discipline
+# ---------------------------------------------------------------------------
+
+class KnobDiscipline:
+    """Every ``TW_*`` environment knob goes through the typed registry.
+
+    The registry (``runtime/knobs.py``, PR 5) is the single
+    parse/validate/default path: a typo'd value raises instead of
+    silently running the default, and ``warn_unknown`` can only see
+    knobs the registry knows. A raw ``os.environ`` read anywhere else
+    re-opens both holes. ``runtime/faults.py`` is the one other allowed
+    reader: it owns the TW_FAULTS spec grammar (site:p[:max=N]), which
+    is richer than the registry's scalar types.
+
+    Cross-module, the rule reconciles registry and readers both ways:
+    a knob read through the registry but never declared raises KeyError
+    at runtime — flag it at the read site; a knob declared but read
+    nowhere is dead configuration surface — flag it at the declaration.
+    """
+
+    id = "TW001"
+    title = "TW_* knob access outside the typed registry"
+
+    #: modules allowed to touch os.environ for TW_* names directly
+    ALLOWED_RAW = ("runtime/knobs.py", "runtime/faults.py")
+    #: declaration helpers inside knobs.py whose first arg names a knob
+    _DECLS = ("_k", "Knob")
+
+    def __init__(self) -> None:
+        self._registry_reads: List[Tuple[str, Module, ast.AST]] = []
+        self._touched: Set[str] = set()
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        allowed = _path_in(mod, self.ALLOWED_RAW)
+        for node in ast.walk(mod.tree):
+            touched = _env_touch(node)
+            if touched:
+                self._touched.add(touched)
+            got = raw_env_read(node)
+            if got and not allowed:
+                tw, site = got
+                findings.append(mod.finding(
+                    self.id, site,
+                    f"raw environment read of {tw!r} — route it through "
+                    "the typed registry (traceweaver_tpu.runtime.knobs."
+                    "get_*), which parses, validates, and defaults in one "
+                    "place"))
+            if isinstance(node, ast.Call):
+                tw = registry_read(node)
+                if tw:
+                    self._touched.add(tw)
+                    self._registry_reads.append((tw, mod, node))
+        return findings
+
+    def _parse_registry(self, knobs_mod: Module) -> Dict[str, ast.AST]:
+        decls: Dict[str, ast.AST] = {}
+        for node in ast.walk(knobs_mod.tree):
+            if (isinstance(node, ast.Call)
+                    and dotted(node.func).split(".")[-1] in self._DECLS
+                    and node.args):
+                tw = _tw_name(node.args[0])
+                if tw:
+                    decls[tw] = node
+        return decls
+
+    def check_repo(self, modules: Sequence[Module]) -> Iterable[Finding]:
+        knobs_mod = next((m for m in modules
+                          if m.path.endswith("runtime/knobs.py")), None)
+        if knobs_mod is None:
+            return []  # partial scan without the registry: nothing to say
+        decls = self._parse_registry(knobs_mod)
+        findings: List[Finding] = []
+        for tw, mod, node in self._registry_reads:
+            if tw not in decls:
+                findings.append(mod.finding(
+                    self.id, node,
+                    f"knob {tw!r} is read through the registry but never "
+                    "declared in runtime/knobs.py — the read raises "
+                    "KeyError at runtime; declare it typed + ranged"))
+        for tw in sorted(set(decls) - self._touched):
+            findings.append(knobs_mod.finding(
+                self.id, decls[tw],
+                f"knob {tw!r} is declared in the registry but read "
+                "nowhere — dead configuration surface; delete the "
+                "declaration or wire up the reader"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TW002 — import-time knob freeze
+# ---------------------------------------------------------------------------
+
+class ImportTimeFreeze:
+    """No module-scope ``TW_*`` reads inside the library.
+
+    A knob read at import time is frozen before test fixtures or a
+    launcher can export it (``monkeypatch.setenv`` after import is a
+    no-op), which is exactly how ``ops/scores.py`` ``_USE_GEMM`` and
+    ``algorithms/fleet.py`` ``FLEET_BUDGET_ELEMS`` went untestable.
+    Library modules (``traceweaver_tpu/``) must read knobs at call time;
+    one-shot scripts (bench.py, exps/) may keep module constants since
+    their env is fixed at launch.
+    """
+
+    id = "TW002"
+    title = "import-time TW_* read freezes the knob"
+
+    ROOT = "traceweaver_tpu/"
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if self.ROOT not in mod.path:
+            return []
+        findings: List[Finding] = []
+
+        def visit(node: ast.AST, in_func: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    visit(child, True)
+                    continue
+                if not in_func:
+                    tw = None
+                    got = raw_env_read(child)
+                    if got:
+                        tw = got[0]
+                    elif isinstance(child, ast.Call):
+                        tw = registry_read(child)
+                    if tw:
+                        findings.append(mod.finding(
+                            self.id, child,
+                            f"module-scope read of {tw!r} freezes the knob "
+                            "at import time (env changes and test "
+                            "fixtures can never reach it) — read it at "
+                            "call time, keeping a plain module attribute "
+                            "only as an explicit test-override hook"))
+                visit(child, in_func)
+
+        visit(mod.tree, False)
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TW003 — host-sync hazard
+# ---------------------------------------------------------------------------
+
+class HostSyncHazard:
+    """Device→host conversions in hot-path modules only at ledgered
+    fetch sites.
+
+    The PR-3 pipeline exists because an unledgered blocking fetch stalls
+    the dispatch flow invisibly: ``np.asarray(device_handle)`` blocks on
+    device execution and D2H without billing ``wait_s`` or the
+    ``d2h_bytes_*`` ledger, so the stall never shows up in stats and the
+    overlap math silently lies. In the hot modules every conversion of a
+    value produced by a device call must go through the ledgered helper
+    (``fleet._fetch``) or carry a per-line justification.
+
+    Mechanics: name-level taint, per function. Names bound (directly,
+    via tuple unpack, loop/comprehension targets, or container append)
+    from calls matching the device-producer patterns (``solve_*``,
+    ``refit_*``, ``fused_*``, ``device_put``) are device handles;
+    ``np.asarray`` / ``np.array`` / ``float()`` / ``.item()`` over a
+    tainted value is a finding. ``_fetch`` launders taint — its result
+    is host memory, already billed.
+    """
+
+    id = "TW003"
+    title = "unledgered device sync in a hot-path module"
+
+    HOT = ("algorithms/fleet.py", "algorithms/weaver_tpu.py",
+           "stream/service.py")
+    #: functions allowed to convert device handles: THE ledgered helper
+    ALLOWED_FUNCS = ("_fetch",)
+    _DEVICE_RE = re.compile(r"^(solve_|refit_|fused_)")
+    _DEVICE_EXACT = {"jax.device_put", "device_put"}
+    _CONVERSIONS = {"np.asarray", "np.array", "numpy.asarray",
+                    "numpy.array", "float"}
+    _LAUNDER = {"_fetch", "np.asarray", "np.array", "numpy.asarray",
+                "numpy.array", "float"}
+
+    def _is_device_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted(node.func)
+        last = name.split(".")[-1]
+        return bool(self._DEVICE_RE.match(last)) or name in self._DEVICE_EXACT
+
+    def _value_tainted(self, node: ast.AST, tainted: Set[str]) -> bool:
+        """Does evaluating this expression yield (or contain) a device
+        handle? Laundering calls (``_fetch``, the conversions themselves)
+        yield host arrays, so the walk does not descend into them."""
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name.split(".")[-1] in self._LAUNDER or name in self._LAUNDER:
+                return False
+        if self._is_device_call(node):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        for child in ast.iter_child_nodes(node):
+            if self._value_tainted(child, tainted):
+                return True
+        return False
+
+    @staticmethod
+    def _target_names(target: ast.AST) -> List[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for elt in target.elts:
+                out.extend(HostSyncHazard._target_names(elt))
+            return out
+        return []
+
+    def _collect_taints(self, fn: ast.AST) -> Set[str]:
+        tainted: Set[str] = set()
+        for _ in range(4):  # small fixpoint: taint chains are short
+            before = len(tainted)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if self._value_tainted(node.value, tainted):
+                        for t in node.targets:
+                            tainted.update(self._target_names(t))
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    if self._value_tainted(node.value, tainted):
+                        tainted.update(self._target_names(node.target))
+                elif isinstance(node, ast.For):
+                    if self._value_tainted(node.iter, tainted):
+                        tainted.update(self._target_names(node.target))
+                elif isinstance(node, ast.comprehension):
+                    if self._value_tainted(node.iter, tainted):
+                        tainted.update(self._target_names(node.target))
+                elif isinstance(node, ast.Call):
+                    # pending.append((packed, out)) taints `pending`
+                    name = dotted(node.func)
+                    if name.split(".")[-1] in ("append", "extend", "insert"):
+                        base = node.func.value if isinstance(
+                            node.func, ast.Attribute) else None
+                        if isinstance(base, ast.Name) and any(
+                                self._value_tainted(a, tainted)
+                                for a in node.args):
+                            tainted.add(base.id)
+            if len(tainted) == before:
+                break
+        return tainted
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not _path_in(mod, self.HOT):
+            return []
+        findings: List[Finding] = []
+        for fn in outer_functions(mod.tree):
+            if fn.name in self.ALLOWED_FUNCS:
+                continue
+            tainted = self._collect_taints(fn)
+            if not tainted:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                if name in self._CONVERSIONS and node.args and \
+                        self._value_tainted(node.args[0], tainted):
+                    findings.append(mod.finding(
+                        self.id, node,
+                        f"{name}() over a device handle blocks on device "
+                        "execution + D2H without billing wait_s / "
+                        "d2h_bytes_* — fetch through the ledgered helper "
+                        "(fleet._fetch) or justify with a suppression"))
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item" and not node.args
+                        and self._value_tainted(node.func.value, tainted)):
+                    findings.append(mod.finding(
+                        self.id, node,
+                        ".item() over a device handle is an unledgered "
+                        "blocking sync — fetch through fleet._fetch"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TW004 — jit / recompile discipline
+# ---------------------------------------------------------------------------
+
+class RecompileDiscipline:
+    """Precision/pallas-style arguments are static jit args; pow2
+    bucketing is never re-implemented inline.
+
+    (a) ``precision`` and ``pallas``/``allow_pallas`` select different
+    device programs (PR 4 made precision a static arg precisely so f32
+    compiles the historical program bit-identically; the supervisor's
+    Pallas-free rung needs its own cache entry). A jit call site that
+    takes such a parameter without declaring it static either fails at
+    trace time (string arg) or, worse, bakes one variant's program into
+    the other's cache key.
+
+    (b) Dispatch shapes must come from the shared pow2 bucketing helpers
+    (``runtime/bucketing.pow2_bucket`` and its wrappers
+    ``weaver_tpu._bucket`` / ``mesh.bucket_rows_per_shard``) so the
+    zero-recompile smoke keeps meaning something; an inline
+    ``1 << (n - 1).bit_length()`` is a second implementation of the
+    contract that can drift (and did — ``algorithms/timing.py``).
+    """
+
+    id = "TW004"
+    title = "jit static-arg / pow2-bucketing discipline"
+
+    SENSITIVE = {"precision", "pallas", "allow_pallas", "interpret",
+                 "method"}
+    BUCKET_MODULES = ("runtime/bucketing.py",)
+
+    # -- (a) static args ----------------------------------------------------
+
+    @staticmethod
+    def _is_jax_jit(node: ast.AST) -> bool:
+        return dotted(node) in ("jax.jit", "jit")
+
+    @staticmethod
+    def _static_names(call: ast.Call, params: List[str]) -> Set[str]:
+        static: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                vals = (kw.value.elts
+                        if isinstance(kw.value, (ast.Tuple, ast.List))
+                        else [kw.value])
+                for v in vals:
+                    s = const_str(v)
+                    if s:
+                        static.add(s)
+            elif kw.arg == "static_argnums":
+                vals = (kw.value.elts
+                        if isinstance(kw.value, (ast.Tuple, ast.List))
+                        else [kw.value])
+                for v in vals:
+                    if isinstance(v, ast.Constant) and isinstance(
+                            v.value, int) and 0 <= v.value < len(params):
+                        static.add(params[v.value])
+        return static
+
+    @staticmethod
+    def _params(args: ast.arguments) -> List[str]:
+        return [a.arg for a in args.posonlyargs + args.args
+                + args.kwonlyargs]
+
+    def _check_site(self, mod: Module, site: ast.AST, jit_call,
+                    fn_args: ast.arguments) -> Iterable[Finding]:
+        params = self._params(fn_args)
+        static = (self._static_names(jit_call, params)
+                  if isinstance(jit_call, ast.Call) else set())
+        for p in params:
+            if p in self.SENSITIVE and p not in static:
+                yield mod.finding(
+                    self.id, site,
+                    f"jit call site takes {p!r} without declaring it in "
+                    "static_argnames/static_argnums — precision/pallas-"
+                    "class arguments select distinct device programs and "
+                    "must be static (PR 4 contract, docs/PERF.md)")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        fn_defs: Dict[str, ast.arguments] = {
+            f.name: f.args
+            for f in ast.walk(mod.tree)
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_jax_jit(dec):
+                        findings.extend(self._check_site(
+                            mod, dec, None, node.args))
+                    elif isinstance(dec, ast.Call):
+                        if self._is_jax_jit(dec.func):
+                            findings.extend(self._check_site(
+                                mod, dec, dec, node.args))
+                        elif (dotted(dec.func).split(".")[-1] == "partial"
+                              and dec.args
+                              and self._is_jax_jit(dec.args[0])):
+                            findings.extend(self._check_site(
+                                mod, dec, dec, node.args))
+            elif (isinstance(node, ast.Call) and self._is_jax_jit(node.func)
+                    and node.args and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in fn_defs):
+                findings.extend(self._check_site(
+                    mod, node, node, fn_defs[node.args[0].id]))
+            # -- (b) inline pow2 bucketing --------------------------------
+            elif (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.LShift)
+                    and isinstance(node.right, ast.Call)
+                    and isinstance(node.right.func, ast.Attribute)
+                    and node.right.func.attr == "bit_length"
+                    and not _path_in(mod, self.BUCKET_MODULES)):
+                findings.append(mod.finding(
+                    self.id, node,
+                    "inline power-of-two bucketing (`1 << "
+                    "(...).bit_length()`) bypasses the shared helpers — "
+                    "use traceweaver_tpu.runtime.bucketing.pow2_bucket "
+                    "(or weaver_tpu._bucket / mesh.bucket_rows_per_shard) "
+                    "so dispatch shapes share ONE bucketing contract"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TW005 — lock discipline
+# ---------------------------------------------------------------------------
+
+class LockDiscipline:
+    """Attributes guarded by a class's lock are guarded everywhere.
+
+    ``fleet._Stats`` exists because pack threads, decode workers, and
+    the serve pump all mutate shared state (PR 3/6); a single bare
+    ``self.d[k] = ...`` outside the lock re-introduces the silent
+    dropped-count race the accumulator was built to kill. For every
+    class that owns a ``threading.Lock``/``RLock``/``Condition``
+    attribute, any attribute that is ever written under ``with
+    self.<lock>`` must be written under it in every method
+    (``__init__`` excepted — construction happens-before publication).
+    Nested functions count as unlocked even when lexically inside a
+    ``with`` block: closures outlive the critical section (the pipeline
+    submits them to worker pools).
+    """
+
+    id = "TW005"
+    title = "lock-guarded attribute written without the lock"
+
+    _LOCK_CTORS = {"threading.Lock", "threading.RLock",
+                   "threading.Condition", "Lock", "RLock", "Condition"}
+    _MUTATORS = {"append", "extend", "add", "update", "setdefault", "pop",
+                 "popleft", "clear", "remove", "discard", "insert",
+                 "appendleft"}
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        locks: Set[str] = set()
+        for node in ast.walk(cls):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and dotted(node.value.func) in self._LOCK_CTORS):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        locks.add(t.attr)
+        return locks
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        """`self.X` → X; `self.X[...]` → X; else None."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def _writes(self, method: ast.FunctionDef, locks: Set[str]
+                ) -> List[Tuple[str, bool, ast.AST]]:
+        """(attr, under_lock, site) for every self-attribute write."""
+        out: List[Tuple[str, bool, ast.AST]] = []
+
+        def visit(node: ast.AST, locked: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_locked = locked
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    # a closure's body runs whenever it is CALLED — the
+                    # enclosing with-block guards nothing about that
+                    visit(child, False)
+                    continue
+                if isinstance(child, ast.With):
+                    holds = any(
+                        self._self_attr(item.context_expr) in locks
+                        for item in child.items)
+                    child_locked = locked or holds
+                if isinstance(child, (ast.Assign, ast.AugAssign)):
+                    targets = (child.targets
+                               if isinstance(child, ast.Assign)
+                               else [child.target])
+                    for t in targets:
+                        elts = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                                else [t])
+                        for e in elts:
+                            attr = self._self_attr(e)
+                            if attr:
+                                out.append((attr, child_locked, child))
+                elif (isinstance(child, ast.Call)
+                        and isinstance(child.func, ast.Attribute)
+                        and child.func.attr in self._MUTATORS):
+                    attr = self._self_attr(child.func.value)
+                    if attr:
+                        out.append((attr, child_locked, child))
+                visit(child, child_locked)
+
+        visit(method, False)
+        return out
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in [n for n in ast.walk(mod.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            locks = self._lock_attrs(cls)
+            if not locks:
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            writes = {m.name: self._writes(m, locks) for m in methods}
+            guarded: Set[str] = {
+                attr
+                for name, ws in writes.items() if name != "__init__"
+                for attr, locked, _ in ws if locked}
+            guarded -= locks
+            for name, ws in writes.items():
+                if name == "__init__":
+                    continue
+                for attr, locked, site in ws:
+                    if attr in guarded and not locked:
+                        findings.append(mod.finding(
+                            self.id, site,
+                            f"self.{attr} is written under `with "
+                            f"self.{'/'.join(sorted(locks))}` elsewhere in "
+                            f"class {cls.name} but not here — an unlocked "
+                            "read-modify-write silently drops updates "
+                            "under the pipelined dispatcher (PR 3 "
+                            "contract; fleet._Stats is the pattern)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# TW006 — precision discipline
+# ---------------------------------------------------------------------------
+
+class PrecisionDiscipline:
+    """bf16 is storage-only: accumulation happens in f32.
+
+    The PR-4 contract: score blocks may be STORED bfloat16, but every
+    accumulating op (sum/cumsum/dot/logsumexp/...) runs f32 — bf16's
+    8-bit mantissa loses whole spans' worth of log-density mass when
+    hundreds of window cells reduce into one scalar. In ``ops/``,
+    feeding a value cast to bf16 into an accumulating op without an f32
+    upcast (or a ``preferred_element_type`` f32 accumulator on the
+    matmul forms) is a finding.
+    """
+
+    id = "TW006"
+    title = "accumulating op over a bf16 block without f32 accumulation"
+
+    OPS_DIR = "ops/"
+    ACCUM = {"sum", "cumsum", "dot", "tensordot", "matmul", "einsum",
+             "logsumexp", "mean", "prod", "cumprod", "dot_general"}
+
+    @staticmethod
+    def _is_bf16_cast(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and len(node.args) == 1
+                and dotted(node.args[0]).split(".")[-1] in ("bfloat16",)
+                )
+
+    @staticmethod
+    def _is_f32_cast(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and len(node.args) == 1
+                and dotted(node.args[0]).split(".")[-1] in (
+                    "float32", "float64"))
+
+    def _value_bf16(self, node: ast.AST, tainted: Set[str]) -> bool:
+        if self._is_f32_cast(node):
+            return False  # explicit upcast launders
+        if isinstance(node, ast.Call) and self._has_f32_accumulator(node):
+            return False  # f32-accumulated matmul yields f32
+        if self._is_bf16_cast(node):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        for child in ast.iter_child_nodes(node):
+            if self._value_bf16(child, tainted):
+                return True
+        return False
+
+    def _collect(self, fn: ast.AST) -> Set[str]:
+        tainted: Set[str] = set()
+        for _ in range(4):
+            before = len(tainted)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and self._value_bf16(
+                        node.value, tainted):
+                    for t in node.targets:
+                        tainted.update(HostSyncHazard._target_names(t))
+            if len(tainted) == before:
+                break
+        return tainted
+
+    @staticmethod
+    def _has_f32_accumulator(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "preferred_element_type":
+                return dotted(kw.value).split(".")[-1] not in ("bfloat16",)
+        return False
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if self.OPS_DIR not in mod.path:
+            return []
+        findings: List[Finding] = []
+        for fn in outer_functions(mod.tree):
+            tainted = self._collect(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                if name:
+                    last = name.split(".")[-1]
+                elif isinstance(node.func, ast.Attribute):
+                    # method form on a non-Name root: expr.sum()
+                    last = node.func.attr
+                else:
+                    continue
+                if last not in self.ACCUM:
+                    continue
+                if self._has_f32_accumulator(node):
+                    continue
+                hot = any(self._value_bf16(a, tainted) for a in node.args)
+                if not hot and isinstance(node.func, ast.Attribute):
+                    # method form: x_bf16.sum()
+                    hot = self._value_bf16(node.func.value, tainted)
+                if hot:
+                    findings.append(mod.finding(
+                        self.id, node,
+                        f"{last}() accumulates a bfloat16 block — bf16 is "
+                        "storage-only (PR 4 contract, docs/PERF.md): "
+                        "upcast with .astype(jnp.float32) first, or pass "
+                        "preferred_element_type=jnp.float32 on the matmul "
+                        "forms"))
+        return findings
+
+
+#: registration order == reporting order for same-line findings
+RULE_CLASSES = [KnobDiscipline, ImportTimeFreeze, HostSyncHazard,
+                RecompileDiscipline, LockDiscipline, PrecisionDiscipline]

@@ -28,6 +28,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from traceweaver_tpu.runtime import knobs as _knobs
+
 _NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
 _LIB_PATH = _NATIVE_DIR / "libtwnative.so"
 
@@ -117,7 +119,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
     when ``TW_DISABLE_NATIVE`` is set or the build/load fails (callers then
     use the pure-Python path). The env guard lives here — every entry point
     below routes through this accessor."""
-    if os.environ.get("TW_DISABLE_NATIVE"):
+    if _knobs.get_bool("TW_DISABLE_NATIVE"):
         return None
     global _lib, _lib_failed
     with _lock:

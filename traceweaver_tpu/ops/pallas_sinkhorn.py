@@ -23,10 +23,11 @@ conflict structure is bipartite, so entropic OT + rounding covers it.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
+
+from traceweaver_tpu.runtime import knobs as _knobs
 
 NEG = -1.0e9
 
@@ -48,15 +49,11 @@ _VMEM_FLOOR_BYTES = 32 * 1024 * 1024
 def _vmem_cap_bytes() -> int:
     """Scoped-VMEM cap, read from TW_PALLAS_VMEM_CAP at CALL time (an
     import-time read would freeze the value before test fixtures or a
-    launcher export it) and clamped into [floor, v5e per-core VMEM]."""
-    raw = os.environ.get("TW_PALLAS_VMEM_CAP")
-    if raw is None:
-        return _VMEM_CAP_DEFAULT_BYTES
-    try:
-        cap = int(raw)
-    except ValueError:
-        return _VMEM_CAP_DEFAULT_BYTES
-    return max(_VMEM_FLOOR_BYTES, min(cap, _VMEM_HW_BYTES_V5E))
+    launcher export it). The registry clamps into [floor, v5e per-core
+    VMEM] (its lo/hi mirror the module constants —
+    tests/test_analysis.py pins the mirror) and raises KnobError on an
+    unparseable value instead of silently running the default."""
+    return _knobs.get_int("TW_PALLAS_VMEM_CAP")
 
 
 def _sublane(itemsize: int) -> int:
@@ -416,7 +413,7 @@ def assign_topk(S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap,
     entry instead of re-hitting the cached kernel program).
     """
     n, m = S_ot.shape
-    fused_ok = os.environ.get("TW_PALLAS_FUSED", "1") not in ("0", "false", "")
+    fused_ok = _knobs.get_bool("TW_PALLAS_FUSED")
     if (not allow_pallas or not fused_ok or not use_pallas()
             or n * m < 64 * 128
             or not fits_pallas_vmem(n, m, jnp.dtype(S_ot.dtype).itemsize)):
@@ -424,7 +421,7 @@ def assign_topk(S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap,
             S_ot, row_marg, col_marg, in_valid, col_valid, skip_cap, n_rows,
             epsilon=epsilon, n_iters=n_iters, tol=tol, topk=topk,
             min_topk_mass=min_topk_mass)
-    if os.environ.get("TW_PALLAS_INTERPRET") == "1":
+    if _knobs.get_bool("TW_PALLAS_INTERPRET"):
         return fused_assign_pallas(
             S_ot, row_marg, col_marg, skip_cap, n_rows,
             epsilon=epsilon, n_iters=n_iters, tol=tol, topk=topk,
@@ -457,9 +454,9 @@ def _tpu_backend() -> bool:
 def use_pallas() -> bool:
     """Policy switch: TW_PALLAS=1 forces on (interpret off-TPU via
     TW_PALLAS_INTERPRET=1), TW_PALLAS=0 forces off, default = on real TPU."""
-    env = os.environ.get("TW_PALLAS")
+    env = _knobs.get_bool("TW_PALLAS")
     if env is not None:
-        return env not in ("0", "false", "")
+        return env
     return _tpu_backend()
 
 
@@ -482,7 +479,7 @@ def sinkhorn(scores, row_marginals, col_marginals, epsilon=1.0, n_iters=50,
             or not fits_pallas_vmem(n, m, jnp.dtype(scores.dtype).itemsize)):
         return sinkhorn_log(scores, row_marginals, col_marginals,
                             epsilon=epsilon, n_iters=n_iters, tol=tol)
-    if os.environ.get("TW_PALLAS_INTERPRET") == "1":
+    if _knobs.get_bool("TW_PALLAS_INTERPRET"):
         # explicit kernel-semantics testing off-TPU
         return sinkhorn_log_pallas(
             scores, row_marginals, col_marginals,

@@ -32,9 +32,9 @@ VMEM-resident blocks and the dispatch pipeline ~2x deeper groups.
 
 from __future__ import annotations
 
-import os
-
 import jax.numpy as jnp
+
+from traceweaver_tpu.runtime import knobs as _knobs
 
 #: accepted values of TW_PRECISION / the ``precision`` solver arguments
 PRECISIONS = ("f32", "bf16")
@@ -62,8 +62,10 @@ def validate_precision(precision: str) -> str:
 def precision_from_env() -> str:
     """The active score-path precision (``TW_PRECISION``, default f32).
     Read at call time — test fixtures and launchers export it after
-    import."""
-    return validate_precision(os.environ.get("TW_PRECISION", "f32"))
+    import. The registry hands back the raw string;
+    :func:`validate_precision` owns the alias table (``fp32``,
+    ``bfloat16``, ...) and the raise-on-typo rule."""
+    return validate_precision(_knobs.get("TW_PRECISION"))
 
 
 def score_dtype(precision: str):

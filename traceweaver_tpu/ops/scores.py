@@ -10,20 +10,32 @@ traceweaver_v1.py:117-148, with one fused vectorized evaluation).
 from __future__ import annotations
 
 import math
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.scipy.special import logsumexp
 
+from traceweaver_tpu.runtime import knobs as _knobs
+
 LOG_2PI = math.log(2.0 * math.pi)
 
-# TW_SCORE_GEMM=1 routes eligible mixture evaluations through the
-# quadratic-feature matmul formulation (see mixture_logpdf_gemm) — the
-# "put the MXU to work" experiment. Default off: the measured roofline
-# (docs/ROOFLINE.md) shows the [.., 3] x [3, K<=5] contraction cannot
-# tile the 128x128 systolic array and the elementwise form wins.
-_USE_GEMM = os.environ.get("TW_SCORE_GEMM") == "1"
+
+def _use_gemm() -> bool:
+    """TW_SCORE_GEMM=1 routes eligible mixture evaluations through the
+    quadratic-feature matmul formulation (see mixture_logpdf_gemm) — the
+    "put the MXU to work" experiment. Default off: the measured roofline
+    (docs/ROOFLINE.md) shows the [.., 3] x [3, K<=5] contraction cannot
+    tile the 128x128 systolic array and the elementwise form wins.
+
+    Read from the typed registry at CALL time (i.e. at trace time under
+    jit) — the old import-time ``_USE_GEMM`` froze the knob before test
+    fixtures or a launcher could export it. NOTE: under jit this selects
+    the traced program; an already-cached program for the same shapes is
+    NOT retraced on an env flip — eager callers and fresh shape classes
+    see the change immediately (tests/test_analysis.py pins the eager
+    path), sweep children get it via their fresh processes.
+    """
+    return _knobs.get_bool("TW_SCORE_GEMM")
 
 
 def mixture_logpdf_gemm(x: jnp.ndarray, weights: jnp.ndarray,
@@ -87,7 +99,7 @@ def mixture_logpdf(x: jnp.ndarray, weights: jnp.ndarray, means: jnp.ndarray,
     x: [...]; weights/means/stds: [..., K] broadcastable against x[..., None].
     Components with weight 0 are padding.
     """
-    if _USE_GEMM and weights.ndim == 1:
+    if _use_gemm() and weights.ndim == 1:
         return mixture_logpdf_gemm(x, weights, means, stds)
     z = (x[..., None] - means) / stds
     comp = -0.5 * z * z - jnp.log(stds) - 0.5 * LOG_2PI
@@ -109,7 +121,7 @@ def pair_scores(t_prev: jnp.ndarray, out_start: jnp.ndarray,
     accumulation step get the cast here.
     """
     delta = out_start[None, :] - t_prev[:, None]  # [N, M]
-    if _USE_GEMM and weights.ndim == 1:
+    if _use_gemm() and weights.ndim == 1:
         return mixture_logpdf_gemm(delta, weights, means, stds,
                                    out_dtype=out_dtype)
     out = mixture_logpdf(delta, weights, means, stds)

@@ -79,11 +79,10 @@ def bucket_rows_per_shard(n_rows: int, n_shards: int) -> int:
     variants, the same discipline as ``weaver_tpu._bucket``) and the
     total divides evenly across the mesh. ``n_shards=1`` degenerates to
     plain power-of-two bucketing (the single-device compaction path)."""
+    from traceweaver_tpu.runtime.bucketing import pow2_bucket
+
     per_shard = -(-max(1, n_rows) // n_shards)  # ceil division
-    b = 1
-    while b < per_shard:
-        b *= 2
-    return b * n_shards
+    return pow2_bucket(per_shard) * n_shards
 
 
 def _pad_batch(arrays: Dict[str, np.ndarray], multiple: int) -> Tuple[Dict[str, np.ndarray], int]:

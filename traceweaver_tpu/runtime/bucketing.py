@@ -1,0 +1,25 @@
+"""The ONE power-of-two bucketing implementation.
+
+Dispatch shapes must be pow2-bucketed so data-dependent sizes (window
+counts, straggler counts, GMM sample counts) cannot mint unbounded jit
+program variants — the zero-recompile smoke (tests/test_bench_smoke.py)
+is the behavioural pin, and twlint TW004 (docs/ANALYSIS.md) flags any
+inline ``1 << (n - 1).bit_length()`` re-implementation so the contract
+cannot fork again. ``weaver_tpu._bucket`` (minimum 8, the sublane tile)
+and ``mesh.bucket_rows_per_shard`` (pow2 per shard) wrap this.
+
+Import-light on purpose: callers include host-only ingest/fit paths
+that must not pull jax.
+"""
+
+from __future__ import annotations
+
+
+def pow2_bucket(n: int, minimum: int = 1) -> int:
+    """Smallest power-of-two multiple of ``minimum`` that is >= ``n``
+    (``minimum`` itself must be a power of two; n <= 0 buckets to
+    ``minimum``)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b

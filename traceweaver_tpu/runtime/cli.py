@@ -66,17 +66,20 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _parse_mesh_devices(raw: str) -> int:
+def _mesh_devices_from_env() -> int:
     """TW_MESH_DEVICES must be 0 (single device) or a positive power of
     two (the window-batch padding divides evenly across mesh devices);
     anything else is a configuration error worth failing loudly on,
-    before any data loads."""
+    before any data loads. The registry read raises
+    :class:`~traceweaver_tpu.runtime.knobs.KnobError` on a non-integer;
+    the pow2 shape constraint is this module's to enforce."""
+    from traceweaver_tpu.runtime import knobs
+
     try:
-        n = int(raw or "0")
-    except ValueError:
-        raise SystemExit(
-            f"TW_MESH_DEVICES={raw!r} is not an integer") from None
-    if n < 0 or (n > 0 and n & (n - 1) != 0):
+        n = knobs.get_int("TW_MESH_DEVICES")
+    except knobs.KnobError as e:
+        raise SystemExit(str(e)) from None
+    if n > 0 and n & (n - 1) != 0:
         raise SystemExit(
             f"TW_MESH_DEVICES={n} must be 0 or a positive power of two")
     return n
@@ -310,6 +313,12 @@ def main(argv=None) -> int:
     from traceweaver_tpu.runtime import knobs
 
     knobs.warn_unknown()
+    if argv and argv[0] == "lint":
+        # twlint static analysis (docs/ANALYSIS.md): import-light, no
+        # JAX backend — safe before any backend/config decisions
+        from traceweaver_tpu.analysis.__main__ import main as lint_main
+
+        return lint_main(argv[1:])
     if argv and argv[0] == "query":
         # offline delay-culprit query (the paper's marquee use case,
         # docs/SERVING.md): no JAX backend needed — pure host analytics
@@ -321,7 +330,7 @@ def main(argv=None) -> int:
         # network service mode: same backend discipline as `stream`
         import jax
 
-        if os.environ.get("TW_BACKEND", "cpu") == "cpu":
+        if knobs.get("TW_BACKEND") == "cpu":
             jax.config.update("jax_platforms", "cpu")
         from traceweaver_tpu.runtime.jax_cache import (
             enable_persistent_compilation_cache,
@@ -334,7 +343,7 @@ def main(argv=None) -> int:
         # below stays byte-compatible with the reference executor CLI
         import jax
 
-        if os.environ.get("TW_BACKEND", "cpu") == "cpu":
+        if knobs.get("TW_BACKEND") == "cpu":
             jax.config.update("jax_platforms", "cpu")
         from traceweaver_tpu.runtime.jax_cache import (
             enable_persistent_compilation_cache,
@@ -347,7 +356,7 @@ def main(argv=None) -> int:
     # var alone cannot override it, only a config update can. Experiment
     # sweeps default to CPU; set TW_BACKEND=axon (or tpu) to run the
     # solver on the chip.
-    backend = os.environ.get("TW_BACKEND", "cpu")
+    backend = knobs.get("TW_BACKEND")
     if backend == "cpu":
         import jax
 
@@ -425,12 +434,10 @@ def main(argv=None) -> int:
         # multi-chip: TW_MESH_DEVICES=N shards solver window batches over
         # an N-device 1-D mesh (XLA SPMD; see parallel/mesh.py). Env, not
         # a flag, to keep the reference CLI surface byte-compatible.
-        mesh_devices=_parse_mesh_devices(
-            os.environ.get("TW_MESH_DEVICES", "0")),
+        mesh_devices=_mesh_devices_from_env(),
         # TW_GT_FREE_DAG=1: ground-truth-free invocation-DAG discovery
         # (ingest.discover_invocation_dag); env for the same reason
-        gt_free_dag=os.environ.get("TW_GT_FREE_DAG", "")
-        not in ("", "0", "false"),
+        gt_free_dag=knobs.get_bool("TW_GT_FREE_DAG"),
     )
     run_experiment(cfg)  # prints per-method accuracy as it goes
     return 0

@@ -15,6 +15,8 @@ import hashlib
 import os
 import platform
 
+from traceweaver_tpu.runtime import knobs as _knobs
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_cache")
@@ -119,9 +121,9 @@ def enable_persistent_compilation_cache(cache_dir: str | None = None) -> str:
     so entries compiled elsewhere can never be deserialized here.
     """
     install_compile_counters()
-    if os.environ.get("TW_JAX_CACHE", "1") in ("0", "false", ""):
+    if not _knobs.get_bool("TW_JAX_CACHE"):
         return ""
-    base_dir = (cache_dir or os.environ.get("TW_JAX_CACHE_DIR")
+    base_dir = (cache_dir or _knobs.get("TW_JAX_CACHE_DIR")
                 or DEFAULT_CACHE_DIR)
     cache_dir = os.path.join(base_dir, host_cache_key())
     os.makedirs(cache_dir, exist_ok=True)

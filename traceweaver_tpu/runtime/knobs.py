@@ -61,13 +61,21 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        help="live-dispatch budget (f32-element-denominated)"),
     _k("TW_FLEET_MERGE", "int", None, lo=0,
        help="shape-class merge budget override (0 = never merge)"),
-    _k("TW_PRECISION", "enum", "f32", choices=("f32", "bf16"),
-       help="score-block storage precision (ops/precision.py validates)"),
+    # declared "str", not "enum": ops/precision.py owns the alias table
+    # (fp32/float32/bfloat16/...) and the raise-on-typo rule
+    _k("TW_PRECISION", "str", "f32",
+       help="score-block storage precision (f32|bf16; ops/precision.py "
+            "validates and normalizes aliases)"),
     _k("TW_COLUMNAR", "bool", True,
        help="0 kills the columnar host pack path (object-walk packing, "
             "the bit-identical pre-columnar flow)"),
-    _k("TW_SCORE_GEMM", "str", None, help="score GEMM path override"),
-    _k("TW_JAX_GMM", "str", None, help="GMM refit path override"),
+    _k("TW_SCORE_GEMM", "bool", False,
+       help="1 routes eligible mixture evaluations through the "
+            "quadratic-feature GEMM form (ops/scores.py; measured slower "
+            "on this geometry — docs/ROOFLINE.md)"),
+    _k("TW_JAX_GMM", "bool", True,
+       help="0 falls back to the per-edge sklearn GMM fit "
+            "(algorithms/timing.py)"),
     # --- Pallas ----------------------------------------------------------
     _k("TW_PALLAS", "bool", None,
        help="force the Pallas kernels on/off (default: on real TPU)"),
@@ -75,8 +83,12 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        help="run Pallas kernels in interpret mode (off-TPU testing)"),
     _k("TW_PALLAS_FUSED", "bool", True,
        help="0 keeps Pallas per-stage (no cross-stage fusion)"),
-    _k("TW_PALLAS_VMEM_CAP", "int", 96 << 20, lo=1,
-       help="scoped-VMEM admission budget (clamped to v5e 128MB/core)"),
+    # lo/hi mirror ops/pallas_sinkhorn.py's _VMEM_FLOOR_BYTES /
+    # _VMEM_HW_BYTES_V5E (this module must stay import-light, so the
+    # constants can't be imported; tests/test_analysis.py pins the mirror)
+    _k("TW_PALLAS_VMEM_CAP", "int", 96 << 20, lo=32 << 20, hi=128 << 20,
+       help="scoped-VMEM admission budget (clamped to [32MB floor, v5e "
+            "128MB/core])"),
     # --- runtime/backends ------------------------------------------------
     _k("TW_BACKEND", "str", "cpu", help="CLI backend selection (cpu|axon|tpu)"),
     _k("TW_MESH_DEVICES", "int", 0, lo=0,
@@ -143,6 +155,18 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("TW_BENCH_PROFILE_JSON", "str", None, help="profile summary sidecar"),
     _k("TW_BENCH_FAULTS", "str", None,
        help="chaos-leg fault spec for bench --faults (default dispatch:0.2)"),
+    # --- standalone experiment scripts (exps/, utils/) -------------------
+    _k("TW_PARITY_BACKEND", "str", "cpu",
+       help="exps/parity/run_parity.py backend selection"),
+    _k("TW_GATE_ALARM", "int", 1200, lo=1,
+       help="exps/parity/record_exact_gate.py per-service alarm (s)"),
+    _k("TW_SUB100_ALARM", "int", 480, lo=1,
+       help="exps/parity/run_sub100_banked.py per-service alarm (s)"),
+    _k("TW_ROOFLINE_BACKEND", "str", "cpu",
+       help="utils/score_roofline.py backend selection"),
+    _k("TW_ENTRY_SMOKE_CPU", "bool", True,
+       help="__graft_entry__ smoke run pins the CPU backend (0 keeps the "
+            "process default)"),
 ]}
 
 

@@ -42,7 +42,9 @@ COMPRESS = 10.0
 def run_child(config: str) -> None:
     import jax
 
-    if os.environ.get("TW_ROOFLINE_BACKEND", "cpu") == "cpu":
+    from traceweaver_tpu.runtime import knobs as _knobs
+
+    if _knobs.get("TW_ROOFLINE_BACKEND") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
     import numpy as np
