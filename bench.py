@@ -193,8 +193,18 @@ def subset_problem(prob, n):
 # ---------------------------------------------------------------------------
 
 def _parse_profile(profile_dir):
-    """Device-plane busy time + top self-time ops from the xplane trace."""
+    """Device-plane busy time + top self-time ops from the xplane trace.
+
+    Returns None when this jax version cannot deserialize xplane traces
+    in-process (``jax.profiler.ProfileData`` not exported — the feature
+    check lives in obs/profile.py so the bench test can skip cleanly
+    instead of erroring)."""
     import glob
+
+    from traceweaver_tpu.obs.profile import profile_data_available
+
+    if not profile_data_available():
+        return None
 
     from jax.profiler import ProfileData
 
@@ -349,12 +359,16 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
     auto_profile_dir = profile_dir is None
     if auto_profile_dir:
         profile_dir = tempfile.mkdtemp(prefix="tw_profile_")
+    from traceweaver_tpu.obs.registry import get_registry
+
     jax.profiler.start_trace(profile_dir)
     stage_stats: dict = {}
     counters0 = compile_counters()
+    telemetry0 = get_registry().snapshot()
     t0 = time.perf_counter()
     preds = one_pass(stage_stats)
     solve_time = time.perf_counter() - t0
+    telemetry1 = get_registry().snapshot()
     jax.profiler.stop_trace()
     timed_counters = counters_delta(counters0)
     if timed_counters["backend_compiles"]:
@@ -438,6 +452,13 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         "mfu_est_pct": round(100.0 * flops / max(device_s_wall, 1e-9)
                              / peak_flops, 4),
     }
+    # obs-registry agreement proof (docs/OBSERVABILITY.md): the timed
+    # pass's registry counter deltas must equal the legacy stats dict
+    # field-for-field — the mirror is real, not a second bookkeeper
+    report.update(telemetry_fields(stage_stats, telemetry0, telemetry1))
+    if not report["telemetry_matches_legacy"]:
+        log("child: WARNING — obs registry deltas disagree with the "
+            f"legacy stage stats on {report['telemetry_mismatch_keys']}")
     # measurement is on disk from this point on — a timeout kill can only
     # lose enrichment below, never the headline
     write_json_atomic(out_path, report)
@@ -725,6 +746,49 @@ def serve_fields(n_tenants: int, clean: dict, storm: dict) -> dict:
         "serve_only_faulty_tenant_accrues": bool(
             storm.get("healthy_quarantined", 1) == 0
             and storm.get("healthy_shed", 1) == 0),
+    }
+
+
+def telemetry_fields(stage_stats: dict, snap_before: dict,
+                     snap_after: dict) -> dict:
+    """Obs-registry agreement proof -> report fields (unit-tested like
+    chaos_fields/ingest_fields, tests/test_bench.py).
+
+    ``snap_before``/``snap_after`` are registry ``snapshot()`` dicts
+    taken around the timed solve; the fleet ledger mirror's counter
+    deltas (``tw_fleet_ledger_total{key=...}``) must equal the solve's
+    legacy ``stage_stats`` dict field-for-field. Keys mirrored as
+    GAUGES (``record_max`` high-water marks like ``pipeline_depth``) are
+    process-wide maxima, not per-solve deltas, so they are excluded
+    from the counter comparison — the gauge set is read from the
+    snapshot itself, never hardcoded."""
+    import re as _re
+
+    ledger_re = _re.compile(r'^tw_fleet_ledger_total\{key="([^"]+)"\}$')
+    gauge_re = _re.compile(r'^tw_fleet_gauge\{key="([^"]+)"\}$')
+    deltas = {}
+    gauge_keys = set()
+    for name, val in snap_after.items():
+        m = ledger_re.match(name)
+        if m:
+            d = val - snap_before.get(name, 0.0)
+            if d:
+                deltas[m.group(1)] = d
+            continue
+        g = gauge_re.match(name)
+        if g:
+            gauge_keys.add(g.group(1))
+    legacy = {k: float(v) for k, v in stage_stats.items()
+              if isinstance(v, (int, float)) and k not in gauge_keys}
+    mismatches = sorted(
+        k for k in set(legacy) | set(deltas)
+        if abs(deltas.get(k, 0.0) - legacy.get(k, 0.0))
+        > 1e-6 * max(1.0, abs(legacy.get(k, 0.0))))
+    return {
+        "telemetry_snapshot": {k: round(v, 6)
+                               for k, v in sorted(deltas.items())},
+        "telemetry_matches_legacy": not mismatches,
+        "telemetry_mismatch_keys": mismatches,
     }
 
 

@@ -484,6 +484,65 @@ def test_tw006_outside_ops_is_out_of_scope():
 
 
 # ---------------------------------------------------------------------------
+# TW007 — metric discipline
+# ---------------------------------------------------------------------------
+
+FLEET = "traceweaver_tpu/algorithms/fleet.py"
+
+
+def test_tw007_adhoc_counter_growth_flagged():
+    findings, _ = lint("""
+        _COUNTERS = {"hits": 0, "misses": 0}
+
+        def f(stats, key):
+            stats[key] += 1
+
+        def g(d, k, v):
+            d[k] = d.get(k, 0.0) + v
+    """, path=FLEET)
+    assert rules_of(findings) == ["TW007", "TW007", "TW007"]
+
+
+def test_tw007_sanctioned_accumulators_and_non_counters_clean():
+    findings, _ = lint("""
+        STAGES = {"pack": "host", "decode": "host"}  # not a counter table
+
+        class _Stats:
+            def add(self, key, val=1.0):
+                self.d[key] = self.d.get(key, 0.0) + val
+
+            def bucket(self, key, subkey, val=1.0):
+                d = self.d.setdefault(key, {})
+                d[subkey] = d.get(subkey, 0.0) + val
+
+        class Svc:
+            def _bump(self, key, n=1):
+                self.stats[key] = self.stats.get(key, 0) + n
+
+            def offer(self):
+                self.shed_spilled += 1  # attribute counter: out of scope
+    """, path="traceweaver_tpu/stream/service.py")
+    assert findings == []
+
+
+def test_tw007_suppression_and_scope():
+    findings, _ = lint("""
+        def f(live, spec):
+            # twlint: disable=TW007 — gate state, not telemetry
+            live["elems"] += spec.cost
+    """, path="traceweaver_tpu/serve/tenancy.py")
+    assert findings == []
+    # outside the watched modules the rule says nothing
+    findings, _ = lint("""
+        _COUNTERS = {"hits": 0}
+
+        def f(stats):
+            stats["x"] += 1
+    """, path="traceweaver_tpu/runtime/jax_cache.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # registry mirrors + TW002 regressions (the two unfrozen knobs)
 # ---------------------------------------------------------------------------
 

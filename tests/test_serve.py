@@ -460,3 +460,98 @@ def test_serve_cli_resume_roundtrip(tmp_path):
     out = resumed.flush()  # the checkpointed open windows still solve
     assert out["solved_windows"] > 0
     resumed.drain()
+
+
+def test_metrics_scrape_under_load_matches_stats_ledger(tmp_path):
+    """GET /metrics (Prometheus text) under concurrent ingest load:
+    scrapes stay parseable while POSTs land, and the final scrape's
+    per-tenant window/dispatch/ladder counters equal the /api/v1/stats
+    JSON ledger EXACTLY (the exposition derives from the same stats()
+    call, so disagreement is impossible by construction — this pins it
+    against refactors that would fork the two surfaces)."""
+    from traceweaver_tpu.serve import make_server
+
+    service = TenantService(_cfg(state_dir=str(tmp_path / "m")))
+    server = make_server(service, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def _scrape():
+        req = urllib.request.Request(base + "/metrics")
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+            assert "version=0.0.4" in resp.headers["Content-Type"]
+            return resp.read().decode()
+
+    def _parse(text):
+        out = {}
+        for line in text.splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            name, _, val = line.rpartition(" ")
+            out[name] = float(val)
+        return out
+
+    scrape_errors = []
+
+    def scrape_loop():
+        try:
+            for _ in range(10):
+                _parse(_scrape())
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            scrape_errors.append(e)
+
+    try:
+        scraper = threading.Thread(target=scrape_loop)
+        scraper.start()
+        # concurrent load: several tenants POSTing while scrapes run
+        posters = []
+        for tid in ("alpha", "beta", "gamma"):
+            def post(tid=tid):
+                code, out = _http(
+                    "POST", base + f"/api/v1/tenants/{tid}/spans",
+                    hotel_payload(prefix=tid[0]))
+                assert code == 200, out
+            t = threading.Thread(target=post)
+            t.start()
+            posters.append(t)
+        for t in posters:
+            t.join()
+        scraper.join()
+        assert not scrape_errors, scrape_errors
+        code, _ = _http("POST", base + "/api/v1/flush")
+        assert code == 200
+
+        metrics = _parse(_scrape())
+        code, st = _http("GET", base + "/api/v1/stats")
+        assert code == 200
+
+        # dispatch ledger: every kind, exactly
+        for kind, v in st["dispatch"].items():
+            assert metrics[f'tw_serve_dispatch_total{{kind="{kind}"}}'] \
+                == float(v), kind
+        # per-tenant window counters: every exposed field, exactly
+        for tid, t in st["tenants"].items():
+            for key in ("consumed", "emitted_windows", "spans_emitted",
+                        "traces_emitted", "solved_windows",
+                        "deadletter_windows", "quarantined_windows",
+                        "ring_traces"):
+                name = (f'tw_serve_tenant_total{{key="{key}",'
+                        f'tenant="{tid}"}}')
+                assert metrics[name] == float(t[key]), name
+            # ladder counters per tenant, exactly
+            for rung, v in t["faults"].items():
+                name = (f'tw_serve_tenant_faults_total{{rung="{rung}",'
+                        f'tenant="{tid}"}}')
+                assert metrics[name] == float(v), name
+        # the process registry rides the same scrape: the fleet ledger
+        # mirror saw this solve's dispatches
+        assert metrics.get(
+            'tw_fleet_ledger_total{key="fleet_dispatches"}', 0) > 0
+        assert 'tw_xla_compile_events_total{kind="backend_compiles"}' \
+            in metrics
+    finally:
+        server.shutdown()
+        server.server_close()
+    service.drain()

@@ -58,6 +58,10 @@ from traceweaver_tpu.algorithms.weaver_tpu import (
     solve_em_fleet,
     solve_windows_fleet,
 )
+from traceweaver_tpu.obs import events as _events
+from traceweaver_tpu.obs import profile as _profile
+from traceweaver_tpu.obs import selftrace as _selftrace
+from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.ops.precision import (
     precision_from_env,
     score_itemsize,
@@ -163,6 +167,33 @@ def _fault_check(site: str, st: "_Stats") -> None:
         raise
 
 
+# obs registry mirrors (docs/OBSERVABILITY.md): every _Stats update
+# ALSO lands in the process metrics registry so `GET /metrics` sees the
+# fleet ledger with labels. The legacy dict stays authoritative for
+# bench/executor field names; the bench `telemetry_snapshot` field
+# proves the two agree (registry counter deltas == the solve's dict).
+_OBS = _get_registry()
+_OBS_LEDGER = _OBS.counter(
+    "tw_fleet_ledger_total",
+    "fleet solve ledger mirror (one series per _Stats counter key)",
+    labels=("key",))
+_OBS_GAUGE = _OBS.gauge(
+    "tw_fleet_gauge",
+    "fleet high-water marks (_Stats.record_max mirror)",
+    labels=("key",))
+_OBS_LADDER = _OBS.counter(
+    "tw_fault_ladder_events_total",
+    "solve-supervisor degradation-ladder rungs walked",
+    labels=("key", "rung"))
+_OBS_TENANT = _OBS.counter(
+    "tw_tenant_windows_total",
+    "per-tenant fleet window buckets (packed/redispatched/decoded)",
+    labels=("key", "tenant"))
+_OBS_DISPATCH_S = _OBS.histogram(
+    "tw_dispatch_seconds",
+    "per-group fleet dispatch launch time (host side)")
+
+
 class _Stats:
     """Lock-guarded accumulator over the caller's stats dict.
 
@@ -170,26 +201,32 @@ class _Stats:
     flow workers, and the per-service fallback pool all mutate the same
     dict; a bare ``stats[k] = stats.get(k, 0) + v`` read-modify-write
     would race and silently drop counts, so every update goes through
-    one locked helper. ``d is None`` (caller passed no stats) makes every
-    method a no-op."""
+    one locked helper. ``d is None`` (caller passed no stats) makes the
+    dict half a no-op; the obs-registry mirror runs either way, so the
+    scrape surface never has blind spots (twlint TW007 enforces that no
+    new counter grows outside this path)."""
 
     def __init__(self, d: Optional[Dict[str, float]]):
         self.d = d
         self._lock = threading.Lock()
 
     def add(self, key: str, val: float = 1.0) -> None:
+        _OBS_LEDGER.inc(val, key=key)
         if self.d is None:
             return
         with self._lock:
             self.d[key] = self.d.get(key, 0.0) + val
 
     def record_max(self, key: str, val: float) -> None:
+        _OBS_GAUGE.set_max(val, key=key)
         if self.d is None:
             return
         with self._lock:
             self.d[key] = max(self.d.get(key, 0.0), val)
 
     def merge(self, other: Dict[str, float]) -> None:
+        for k, v in other.items():
+            _OBS_LEDGER.inc(v, key=k)
         if self.d is None:
             return
         with self._lock:
@@ -200,7 +237,12 @@ class _Stats:
         """Append to an ORDERED event list under ``key`` (the supervisor's
         degradation-ladder audit trail — ``fault_ladder``). List-valued,
         unlike every counter, so consumers that aggregate numerically
-        must skip it; it serializes to JSON like the rest of the dict."""
+        must skip it; it serializes to JSON like the rest of the dict.
+        Each event also mirrors to the labelled ladder counter and, when
+        an event sink is installed (``TW_EVENTS``), to the structured
+        JSONL log — the durable, timestamped copy of this list."""
+        _OBS_LADDER.inc(1.0, key=key, rung=event)
+        _events.emit(key, event)
         if self.d is None:
             return
         with self._lock:
@@ -212,6 +254,7 @@ class _Stats:
         Dict-valued like ``note``'s lists, so numeric aggregators skip
         it; only written when the serve layer actually tags items with
         tenants, so no-tenant callers' stats dicts are unchanged."""
+        _OBS_TENANT.inc(val, key=key, tenant=subkey)
         if self.d is None:
             return
         with self._lock:
@@ -221,6 +264,20 @@ class _Stats:
 
 def _as_stats(stats) -> _Stats:
     return stats if isinstance(stats, _Stats) else _Stats(stats)
+
+
+def _trace_stage(keys, stage: str, w0_us: float,
+                 w1_us: Optional[float] = None) -> None:
+    """Record one pipeline stage on every window trace in ``keys``
+    (obs/selftrace.py). ``keys`` is the group's host-side trace context
+    — carried on the dispatch ticket so pack thread, flow workers, and
+    decode workers all stamp the same windows. One global read and out
+    when no tracer is installed (the production default)."""
+    tr = _selftrace.active()
+    if tr is None or not keys:
+        return
+    for key in keys:
+        tr.stage(key, stage, w0_us, w1_us)
 
 
 def _copy_async(out) -> None:
@@ -247,6 +304,9 @@ def _fetch(handle, st: _Stats, flow_wait=None, flag_fetch: bool = False):
     dt = time.perf_counter() - t0
     st.add("wait_s", dt)
     if flow_wait is not None:
+        # twlint: disable=TW007 — flow-local wait aggregator (a 1-element
+        # list returned to the dispatcher), not a ledger counter; the
+        # telemetry copy is the st.add("wait_s") mirror above
         flow_wait[0] += dt
     st.add("d2h_bytes_fetched", float(out.nbytes))
     if flag_fetch:
@@ -260,7 +320,8 @@ class FleetItem:
     def __init__(self, svc, in_span_partitions, out_span_partitions,
                  true_assignments, dag=None,
                  method="MaxScoreBatchSubsetWithSkips", store=None,
-                 warm_dists=None, tenant=None, in_cols=None, out_cols=None):
+                 warm_dists=None, tenant=None, in_cols=None, out_cols=None,
+                 trace_key=None):
         self.svc = svc
         self.in_span_partitions = in_span_partitions
         self.out_span_partitions = out_span_partitions
@@ -291,6 +352,13 @@ class FleetItem:
         # (batch callers), _prepare converts once at the solve boundary.
         self.in_cols = in_cols
         self.out_cols = out_cols
+        # optional self-trace window key (obs/selftrace.py): the host-side
+        # trace context that follows this item's windows through the pack
+        # thread, dispatch flows, and decode workers so the pipeline's own
+        # journey spans land on the right window's trace. None (the
+        # default) with no tracer installed costs one global read per
+        # hook site.
+        self.trace_key = trace_key
 
 
 def _prepare(item: FleetItem, solver: WeaverTPU):
@@ -744,11 +812,17 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
     ``fault_ladder`` event list."""
     retry_max = _retry_max()
     backoff = _retry_backoff_s()
+    # ladder rungs stamp the affected windows' self-traces too, so a
+    # reconstructed pipeline trace shows WHERE a window's time went when
+    # the supervisor engaged (tw-retry/tw-bisect/... stage services)
+    rung_keys = sorted({p[1].trace_key for p in spec.group
+                        if p[1].trace_key is not None})
     for attempt in range(retry_max):
         if backoff > 0:
             time.sleep(backoff * (2 ** attempt))
         st.add("fault_retries")
         st.note("fault_ladder", "retry")
+        _trace_stage(rung_keys, "retry", _selftrace.now_us())
         try:
             _attempt_group(solver, pg, spec, results, st, hypers_common,
                            mesh)
@@ -763,6 +837,7 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
         # bisect: isolate the offender instead of failing the class
         st.add("fault_bisections")
         st.note("fault_ladder", "bisect")
+        _trace_stage(rung_keys, "bisect", _selftrace.now_us())
         mid = len(spec.group) // 2
         itemsize = score_itemsize(hypers_common.get("precision", "f32"))
         for half in (spec.group[:mid], spec.group[mid:]):
@@ -779,6 +854,7 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
     # --- singleton rungs -------------------------------------------------
     st.add("fault_xla_fallbacks")
     st.note("fault_ladder", "xla")
+    _trace_stage(rung_keys, "xla-fallback", _selftrace.now_us())
     try:
         _attempt_group(solver, pg, spec, results, st,
                        {**hypers_common, "pallas": False}, mesh)
@@ -791,6 +867,7 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
     plan = spec.group[0]
     st.add("fault_host_fallbacks")
     st.note("fault_ladder", "host")
+    _trace_stage(rung_keys, "host-fallback", _selftrace.now_us())
     try:
         _fault_check("host", st)
         _run_fallback([(plan[0], plan[1])], results, ctx["all_spans"],
@@ -804,6 +881,7 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
 
     st.add("fault_quarantined")
     st.note("fault_ladder", "quarantine")
+    _trace_stage(rung_keys, "quarantine", _selftrace.now_us())
     results[plan[0]] = _quarantine_result(plan)
     ctx["quarantined"].append(plan[0])
 
@@ -926,7 +1004,11 @@ def _solve_groups_pipelined(specs, solver, results, st, hypers_common,
                 while live["elems"] > 0 and \
                         live["elems"] + spec.cost > _fleet_budget_bytes():
                     gate.wait()
+                # twlint: disable=TW007 — admission-gate state under the
+                # gate condition lock, not telemetry; the observable copy
+                # is the pipeline_depth record_max mirror below
                 live["elems"] += spec.cost
+                # twlint: disable=TW007 — same: gate state, mirrored below
                 live["flows"] += 1
                 st.record_max("pipeline_depth", float(live["flows"]))
             flow_futs.append(flow_pool.submit(flow, pg, spec))
@@ -945,6 +1027,7 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
     W_pad, M_pad, E_pad, bmax = spec.W_pad, spec.M_pad, spec.E_pad, spec.bmax
     n_passes = spec.n_passes
     t0 = time.perf_counter()
+    w0 = _selftrace.now_us()
     arrays_cat: Dict[str, List[np.ndarray]] = {}
     param_rows: Dict[str, List[np.ndarray]] = {k: [] for k in _TABLE_KEYS}
     per_item_pack = []
@@ -1006,6 +1089,12 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
         window_rows[p, :n_w] = np.arange(row0, row0 + n_w, dtype=np.int32)
         window_valid[p, :n_w] = True
         row0 += n_w
+    # self-trace context for this group: every distinct window key whose
+    # item rides this dispatch (carried on the ticket below — the decode
+    # worker that finishes the flow stamps the same keys)
+    trace_keys = sorted({item.trace_key for _, item, *_ in group
+                         if item.trace_key is not None})
+    _trace_stage(trace_keys, "pack", w0)
     st.add("pack_s", time.perf_counter() - t0)
     st.add("fleet_dispatches", 1.0)
     st.add("fleet_services", float(len(per_item_pack)))
@@ -1036,7 +1125,8 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
                 window_rows=window_rows, window_valid=window_valid,
                 per_item_pack=per_item_pack, max_preds=_mp, max_succs=_ms,
                 tenant_table=tenant_table,
-                tenant_col=np.asarray(tenant_idx, dtype=np.int32))
+                tenant_col=np.asarray(tenant_idx, dtype=np.int32),
+                trace_keys=trace_keys)
 
 
 def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
@@ -1113,6 +1203,8 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
                  np.full(batch["in_start"].shape[0] - true_b, -1,
                          dtype=tenant_col.dtype)])
     t0 = time.perf_counter()
+    w0 = _selftrace.now_us()
+    trace_keys = pg.get("trace_keys") or ()
     # this flow's blocking time (compacted intermediate fetches), so
     # dispatch_s below stays pure launch/host time even when several
     # flows bill wait_s to the shared dict concurrently
@@ -1122,37 +1214,45 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
             batch, pidx, params, _tables_of(params), window_rows,
             window_valid, n_passes, n_sweeps, warm, hypers, st,
             mesh=mesh, flow_wait=flow_wait,
-            tenant_col=tenant_col, tenant_table=tenant_table)
+            tenant_col=tenant_col, tenant_table=tenant_table,
+            trace_keys=trace_keys)
     else:
-        if mesh is not None:
-            import jax
-            from jax.sharding import NamedSharding, PartitionSpec
+        with _profile.annotate("tw:fleet:dispatch"):
+            if mesh is not None:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
 
-            from traceweaver_tpu.parallel.mesh import put_sharded
+                from traceweaver_tpu.parallel.mesh import put_sharded
 
-            # put_sharded: window-axis keys sharded, everything else
-            # (param tables, window_rows/valid) replicated
-            placed = put_sharded(
-                {**batch, **params,
-                 "window_rows": window_rows, "window_valid": window_valid},
-                mesh)
-            batch = {k: placed[k] for k in batch}
-            params = {k: placed[k] for k in params}
-            window_rows = placed["window_rows"]
-            window_valid = placed["window_valid"]
-            pidx = jax.device_put(
-                pidx, NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
-        common = tuple(batch[k] for k in _BATCH_KEYS) + (pidx,)
-        if n_passes == 2:
-            out, _ = solve_em_fleet(
-                *common, window_rows, window_valid, *_tables_of(params),
-                n_sweeps=n_sweeps, **hypers,
-            )
-        else:
-            out, _ = solve_windows_fleet(
-                *common, *_tables_of(params), n_sweeps=n_sweeps, **hypers,
-            )
-    st.add("dispatch_s", time.perf_counter() - t0 - flow_wait[0])
+                # put_sharded: window-axis keys sharded, everything else
+                # (param tables, window_rows/valid) replicated
+                placed = put_sharded(
+                    {**batch, **params,
+                     "window_rows": window_rows,
+                     "window_valid": window_valid},
+                    mesh)
+                batch = {k: placed[k] for k in batch}
+                params = {k: placed[k] for k in params}
+                window_rows = placed["window_rows"]
+                window_valid = placed["window_valid"]
+                pidx = jax.device_put(
+                    pidx,
+                    NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
+            common = tuple(batch[k] for k in _BATCH_KEYS) + (pidx,)
+            if n_passes == 2:
+                out, _ = solve_em_fleet(
+                    *common, window_rows, window_valid, *_tables_of(params),
+                    n_sweeps=n_sweeps, **hypers,
+                )
+            else:
+                out, _ = solve_windows_fleet(
+                    *common, *_tables_of(params), n_sweeps=n_sweeps,
+                    **hypers,
+                )
+    dispatch_s = time.perf_counter() - t0 - flow_wait[0]
+    st.add("dispatch_s", dispatch_s)
+    _OBS_DISPATCH_S.observe(dispatch_s)
+    _trace_stage(trace_keys, "dispatch", w0)
     _copy_async(out)
     return pg["per_item_pack"], out
 
@@ -1163,7 +1263,7 @@ def _tables_of(params: Dict) -> Tuple:
 
 def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
                     mesh=None, flow_wait=None, tenant_col=None,
-                    tenant_table=None):
+                    tenant_table=None, trace_keys=()):
     """One solve pass as warm dispatch + compacted full redispatch.
 
     Returns the packed [B, E, W, 3+topk] output as a host array,
@@ -1211,12 +1311,17 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
         tables_dev = tuple(jax.device_put(np.asarray(t), rep)
                            for t in tables)
 
-    out_warm, flags = solve_windows_fleet(
-        *place(batch, pidx), *tables_dev, n_sweeps=warm, **hypers)
+    with _profile.annotate("tw:fleet:warm-dispatch"):
+        out_warm, flags = solve_windows_fleet(
+            *place(batch, pidx), *tables_dev, n_sweeps=warm, **hypers)
     # the big warm block starts its D2H NOW — it overlaps the flag fetch,
     # the host gather, and the redispatch's device execution below
     _copy_async(out_warm)
-    converged = _fetch(flags, st, flow_wait, flag_fetch=True).astype(bool)
+    w0 = _selftrace.now_us()
+    with _profile.annotate("tw:fleet:flag-fetch"):
+        converged = _fetch(flags, st, flow_wait,
+                           flag_fetch=True).astype(bool)
+    _trace_stage(trace_keys, "compact-fetch", w0)
     active = np.flatnonzero(~converged)
     st.add("compact_windows_total", float(converged.shape[0]))
     st.add("compact_windows_redispatched", float(active.size))
@@ -1251,9 +1356,12 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
     if pad:
         pidx_active = np.concatenate(
             [pidx_active, np.zeros(pad, dtype=pidx_active.dtype)])
-    out_full, _ = solve_windows_fleet(
-        *place(gathered, pidx_active), *tables_dev,
-        n_sweeps=n_sweeps, **hypers)
+    w0 = _selftrace.now_us()
+    with _profile.annotate("tw:fleet:redispatch"):
+        out_full, _ = solve_windows_fleet(
+            *place(gathered, pidx_active), *tables_dev,
+            n_sweeps=n_sweeps, **hypers)
+    _trace_stage(trace_keys, "redispatch", w0)
     _copy_async(out_full)
     out = _fetch(out_warm, st, flow_wait).copy()
     out[active] = _fetch(out_full, st, flow_wait)[:active.size]
@@ -1263,7 +1371,8 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
 def _solve_group_compacted(batch, pidx, params, tables, window_rows,
                            window_valid, n_passes, n_sweeps, warm, hypers,
                            stats, mesh=None, flow_wait=None,
-                           tenant_col=None, tenant_table=None):
+                           tenant_col=None, tenant_table=None,
+                           trace_keys=()):
     """Compacted replacement for one fused group dispatch: per-pass
     warm/redispatch compaction, with the two-pass EM's on-device refit as
     its own dispatch between the passes (same refit program
@@ -1274,7 +1383,8 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
     st = _as_stats(stats)
     out0 = _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, st,
                            mesh=mesh, flow_wait=flow_wait,
-                           tenant_col=tenant_col, tenant_table=tenant_table)
+                           tenant_col=tenant_col, tenant_table=tenant_table,
+                           trace_keys=trace_keys)
     if n_passes == 1:
         return out0
     new_tables = refit_fleet_params(
@@ -1295,7 +1405,8 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
     return _compacted_pass(batch, pidx, tables[:3] + tuple(new_tables),
                            n_sweeps, warm, hypers, st, mesh=mesh,
                            flow_wait=flow_wait,
-                           tenant_col=tenant_col, tenant_table=tenant_table)
+                           tenant_col=tenant_col, tenant_table=tenant_table,
+                           trace_keys=trace_keys)
 
 
 def _decode_group(solver, pend, results, stats):
@@ -1311,6 +1422,7 @@ def _decode_group(solver, pend, results, stats):
     o = out if isinstance(out, np.ndarray) else _fetch(out, st)
 
     t0 = time.perf_counter()
+    w0 = _selftrace.now_us()
     row = 0
     for i, item, prep, packed, n_w in per_item_pack:
         rows = o[row:row + n_w]
@@ -1347,4 +1459,6 @@ def _decode_group(solver, pend, results, stats):
             {in_ids[j]: int(span_cands[j]) for j in range(n_in)},
             cnt_unassigned,
         )
+    _trace_stage(sorted({item.trace_key for _, item, *_ in per_item_pack
+                         if item.trace_key is not None}), "decode", w0)
     st.add("decode_s", time.perf_counter() - t0)

@@ -59,6 +59,8 @@ from traceweaver_tpu.algorithms import timing
 from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
 from traceweaver_tpu.algorithms.timing import MAX_COMPONENTS, EdgeDist
 from traceweaver_tpu.metrics.accuracy import get_out_eps_in_order
+from traceweaver_tpu.obs import profile as _obs_profile
+from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.ops.pallas_sinkhorn import assign_topk
 from traceweaver_tpu.ops.precision import (
     precision_from_env,
@@ -90,6 +92,21 @@ CHUNK_ELEMS = 1 << 26
 # padded area (elements) stays under this budget (~a round trip's worth of
 # VPU work for this pipeline).
 MERGE_ELEMS = 1 << 24
+
+# obs mirror of the per-service solver ledger (docs/OBSERVABILITY.md):
+# WeaverTPU.stats keeps its field names (executor merges, bench reads);
+# every accumulating update below ALSO lands here so the scrape surface
+# covers the per-service fallback/baseline path, not just the fleet.
+_OBS_SOLVER = _get_registry().counter(
+    "tw_solver_ledger_total",
+    "per-service WeaverTPU solve ledger mirror (stage seconds, "
+    "analytic op/byte estimates)",
+    labels=("key",))
+
+
+def _stat_add(stats: Dict[str, float], key: str, val: float) -> None:
+    _OBS_SOLVER.inc(val, key=key)
+    stats[key] = stats.get(key, 0.0) + val
 
 
 # ---------------------------------------------------------------------------
@@ -1575,8 +1592,7 @@ class WeaverTPU:
                 skip_caps=skip_caps_all[[row_of[w] for w in chunk]],
                 in_cols=in_cols, out_cols=out_cols,
             )
-            stats["pack_s"] = stats.get("pack_s", 0.0) + (
-                _time.perf_counter() - t0)
+            _stat_add(stats, "pack_s", _time.perf_counter() - t0)
             a = packed.arrays
             if mesh is not None:
                 from traceweaver_tpu.parallel.mesh import put_sharded
@@ -1603,36 +1619,36 @@ class WeaverTPU:
             # figures are therefore upper bounds too
             n_passes = 2 if use_fused else 1
             cells = B_c * E * W_c * M_c * n_sweeps * n_passes
-            stats["flops_est"] = stats.get("flops_est", 0.0) + cells * (
+            _stat_add(stats, "flops_est", cells * (
                 8.0 * K_c * (n_pred + n_succ + 2)
                 + 6.0 * 2 * self.n_sinkhorn
                 + 8.0 * max(1, W_c.bit_length())
-            )
+            ))
             # XLA-path HBM traffic bound: the [W, M] score block streams
             # twice per Sinkhorn iteration (row+col LSE) at the SCORE
             # itemsize (bf16 halves this — the whole point of
             # TW_PRECISION); the Pallas kernel keeps it VMEM-resident and
             # only pays one score read plus the f32 plan/result write
-            stats["bytes_est_xla"] = stats.get("bytes_est_xla", 0.0) + (
-                cells * float(itemsize) * 2 * self.n_sinkhorn)
-            stats["bytes_est_pallas"] = stats.get(
-                "bytes_est_pallas", 0.0) + cells * (float(itemsize) + 2 * 4.0)
+            _stat_add(stats, "bytes_est_xla",
+                      cells * float(itemsize) * 2 * self.n_sinkhorn)
+            _stat_add(stats, "bytes_est_pallas",
+                      cells * (float(itemsize) + 2 * 4.0))
             t0 = _time.perf_counter()
             solve_fn = solve_em_packed if use_fused else solve_windows_packed
-            out = solve_fn(
-                a["in_start"], a["in_end"], a["in_valid"],
-                a["out_start"], a["out_end"], a["out_valid"],
-                a["skip_cap"], a["force_skip"],
-                a["pred_mask"], a["root_mask"], a["is_last"],
-                a["edge_wt"], a["edge_mu"], a["edge_sd"],
-                a["in_wt"], a["in_mu"], a["in_sd"],
-                a["ret_wt"], a["ret_mu"], a["ret_sd"],
-                epsilon=self.epsilon, n_sinkhorn=self.n_sinkhorn,
-                n_sweeps=n_sweeps, sinkhorn_tol=self.sinkhorn_tol,
-                max_preds=mp, max_succs=ms, precision=self.precision,
-            )
-            stats["dispatch_s"] = stats.get("dispatch_s", 0.0) + (
-                _time.perf_counter() - t0)
+            with _obs_profile.annotate("tw:solve:dispatch"):
+                out = solve_fn(
+                    a["in_start"], a["in_end"], a["in_valid"],
+                    a["out_start"], a["out_end"], a["out_valid"],
+                    a["skip_cap"], a["force_skip"],
+                    a["pred_mask"], a["root_mask"], a["is_last"],
+                    a["edge_wt"], a["edge_mu"], a["edge_sd"],
+                    a["in_wt"], a["in_mu"], a["in_sd"],
+                    a["ret_wt"], a["ret_mu"], a["ret_sd"],
+                    epsilon=self.epsilon, n_sinkhorn=self.n_sinkhorn,
+                    n_sweeps=n_sweeps, sinkhorn_tol=self.sinkhorn_tol,
+                    max_preds=mp, max_succs=ms, precision=self.precision,
+                )
+            _stat_add(stats, "dispatch_s", _time.perf_counter() - t0)
             pending.append((packed, out))
 
         for _, out in pending:
@@ -1654,8 +1670,7 @@ class WeaverTPU:
             feas = o[..., 2]
             topk_cols = o[..., 3:]
             results.append((packed, (assign, topk_cols, not_best, feas)))
-        stats["wait_s"] = stats.get("wait_s", 0.0) + (
-            _time.perf_counter() - t0)
+        _stat_add(stats, "wait_s", _time.perf_counter() - t0)
         return results
 
     @staticmethod
@@ -1826,8 +1841,7 @@ class WeaverTPU:
             }
             self._resolve_cross_window_duplicates(
                 all_assignments, all_topk, in_ids, skip_budget)
-            self.stats["decode_s"] = self.stats.get("decode_s", 0.0) + (
-                _time.perf_counter() - t0)
+            _stat_add(self.stats, "decode_s", _time.perf_counter() - t0)
             if it + 1 < iterations:
                 t0 = _time.perf_counter()
                 dists = timing.refit_from_assignments(
@@ -1835,8 +1849,8 @@ class WeaverTPU:
                     invocation_graph, all_assignments, self.all_spans,
                     score_mode=self.score_mode,
                 )
-                self.stats["refit_s"] = self.stats.get("refit_s", 0.0) + (
-                    _time.perf_counter() - t0)
+                _stat_add(self.stats, "refit_s",
+                          _time.perf_counter() - t0)
             it += 1
 
         cnt_unassigned = sum(

@@ -21,6 +21,8 @@ Rule ids are stable (baseline entries and suppressions reference them):
   guarded everywhere
 - TW006 precision discipline — no accumulation over bf16 storage blocks
   without an explicit f32 accumulator
+- TW007 metric discipline    — counters in fleet/stream/serve grow only
+  through the obs-mirrored accumulators
 """
 
 from __future__ import annotations
@@ -776,6 +778,125 @@ class PrecisionDiscipline:
         return findings
 
 
+# ---------------------------------------------------------------------------
+# TW007 — metric discipline
+# ---------------------------------------------------------------------------
+
+class MetricDiscipline:
+    """Counters in the telemetry-bearing modules grow only through the
+    obs-mirrored accumulators.
+
+    The obs registry (``traceweaver_tpu/obs``, PR 9) exists because the
+    ledgers lived in ad-hoc dicts nothing could scrape; every sanctioned
+    accumulator (``fleet._Stats.add/merge/note/bucket/record_max``, the
+    stream/serve ``_bump`` helpers) now mirrors into the registry, so a
+    NEW bare ``stats[k] += 1`` or ``d[k] = d.get(k, 0) + v`` in
+    ``algorithms/fleet.py`` / ``stream/`` / ``serve/`` is a counter the
+    scrape surface silently never sees — exactly the blind spot this PR
+    closed. Module-level counter-table dicts (``_COUNTERS = {"x": 0}``)
+    in those modules are the same hazard at module scope.
+
+    Narrow by design: attribute counters (``self.shed_spilled += 1``)
+    are typed object state with explicit mirror sites and are not
+    flagged; dict read-modify-writes outside a sanctioned accumulator
+    method are.
+    """
+
+    id = "TW007"
+    title = "ad-hoc counter growth outside the obs-mirrored accumulators"
+
+    #: telemetry-bearing modules the registry must see completely
+    WATCH_FILES = ("algorithms/fleet.py",)
+    WATCH_DIRS = ("traceweaver_tpu/stream/", "traceweaver_tpu/serve/")
+    #: accumulator methods whose body IS the sanctioned write path
+    SANCTIONED = {"add", "merge", "note", "bucket", "record_max",
+                  "_bump", "bump", "inc", "observe", "set", "set_max"}
+
+    def _watched(self, mod: Module) -> bool:
+        return (_path_in(mod, self.WATCH_FILES)
+                or any(d in mod.path for d in self.WATCH_DIRS))
+
+    @staticmethod
+    def _numeric_const(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Constant)
+                and isinstance(node.value, (int, float))
+                and not isinstance(node.value, bool))
+
+    def _is_counter_table(self, node: ast.AST) -> bool:
+        """``{"x": 0, "y": 0}`` — a dict literal whose values are all
+        numeric constants (at least one entry)."""
+        return (isinstance(node, ast.Dict) and node.values
+                and all(self._numeric_const(v) for v in node.values))
+
+    @staticmethod
+    def _get_rmw(node: ast.Assign) -> bool:
+        """``d[k] = d.get(k, 0) + v`` (either operand order): the target
+        is a subscript and the value contains a ``.get`` call on the
+        same receiver expression."""
+        if len(node.targets) != 1 or not isinstance(
+                node.targets[0], ast.Subscript):
+            return False
+        base_dump = ast.dump(node.targets[0].value)
+        if not isinstance(node.value, ast.BinOp) or not isinstance(
+                node.value.op, ast.Add):
+            return False
+        for side in (node.value.left, node.value.right):
+            if (isinstance(side, ast.Call)
+                    and isinstance(side.func, ast.Attribute)
+                    and side.func.attr == "get"
+                    and ast.dump(side.func.value) == base_dump):
+                return True
+        return False
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not self._watched(mod):
+            return []
+        findings: List[Finding] = []
+
+        # (a) module-scope counter tables
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and self._is_counter_table(
+                    stmt.value):
+                findings.append(mod.finding(
+                    self.id, stmt,
+                    "module-level counter dict — a private ledger the "
+                    "metrics registry never sees; declare a counter on "
+                    "traceweaver_tpu.obs (or mirror through the "
+                    "sanctioned accumulators) instead"))
+
+        # (b)/(c) counter read-modify-writes outside sanctioned methods
+        def visit(node: ast.AST, sanctioned: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_ok = sanctioned
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_ok = child.name in self.SANCTIONED
+                if (not sanctioned
+                        and isinstance(child, ast.AugAssign)
+                        and isinstance(child.op, ast.Add)
+                        and isinstance(child.target, ast.Subscript)):
+                    findings.append(mod.finding(
+                        self.id, child,
+                        "`...[key] += n` outside the obs-mirrored "
+                        "accumulators — this count never reaches the "
+                        "metrics registry (/metrics blind spot); route "
+                        "it through _Stats/_bump or an obs counter"))
+                elif (not sanctioned and isinstance(child, ast.Assign)
+                        and self._get_rmw(child)):
+                    findings.append(mod.finding(
+                        self.id, child,
+                        "`d[k] = d.get(k, ...) + v` outside the "
+                        "obs-mirrored accumulators — this count never "
+                        "reaches the metrics registry (/metrics blind "
+                        "spot); route it through _Stats/_bump or an obs "
+                        "counter"))
+                visit(child, child_ok)
+
+        visit(mod.tree, False)
+        return findings
+
+
 #: registration order == reporting order for same-line findings
 RULE_CLASSES = [KnobDiscipline, ImportTimeFreeze, HostSyncHazard,
-                RecompileDiscipline, LockDiscipline, PrecisionDiscipline]
+                RecompileDiscipline, LockDiscipline, PrecisionDiscipline,
+                MetricDiscipline]

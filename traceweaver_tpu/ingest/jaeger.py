@@ -37,7 +37,12 @@ from traceweaver_tpu.ingest import repair
 from traceweaver_tpu import native as native_mod
 
 # FIX mode -> required root-span operation name. ``None`` (Alibaba) means
-# "ingest every trace" (reference executor.py:756-762).
+# "ingest every trace" (reference executor.py:756-762). Mode 6 is the
+# pipeline's OWN telemetry (traceweaver_tpu/obs/selftrace.py): each
+# window's journey emitted as a one-level fan-out trace rooted at a
+# ``tw:window`` span — no repair shims, no Alibaba remapping, so
+# ``serve --fix 6`` ingests the reconstructor's self-trace payloads and
+# the solver reconstructs its own pipeline (docs/OBSERVABILITY.md).
 FIX_ROOT_OPS: Dict[int, Optional[str]] = {
     0: "init-span",
     1: "ComposeReview",
@@ -45,6 +50,7 @@ FIX_ROOT_OPS: Dict[int, Optional[str]] = {
     3: "HTTP GET /recommendations",
     4: "[Todo] CompleteTodoCommandHandler",
     5: None,
+    6: "tw:window",
 }
 
 

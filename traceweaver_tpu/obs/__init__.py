@@ -1,0 +1,24 @@
+"""Self-tracing telemetry subsystem (ISSUE 9, docs/OBSERVABILITY.md).
+
+- :mod:`traceweaver_tpu.obs.registry` — typed, thread-safe metrics
+  registry (counters/gauges/histograms with label sets) every legacy
+  ledger mirrors into;
+- :mod:`traceweaver_tpu.obs.exposition` — Prometheus text rendering,
+  the serve server's ``GET /metrics``, and the CLI sidecar exporter;
+- :mod:`traceweaver_tpu.obs.selftrace` — the pipeline's own journey as
+  Jaeger-JSON spans the solver can reconstruct;
+- :mod:`traceweaver_tpu.obs.events` — structured JSONL event sink
+  (fault-ladder rungs, injections) + the ``cli events`` tail;
+- :mod:`traceweaver_tpu.obs.profile` — ``TW_PROFILE`` jax.profiler
+  annotations, device-memory gauges, and the ProfileData feature check.
+
+The package is import-light: nothing here imports jax or numpy at
+module scope, so hot modules (``algorithms/fleet.py``) can mirror into
+the registry for free.
+"""
+
+from traceweaver_tpu.obs.registry import (  # noqa: F401
+    MetricError,
+    MetricsRegistry,
+    get_registry,
+)

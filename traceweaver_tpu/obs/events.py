@@ -1,0 +1,176 @@
+"""Structured JSONL event sink + the ``cli events`` tail subcommand.
+
+Replaces the fault ladder's dict-internal ordered event list as the
+OPERATOR surface: every ladder rung (retry/bisect/xla/host/quarantine),
+every injected fault, and any other subsystem event lands as one
+structured JSON record per line in an append-only sink — the SAME
+record-per-line format the quarantine dead-letter sidecar already uses
+(``stream/service.py _deadletter``), so one tail tool reads both. The
+in-dict ``fault_ladder`` list the bench/tests consume is unchanged
+(``fleet._Stats.note`` still appends); the sink is the durable,
+tail-able copy with timestamps and context the list never had.
+
+Record shape (sorted keys, one JSON object per line)::
+
+    {"event": "retry", "kind": "fault_ladder", "ts": 1754300000.123, ...}
+
+Offset/truncate semantics mirror the stream's ``TraceSink`` so a
+checkpoint/resume splice can rewind an event log the same way it
+rewinds the emission sink — no double-recorded, no lost events.
+
+Install one process-wide via :func:`install` (the CLIs wire
+``TW_EVENTS=<path>``); :func:`emit` is a no-op returning immediately
+when none is installed, so the production no-events path costs one
+global read per call.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class EventLog:
+    """Append-only JSONL event sink with a recorded byte offset."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "a+b")
+        self._f.seek(0, os.SEEK_END)
+        self.offset = self._f.tell()
+        self.records = 0
+
+    def emit(self, kind: str, event: str, **fields) -> None:
+        rec = dict(fields)
+        rec["kind"] = kind
+        rec["event"] = event
+        rec.setdefault("ts", round(time.time(), 6))
+        data = (json.dumps(rec, sort_keys=True, default=str) + "\n") \
+            .encode("utf-8")
+        with self._lock:
+            self._f.write(data)
+            self._f.flush()
+            self.offset += len(data)
+            self.records += 1
+
+    def truncate(self, offset: int) -> None:
+        with self._lock:
+            self._f.truncate(offset)
+            self._f.seek(offset)
+            self.offset = offset
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+_ACTIVE: Optional[EventLog] = None
+
+
+def install(log: Optional[EventLog]) -> Optional[EventLog]:
+    """Install (or clear, with None) the process-wide event sink.
+    Returns the previous one so scopes can restore it."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = log
+    return prev
+
+
+def active() -> Optional[EventLog]:
+    return _ACTIVE
+
+
+def emit(kind: str, event: str, **fields) -> None:
+    """Emit to the installed sink, if any (one global read when not)."""
+    log = _ACTIVE
+    if log is not None:
+        log.emit(kind, event, **fields)
+
+
+# ---------------------------------------------------------------------------
+# `python -m traceweaver_tpu.runtime.cli events` — tail the sink
+# ---------------------------------------------------------------------------
+
+def _fmt_record(rec: Dict) -> str:
+    """One human line per record: timestamp, kind/event head, then the
+    remaining fields as k=v. Dead-letter records (no kind/event) print
+    their fields generically — same tool, both formats."""
+    ts = rec.pop("ts", None)
+    head = []
+    if ts is not None:
+        try:
+            head.append(time.strftime("%H:%M:%S", time.localtime(float(ts)))
+                        + ("%.3f" % (float(ts) % 1))[1:])
+        except (TypeError, ValueError):
+            head.append(str(ts))
+    kind = rec.pop("kind", None)
+    event = rec.pop("event", None)
+    if kind is not None or event is not None:
+        head.append("%s/%s" % (kind or "-", event or "-"))
+    elif "reason" in rec:
+        head.append("deadletter")
+    tail = " ".join("%s=%s" % (k, rec[k]) for k in sorted(rec))
+    return " ".join(head + ([tail] if tail else []))
+
+
+def tail_main(argv: List[str]) -> int:
+    """``cli events <path> [-n N] [--follow] [--kind K]``: pretty-tail a
+    JSONL event (or dead-letter) sink."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="python -m traceweaver_tpu.runtime.cli events",
+        description="Tail a structured JSONL event sink (fault-ladder "
+                    "events, quarantine dead-letters — one record per "
+                    "line, docs/OBSERVABILITY.md).")
+    p.add_argument("path", help="event/dead-letter JSONL file")
+    p.add_argument("-n", type=int, default=20,
+                   help="show the last N records (default 20; 0 = all)")
+    p.add_argument("--follow", action="store_true",
+                   help="keep the file open and print records as they "
+                        "arrive (Ctrl-C to stop)")
+    p.add_argument("--kind", default=None,
+                   help="only records whose 'kind' field matches")
+    args = p.parse_args(argv)
+    if not os.path.exists(args.path):
+        print(f"events: no such file: {args.path}", file=sys.stderr)
+        return 2
+
+    def emit_line(raw: str) -> None:
+        raw = raw.strip()
+        if not raw:
+            return
+        try:
+            rec = json.loads(raw)
+        except json.JSONDecodeError:
+            print("? " + raw)
+            return
+        if not isinstance(rec, dict):
+            print("? " + raw)
+            return
+        if args.kind is not None and rec.get("kind") != args.kind:
+            return
+        print(_fmt_record(dict(rec)))
+
+    with open(args.path, "r", encoding="utf-8") as f:
+        lines = f.readlines()
+        for raw in (lines[-args.n:] if args.n else lines):
+            emit_line(raw)
+        if not args.follow:
+            return 0
+        try:
+            while True:
+                raw = f.readline()
+                if raw:
+                    emit_line(raw)
+                else:
+                    time.sleep(0.2)
+        except KeyboardInterrupt:
+            return 0

@@ -1,0 +1,284 @@
+"""Typed, thread-safe metrics registry — the telemetry spine (ISSUE 9).
+
+Before this module, every subsystem kept its own ad-hoc ledger: the
+fleet's ``_Stats`` dict, the stream service's ``stats``/``_bump``, the
+serve layer's per-tenant ``counters``, ``runtime/jax_cache``'s module
+``_COUNTERS``. Those ledgers stay (their field names are load-bearing —
+bench schemas, executor prints, a dozen tests) but every update now
+ALSO mirrors into this registry, so one scrape surface
+(:mod:`traceweaver_tpu.obs.exposition`, ``GET /metrics``) sees the
+whole pipeline with labels instead of N private dicts.
+
+Design constraints, in order:
+
+- **import-light**: stdlib only (no jax, no numpy) — the registry is
+  imported by ``algorithms/fleet.py`` and the analysis CLI alike, and
+  must cost nothing before the first metric moves;
+- **typed**: three metric kinds only — :class:`Counter` (monotonic,
+  negative increments raise), :class:`Gauge` (set / set-if-greater),
+  :class:`Histogram` (fixed buckets, cumulative) — and a declared label
+  schema per family: declaring the same name twice with a different
+  kind or label set raises :class:`MetricError` instead of silently
+  forking the series (the ``ops/precision.py`` raise-on-typo rule
+  applied to telemetry);
+- **thread-safe**: the fleet's pack thread, decode workers, and the
+  serve pump all mirror concurrently; every mutation runs under the
+  owning registry's lock (the ``fleet._Stats`` discipline, twlint
+  TW005);
+- **scrape-time collectors**: state that already lives elsewhere
+  (``jax_cache._COUNTERS``, the serve layer's per-tenant stats) is
+  exposed via registered collector callbacks evaluated at scrape time,
+  so the exposition can never drift from the source ledger — exact
+  match is by construction, not by double bookkeeping.
+
+See docs/OBSERVABILITY.md for the metric catalog and label schema.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: default histogram buckets (seconds-flavored: 1 ms .. 60 s, then +Inf)
+DEFAULT_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0)
+
+
+class MetricError(ValueError):
+    """A metric misuse (name/label schema conflict, negative counter
+    increment, bad label set) — raised loudly instead of silently
+    forking or corrupting a series."""
+
+
+class _Family:
+    """One metric family: a name, a kind, a label schema, and children
+    keyed by label-value tuples. All mutation happens under the owning
+    registry's lock (passed in — one lock per registry, so cross-family
+    snapshots are consistent)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labels: Sequence[str],
+                 lock: threading.RLock) -> None:
+        if not _NAME_RE.match(name):
+            raise MetricError(f"invalid metric name {name!r}")
+        for lab in labels:
+            if not _LABEL_RE.match(lab):
+                raise MetricError(
+                    f"invalid label name {lab!r} on metric {name!r}")
+        self.name = name
+        self.help = help
+        self.labels = tuple(labels)
+        self._lock = lock
+        self._children: Dict[Tuple[str, ...], float] = {}
+
+    def _key(self, labelkw: Dict[str, object]) -> Tuple[str, ...]:
+        if set(labelkw) != set(self.labels):
+            raise MetricError(
+                f"metric {self.name!r} declared labels {self.labels}, "
+                f"got {tuple(sorted(labelkw))}")
+        return tuple(str(labelkw[lab]) for lab in self.labels)
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        """``[(labels_dict, value)]`` snapshot, label-sorted (stable
+        exposition order)."""
+        with self._lock:
+            items = sorted(self._children.items())
+        return [(dict(zip(self.labels, key)), val) for key, val in items]
+
+
+class Counter(_Family):
+    """Monotonic counter. ``inc`` with a negative value raises — a
+    decreasing 'counter' is a gauge wearing the wrong type."""
+
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if value < 0:
+            raise MetricError(
+                f"counter {self.name!r}: negative increment {value}")
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + value
+
+
+class Gauge(_Family):
+    """Point-in-time value; ``set_max`` is the ``_Stats.record_max``
+    mirror (set-if-greater)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = float(value)
+
+    def set_max(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = max(self._children.get(key, float(value)),
+                                      float(value))
+
+
+class Histogram(_Family):
+    """Fixed-bucket cumulative histogram (Prometheus semantics: each
+    bucket counts observations ≤ its bound, ``+Inf`` counts all)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, labels: Sequence[str],
+                 lock: threading.RLock,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help, labels, lock)
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds or any(math.isnan(b) for b in bounds):
+            raise MetricError(
+                f"histogram {name!r}: need at least one finite bucket")
+        self.buckets = bounds
+        # child value: [count_per_bucket..., +Inf count, sum]
+        self._hchildren: Dict[Tuple[str, ...], List[float]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            child = self._hchildren.get(key)
+            if child is None:
+                child = [0.0] * (len(self.buckets) + 2)
+                self._hchildren[key] = child
+            for i, bound in enumerate(self.buckets):
+                if v <= bound:
+                    child[i] += 1.0
+            child[-2] += 1.0          # +Inf
+            child[-1] += v            # sum
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        """Flattened exposition samples: ``_bucket{le=...}``, ``_sum``,
+        ``_count`` per child (the exposition layer keys on the sample
+        name suffixes)."""
+        out: List[Tuple[Dict[str, str], float]] = []
+        with self._lock:
+            items = sorted(self._hchildren.items())
+        for key, child in items:
+            base = dict(zip(self.labels, key))
+            for i, bound in enumerate(self.buckets):
+                out.append(({**base, "le": _fmt_bound(bound),
+                             "__name__": self.name + "_bucket"}, child[i]))
+            out.append(({**base, "le": "+Inf",
+                         "__name__": self.name + "_bucket"}, child[-2]))
+            out.append(({**base, "__name__": self.name + "_sum"}, child[-1]))
+            out.append(({**base, "__name__": self.name + "_count"},
+                        child[-2]))
+        return out
+
+
+def _fmt_bound(b: float) -> str:
+    return repr(b) if b != int(b) else str(int(b))
+
+
+#: a collector returns families as plain tuples so sources need no
+#: registry objects: ``(name, kind, help, [(labels_dict, value), ...])``
+CollectorFn = Callable[[], Iterable[Tuple[str, str, str,
+                                          List[Tuple[Dict[str, str],
+                                                     float]]]]]
+
+
+class MetricsRegistry:
+    """Family store + scrape-time collectors. One instance per process
+    in practice (:func:`get_registry`); tests may build private ones."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Family] = {}
+        self._collectors: Dict[str, CollectorFn] = {}
+
+    # -- declaration (idempotent; schema conflicts raise) -----------------
+    def _declare(self, cls, name: str, help: str, labels: Sequence[str],
+                 **kw) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if type(fam) is not cls or fam.labels != tuple(labels):
+                    raise MetricError(
+                        f"metric {name!r} already declared as "
+                        f"{fam.kind} with labels {fam.labels}; "
+                        f"redeclaration as {cls.kind} with "
+                        f"{tuple(labels)} would fork the series")
+                return fam
+            fam = cls(name, help, labels, self._lock, **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._declare(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._declare(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._declare(Histogram, name, help, labels, buckets=buckets)
+
+    def register_collector(self, key: str, fn: CollectorFn) -> None:
+        """Register (or replace — idempotence under re-install) a
+        scrape-time collector. Collectors are evaluated on every
+        :meth:`collect`, so the exposed values ARE the source ledger's
+        current values, never a mirrored copy that could drift."""
+        with self._lock:
+            self._collectors[key] = fn
+
+    # -- read side ---------------------------------------------------------
+    def collect(self, include_collectors: bool = True):
+        """Yield ``(name, kind, help, samples)`` for every family (owned
+        first, then collectors). Collector callbacks run OUTSIDE the
+        registry lock — they read other subsystems' locked state and
+        must not nest under ours."""
+        with self._lock:
+            owned = sorted(self._families.items())
+            collectors = list(self._collectors.items())
+        for name, fam in owned:
+            yield (name, fam.kind, fam.help, fam.samples())
+        for _, fn in sorted(collectors):
+            for entry in fn():
+                yield entry
+
+    def snapshot(self, include_collectors: bool = False) -> Dict[str, float]:
+        """Flat ``{'name{label="v",...}': value}`` view — the bench
+        ``telemetry_snapshot`` delta input (histograms contribute their
+        ``_sum``/``_count``/``_bucket`` samples)."""
+        out: Dict[str, float] = {}
+        for name, _kind, _help, samples in self.collect(include_collectors):
+            for labels, value in samples:
+                labels = dict(labels)
+                sample_name = labels.pop("__name__", name)
+                body = ",".join('%s="%s"' % (k, v)
+                                for k, v in sorted(labels.items()))
+                out[sample_name + ("{%s}" % body if body else "")] = value
+        return out
+
+    def reset(self) -> None:
+        """Drop every family and collector (test isolation only)."""
+        with self._lock:
+            self._families.clear()
+            self._collectors.clear()
+
+
+_DEFAULT: Optional[MetricsRegistry] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem mirrors into."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = MetricsRegistry()
+    return _DEFAULT

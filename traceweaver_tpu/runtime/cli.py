@@ -85,6 +85,53 @@ def _mesh_devices_from_env() -> int:
     return n
 
 
+def _obs_setup(metrics_port=None):
+    """Wire the run-scoped observability surfaces (docs/OBSERVABILITY.md):
+
+    - sidecar ``/metrics`` exporter (``--metrics-port`` where a flag
+      exists, else ``TW_METRICS_PORT`` — the batch CLI stays flag-for-
+      flag byte-compatible with the reference, same rule as
+      ``TW_MESH_DEVICES``);
+    - structured JSONL event sink (``TW_EVENTS``);
+    - pipeline self-tracer (``TW_SELFTRACE=<path>`` — the collected
+      Jaeger-JSON journeys are written there at end of run).
+
+    Returns ``(exporter, tracer, selftrace_path)``; pass the latter two
+    to :func:`_obs_finish` when the run drains."""
+    from traceweaver_tpu.obs import events as obs_events
+    from traceweaver_tpu.obs import selftrace as obs_selftrace
+    from traceweaver_tpu.runtime import knobs
+
+    port = (metrics_port if metrics_port is not None
+            else knobs.get_int("TW_METRICS_PORT"))
+    exporter = None
+    if port:
+        from traceweaver_tpu.obs.exposition import start_metrics_server
+
+        exporter = start_metrics_server(port)
+        print(f"[obs] /metrics on http://127.0.0.1:{exporter.port}",
+              file=sys.stderr)
+    events_path = knobs.get("TW_EVENTS")
+    if events_path:
+        obs_events.install(obs_events.EventLog(events_path))
+    selftrace_path = knobs.get("TW_SELFTRACE")
+    tracer = None
+    if selftrace_path:
+        tracer = obs_selftrace.PipelineTracer()
+        obs_selftrace.install(tracer)
+    return exporter, tracer, selftrace_path
+
+
+def _obs_finish(tracer, selftrace_path) -> None:
+    """End-of-run half of :func:`_obs_setup`: persist the self-trace
+    payload (ingestable back through fix mode 6)."""
+    if tracer is not None and selftrace_path:
+        n = tracer.write(selftrace_path)
+        print(f"[obs] self-trace: {n} window journey(s) -> "
+              f"{selftrace_path} (re-ingest with --fix 6)",
+              file=sys.stderr)
+
+
 def build_stream_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m traceweaver_tpu.runtime.cli stream",
@@ -143,6 +190,9 @@ def build_stream_parser() -> argparse.ArgumentParser:
     p.add_argument("--compare_batch", action="store_true",
                    help="after the stream drains, run the batch executor "
                         "on the same corpus and print the accuracy delta")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="sidecar Prometheus /metrics exporter port "
+                        "(default TW_METRICS_PORT; 0 = off)")
     return p
 
 
@@ -184,7 +234,9 @@ def stream_main(argv) -> int:
                                                 sink=sink)
     else:
         service = StreamingReconstructor(source, cfg, sink=sink)
+    _, tracer, selftrace_path = _obs_setup(args.metrics_port)
     summary = service.run()
+    _obs_finish(tracer, selftrace_path)
 
     print("[stream] done [%s]: %d events -> %d windows, %d spans emitted, "
           "late %d rerouted / %d dropped, shed %d spilled / %d dropped"
@@ -301,7 +353,11 @@ def serve_main(argv) -> int:
                      ", ".join(sorted(service.tenants))))
     else:
         service = TenantService(cfg)
+    # serve mounts /metrics natively, so no sidecar port here; the event
+    # sink and self-tracer ride the same TW_* knobs as the stream CLI
+    _, tracer, selftrace_path = _obs_setup(metrics_port=0)
     run_server(service, args.host, args.port, verbose=not args.quiet)
+    _obs_finish(tracer, selftrace_path)
     return 0
 
 
@@ -319,6 +375,13 @@ def main(argv=None) -> int:
         from traceweaver_tpu.analysis.__main__ import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "events":
+        # tail a structured JSONL event sink (fault-ladder rungs,
+        # quarantine dead-letters — docs/OBSERVABILITY.md); pure stdlib,
+        # no JAX backend
+        from traceweaver_tpu.obs.events import tail_main
+
+        return tail_main(argv[1:])
     if argv and argv[0] == "query":
         # offline delay-culprit query (the paper's marquee use case,
         # docs/SERVING.md): no JAX backend needed — pure host analytics
@@ -373,6 +436,11 @@ def main(argv=None) -> int:
         load_replica_table,
         run_experiment,
     )
+
+    # batch-mode observability rides env knobs only (TW_METRICS_PORT /
+    # TW_EVENTS / TW_SELFTRACE): the flag surface below stays
+    # byte-compatible with the reference executor CLI
+    _, tracer, selftrace_path = _obs_setup()
 
     args = build_parser().parse_args(argv)
     if args.relative_path is None and args.absolute_path is None:
@@ -440,6 +508,7 @@ def main(argv=None) -> int:
         gt_free_dag=knobs.get_bool("TW_GT_FREE_DAG"),
     )
     run_experiment(cfg)  # prints per-method accuracy as it goes
+    _obs_finish(tracer, selftrace_path)
     return 0
 
 

@@ -251,7 +251,12 @@ def _solve_fleet_method(cfg: ExecutorConfig, store: TraceStore, method: str,
     items = [
         FleetItem(process, prep["prob"].in_span_partitions,
                   prep["prob"].out_span_partitions, prep["true"],
-                  prep["dag"], method=method, store=store)
+                  prep["dag"], method=method, store=store,
+                  # batch-mode self-trace context (obs/selftrace.py):
+                  # per-service journeys keyed "batch:<svc>" — no
+                  # ingest/seal/emit phases, so a batch journey is the
+                  # pack -> dispatch -> decode slice of the pipeline
+                  trace_key="batch:" + process)
         for process, prep in preps
     ]
     start = time.time()

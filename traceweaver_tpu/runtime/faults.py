@@ -223,9 +223,16 @@ def override(spec: str, seed: int = 0):
 def maybe_fail(site: str) -> None:
     """Raise :class:`FaultError` if the active plan draws a failure for
     ``site``. No-op (one dict lookup) when no plan is active — the
-    TW_FAULTS-unset production path stays bit-identical to HEAD."""
+    TW_FAULTS-unset production path stays bit-identical to HEAD. Every
+    injection also lands in the structured event sink when one is
+    installed (``TW_EVENTS``, obs/events.py) so a chaos run's stimulus
+    is tail-able next to the ladder rungs it provoked."""
     plan = active()
     if plan is not None and plan.should_fail(site):
+        from traceweaver_tpu.obs import events as _events
+
+        _events.emit("fault_injected", site, n=plan.injected[site],
+                     seed=plan.seed)
         raise FaultError(f"injected fault at site {site!r} "
                          f"(#{plan.injected[site]}, seed {plan.seed})")
 

@@ -44,6 +44,22 @@ def install_compile_counters() -> None:
     global _COUNTERS_INSTALLED
     if _COUNTERS_INSTALLED:
         return
+    # scrape surface (docs/OBSERVABILITY.md): a collector reads the live
+    # _COUNTERS at scrape time, so /metrics can never drift from the
+    # numbers the bench/stream ledgers diff. Registered before the jax
+    # listeners so even a failed listener install leaves the (zero)
+    # counters visible.
+    from traceweaver_tpu.obs.registry import get_registry
+
+    def _collect():
+        return [("tw_xla_compile_events_total", "counter",
+                 "XLA backend compiles + persistent-cache hits/misses "
+                 "(runtime/jax_cache.py counters)",
+                 [({"kind": k}, float(v))
+                  for k, v in sorted(_COUNTERS.items())])]
+
+    get_registry().register_collector("jax_cache", _collect)
+
     from jax._src import monitoring
 
     def _on_event(name, **kw):

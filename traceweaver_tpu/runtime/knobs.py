@@ -127,6 +127,19 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("TW_SERVE_PUMP_WINDOWS", "int", 8, lo=1,
        help="auto-pump threshold: solve once this many sealed windows "
             "are queued across tenants (flush forces it)"),
+    # --- observability (traceweaver_tpu/obs, docs/OBSERVABILITY.md) ------
+    _k("TW_PROFILE", "bool", False,
+       help="jax.profiler trace annotations around fleet stages + device "
+            "memory gauges on /metrics (obs/profile.py)"),
+    _k("TW_METRICS_PORT", "int", 0, lo=0, hi=65535,
+       help="sidecar /metrics exporter port for the batch/stream CLIs "
+            "(0 = off; the serve server mounts /metrics natively)"),
+    _k("TW_SELFTRACE", "str", None,
+       help="write the pipeline's own Jaeger-JSON journey spans here at "
+            "end of run (obs/selftrace.py; ingest them back with fix=6)"),
+    _k("TW_EVENTS", "str", None,
+       help="structured JSONL event sink path (fault-ladder rungs, "
+            "injections; tail with `cli events`)"),
     # --- bench orchestration ---------------------------------------------
     _k("TW_BENCH_SUBSET", "int", 25, lo=1, help="subset spans per service"),
     _k("TW_BENCH_EXACT_ALARM", "int", 95, lo=1,

@@ -16,6 +16,7 @@ Endpoint reference (full table + curl quickstart in docs/SERVING.md)::
     GET  /api/v1/tenants/<id>/query/delay_culprit  ?percentile=&after_us=
     GET  /api/v1/tenants/<id>/stats                per-tenant ledger
     GET  /api/v1/stats                             service-wide ledger
+    GET  /metrics                                  Prometheus exposition
     GET  /healthz                                  liveness
 
 Error mapping: bad JSON / malformed payloads (strict mode) -> 400,
@@ -65,6 +66,14 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _reply_text(self, code: int, body: str, content_type: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def _error(self, code: int, message: str) -> None:
         self._reply(code, {"error": message})
@@ -129,6 +138,23 @@ class ServeHandler(BaseHTTPRequestHandler):
                 if sub == "/healthz":
                     self._reply(200, {"ok": True,
                                       "tenants": len(self.service.tenants)})
+                elif sub == "/metrics":
+                    # Prometheus text exposition (docs/OBSERVABILITY.md):
+                    # the process registry (fleet/stream mirrors, compile
+                    # counters) plus the tenancy collector — the latter
+                    # derived from the same stats() dict /api/v1/stats
+                    # serves, so the two surfaces can never disagree —
+                    # plus TW_PROFILE device-memory gauges when enabled
+                    from traceweaver_tpu.obs import profile as _obs_profile
+                    from traceweaver_tpu.obs.exposition import (
+                        CONTENT_TYPE,
+                        render_metrics,
+                    )
+
+                    extra = (self.service.metrics_families()
+                             + _obs_profile.device_memory_families())
+                    self._reply_text(200, render_metrics(extra=extra),
+                                     CONTENT_TYPE)
                 elif sub == "/api/v1/stats":
                     self._reply(200, self.service.stats())
                 elif sub == "/api/v1/tenants":

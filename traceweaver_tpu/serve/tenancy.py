@@ -52,6 +52,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from traceweaver_tpu.ingest.jaeger import FIX_ROOT_OPS, parse_trace_payload
+from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.ops.precision import precision_from_env
 from traceweaver_tpu.query.delay_culprit import live_delay_culprit
 from traceweaver_tpu.runtime import knobs
@@ -65,6 +66,20 @@ from traceweaver_tpu.stream.service import (
 from traceweaver_tpu.stream.sources import SpanEvent
 
 _TENANT_ID_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
+
+# obs registry mirrors (docs/OBSERVABILITY.md): per-tenant counters and
+# the service-wide pump ledger. /metrics does NOT scrape these mirrors
+# for the per-tenant surface — it scrapes TenantService.metrics_families
+# (derived from the same stats() dicts at request time) so the exposed
+# values equal /api/v1/stats by construction.
+_OBS_TENANT_LEDGER = _get_registry().counter(
+    "tw_serve_tenant_ledger_total",
+    "per-tenant serve counters mirror (posts/ingest/quarantine/...)",
+    labels=("tenant", "key"))
+_OBS_PUMP = _get_registry().counter(
+    "tw_serve_pump_total",
+    "tenancy pump ledger mirror (shared/isolated solves, windows, ...)",
+    labels=("key",))
 
 
 class TenancyError(ValueError):
@@ -142,6 +157,9 @@ class Tenant:
             verbose=cfg.verbose,
         )
         self.svc = StreamingReconstructor(None, stream_cfg, sink=sink)
+        # self-trace identity: this tenant's window journeys key as
+        # "<tenant>:<window k>" on the shared tracer (obs/selftrace.py)
+        self.svc.trace_prefix = tenant_id + ":"
         self.ring = TraceRing(cfg.ring_size)
         # Alibaba self-loop remap state must be stable across payloads
         # (and across a resume) exactly like the batch loader's
@@ -215,7 +233,9 @@ class Tenant:
         svc.watermark.observe(ev.event_us)
         span = svc.live.add(ev)
         svc.windower.add(span, ev.event_us)
+        svc._trace_touch()
         sealed = svc.windower.poll(svc.watermark.value)
+        svc._trace_seal(sealed)
         for buf in sealed:
             svc.scheduler.offer(buf)
         if sealed and svc.cfg.prune:
@@ -243,6 +263,7 @@ class Tenant:
         frontier = max(b.end_us for b in svc.windower.open.values()) \
             + svc.windower.grace_us
         sealed = svc.windower.poll(frontier)
+        svc._trace_seal(sealed)
         for buf in sealed:
             svc.scheduler.offer(buf)
         return len(sealed)
@@ -343,6 +364,7 @@ class Tenant:
 
     # -- accounting -------------------------------------------------------
     def _bump(self, key: str, n: float = 1) -> None:
+        _OBS_TENANT_LEDGER.inc(n, tenant=self.id, key=key)
         self.counters[key] = self.counters.get(key, 0) + n
 
     def stats(self) -> Dict:
@@ -408,6 +430,13 @@ class TenantService:
             shared_solves=0, tenant_batches=0, isolated_solves=0,
             pumped_windows=0, drain_timeouts=0)
 
+    def _bump(self, key: str, n: float = 1) -> None:
+        """The pump ledger's single write path (callers hold the
+        re-entrant ``self._lock``); mirrors into the obs registry so the
+        sidecar scrape surface sees the pump too."""
+        _OBS_PUMP.inc(n, key=key)
+        self.stats_counters[key] = self.stats_counters.get(key, 0) + n
+
     # -- tenancy ----------------------------------------------------------
     def tenant(self, tenant_id: str, create: bool = True) -> Tenant:
         with self._lock:
@@ -463,7 +492,7 @@ class TenantService:
                 if t.ckpt_path and \
                         t.svc._since_checkpoint >= self.cfg.checkpoint_every:
                     t.checkpoint()
-            self.stats_counters["pumped_windows"] += n
+            self._bump("pumped_windows", n)
             return n
 
     def _solve_shared(self, batches: List[Tuple[Tenant, List]]) -> int:
@@ -485,15 +514,12 @@ class TenantService:
                                precision=self.precision,
                                quarantined=quarantined)
         solve_s = time.perf_counter() - t0
-        # twlint: disable=TW005 — only reachable from pump(), which
-        # holds the re-entrant self._lock for the whole solve
-        self.stats_counters["shared_solves"] += 1
-        # twlint: disable=TW005 — same: caller pump() holds self._lock
-        self.stats_counters["tenant_batches"] += len(batches)
+        self._bump("shared_solves")
+        self._bump("tenant_batches", len(batches))
         n = 0
         for t, bufs, per_buf, t_owners, lo, hi in prepared:
             share = solve_s * (hi - lo) / max(1, len(items))
-            t.svc.stats["solve_s"] = t.svc.stats.get("solve_s", 0.0) + share
+            t.svc._bump("solve_s", share)
             results = t.svc.consume_batch_results(
                 bufs, per_buf, t_owners, outs[lo:hi],
                 [k - lo for k in quarantined if lo <= k < hi], share)
@@ -518,10 +544,8 @@ class TenantService:
                                    precision=self.precision,
                                    quarantined=quarantined)
         solve_s = time.perf_counter() - t0
-        t.svc.stats["solve_s"] = t.svc.stats.get("solve_s", 0.0) + solve_s
-        # twlint: disable=TW005 — only reachable from pump(), which
-        # holds the re-entrant self._lock for the whole solve
-        self.stats_counters["isolated_solves"] += 1
+        t.svc._bump("solve_s", solve_s)
+        self._bump("isolated_solves")
         results = t.svc.consume_batch_results(bufs, per_buf, owners, outs,
                                               quarantined, solve_s)
         t.emit_results(results)
@@ -552,7 +576,7 @@ class TenantService:
             for tid in sorted(self.tenants):
                 if time.monotonic() - t0 > budget:
                     timed_out += 1
-                    self.stats_counters["drain_timeouts"] += 1
+                    self._bump("drain_timeouts")
                     continue
                 if self.tenants[tid].checkpoint():
                     done += 1
@@ -601,6 +625,55 @@ class TenantService:
     def trace(self, tenant_id: str, trace_id: str) -> Optional[Dict]:
         with self._lock:
             return self.tenant(tenant_id, create=False).ring.get(trace_id)
+
+    #: per-tenant stats() fields exposed on /metrics, name-for-name
+    _METRIC_TENANT_FIELDS = (
+        "consumed", "emitted_windows", "spans_emitted", "traces_emitted",
+        "backlog", "solved_windows", "shed_spilled",
+        "shed_dropped_windows", "shed_dropped_spans", "late_rerouted",
+        "late_dropped", "deadletter_windows", "deadletter_spans",
+        "quarantined_windows", "ring_traces", "ring_evicted")
+
+    def metrics_families(self) -> List:
+        """Collector-style families for ``GET /metrics``
+        (``(name, kind, help, [(labels, value), ...])`` tuples the
+        exposition renders after the process registry).
+
+        Derived at scrape time from the SAME :meth:`stats` call the
+        ``/api/v1/stats`` endpoint serves, so the exposed per-tenant
+        window/dispatch/ladder counters equal the JSON ledger exactly —
+        by construction, not by double bookkeeping
+        (tests/test_serve.py pins the match under concurrent load)."""
+        st = self.stats()
+        tenants = st["tenants"]
+        fams: List = [
+            ("tw_serve_tenants", "gauge", "live tenant count",
+             [({}, float(st["n_tenants"]))]),
+            ("tw_serve_backlog_windows", "gauge",
+             "sealed windows awaiting solve, all tenants",
+             [({}, float(st["total_backlog"]))]),
+            ("tw_serve_dispatch_total", "counter",
+             "service-wide dispatch ledger (= /api/v1/stats .dispatch)",
+             [({"kind": k}, float(v))
+              for k, v in sorted(st["dispatch"].items())]),
+        ]
+        tenant_samples = [
+            ({"tenant": tid, "key": field}, float(t[field]))
+            for tid, t in sorted(tenants.items())
+            for field in self._METRIC_TENANT_FIELDS
+        ]
+        fams.append((
+            "tw_serve_tenant_total", "counter",
+            "per-tenant window ledger (= /api/v1/stats .tenants.*)",
+            tenant_samples))
+        fams.append((
+            "tw_serve_tenant_faults_total", "counter",
+            "per-tenant solve-supervisor ladder (= /api/v1/stats "
+            ".tenants.*.faults)",
+            [({"tenant": tid, "rung": rung}, float(v))
+             for tid, t in sorted(tenants.items())
+             for rung, v in sorted(t["faults"].items())]))
+        return fams
 
     def stats(self, tenant_id: Optional[str] = None) -> Dict:
         with self._lock:

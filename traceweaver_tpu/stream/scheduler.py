@@ -38,7 +38,19 @@ from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Callable, Deque, List, Optional
 
+from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.stream.window import WindowBuffer
+
+# obs mirror of the backpressure/watchdog outcomes (the scheduler's own
+# integer attributes keep their names — summaries/checkpoints read them)
+_OBS_BACKPRESSURE = _get_registry().counter(
+    "tw_stream_backpressure_total",
+    "sealed-window admission outcomes (offer(): queued/spilled/dropped)",
+    labels=("outcome",))
+_OBS_WATCHDOG = _get_registry().counter(
+    "tw_stream_watchdog_total",
+    "micro-batch watchdog outcomes (timeouts/retries/poisoned windows)",
+    labels=("outcome",))
 
 
 class SolveTimeout(RuntimeError):
@@ -89,13 +101,16 @@ class MicroBatchScheduler:
         "dropped"."""
         if len(self.pending) < self.max_pending:
             self.pending.append(buf)
+            _OBS_BACKPRESSURE.inc(outcome="queued")
             return "queued"
         if len(self.spill) < self.spill_max:
             self.spill.append(buf)
             self.shed_spilled += 1
+            _OBS_BACKPRESSURE.inc(outcome="spilled")
             return "spilled"
         self.shed_dropped_windows += 1
         self.shed_dropped_spans += buf.n_spans
+        _OBS_BACKPRESSURE.inc(outcome="dropped")
         return "dropped"
 
     @property
@@ -131,6 +146,7 @@ class MicroBatchScheduler:
             return fut.result(timeout=self.watchdog_s)
         except FutureTimeout:
             self.solve_timeouts += 1
+            _OBS_WATCHDOG.inc(outcome="timeout")
             fut.cancel()  # best effort; a running solve is abandoned
             # a hung worker would serialize behind the abandoned solve:
             # detach the pool so the retry gets a fresh thread
@@ -147,6 +163,7 @@ class MicroBatchScheduler:
         for attempt in range(1 + self.solve_retries):
             if attempt:
                 self.solve_retried += 1
+                _OBS_WATCHDOG.inc(outcome="retried")
             try:
                 return self._solve_once(batch)
             except SolveTimeout as e:
@@ -156,6 +173,7 @@ class MicroBatchScheduler:
                     raise
                 err = e
         self.poisoned_windows += len(batch)
+        _OBS_WATCHDOG.inc(len(batch), outcome="poisoned")
         if self.poison_fn is not None:
             return self.poison_fn(batch, err)
         raise err

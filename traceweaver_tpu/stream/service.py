@@ -28,6 +28,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from traceweaver_tpu.obs import selftrace as _selftrace
+from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.ops.precision import precision_from_env
 from traceweaver_tpu.runtime import knobs as _knobs
 from traceweaver_tpu.spans import NA, SKIP, Span, SpanArray
@@ -40,6 +42,19 @@ from traceweaver_tpu.stream.state import (
 )
 from traceweaver_tpu.stream.watermark import WatermarkTracker
 from traceweaver_tpu.stream.window import WindowBuffer, WindowingEngine
+
+
+# obs registry mirrors (docs/OBSERVABILITY.md): the stream ledger's
+# scrape surface. The stats dict keeps its field names (summaries,
+# checkpoints, tests); every _bump ALSO lands here with a key label.
+_OBS = _get_registry()
+_OBS_STREAM = _OBS.counter(
+    "tw_stream_ledger_total",
+    "stream service ledger mirror (one series per stats counter key)",
+    labels=("key",))
+_OBS_SOLVE_S = _OBS.histogram(
+    "tw_solve_seconds",
+    "micro-batch solve wall time (stream + serve pump dispatches)")
 
 
 @dataclass
@@ -169,6 +184,10 @@ class StreamingReconstructor:
         self.stats: Dict[str, float] = {}
         self.fleet_stats: Dict[str, float] = {}
         self._since_checkpoint = 0
+        # self-trace identity (obs/selftrace.py): window keys are
+        # "<prefix><window k>"; the serve layer sets "<tenant>:" so one
+        # tracer can hold many tenants' journeys apart
+        self.trace_prefix = ""
         # score-path precision (TW_PRECISION, read at service start) —
         # labels every micro-batch/window line and rides the checkpoint
         # so a resume under a DIFFERENT precision is visible, not silent
@@ -276,7 +295,11 @@ class StreamingReconstructor:
                     wp.service, {wp.in_ep: wp.in_spans}, wp.out_parts,
                     wp.truth, wp.dag, store=self.live, warm_dists=warm,
                     tenant=tenant, in_cols=wp.in_cols,
-                    out_cols=wp.out_cols))
+                    out_cols=wp.out_cols,
+                    # host-side trace context: the fleet's pack thread,
+                    # dispatch flows, and decode workers stamp this
+                    # window's self-trace through the item (obs/selftrace)
+                    trace_key=self._trace_key(buf.k)))
                 owners.append(b)
         return per_buf, items, owners
 
@@ -300,8 +323,7 @@ class StreamingReconstructor:
                                precision=self.precision,
                                quarantined=quarantined)
             delta = counters_delta(counters_before)
-            self.stats["micro_batches"] = self.stats.get(
-                "micro_batches", 0) + 1
+            self._bump("micro_batches")
             # per-dispatch compile/cache visibility: a warm stream runs at
             # zero compiles per micro-batch; any nonzero line here is a new
             # shape class (or a cold persistent cache) — exactly the
@@ -315,7 +337,8 @@ class StreamingReconstructor:
                          delta["persistent_cache_hits"],
                          delta["persistent_cache_misses"]))
         solve_s = time.perf_counter() - t0
-        self.stats["solve_s"] = self.stats.get("solve_s", 0.0) + solve_s
+        self._bump("solve_s", solve_s)
+        _OBS_SOLVE_S.observe(solve_s)
         return self.consume_batch_results(bufs, per_buf, owners, outs,
                                           quarantined, solve_s)
 
@@ -481,6 +504,9 @@ class StreamingReconstructor:
                   "poison window %d counted but not persisted" % buf.k)
         self._bump("deadletter_windows")
         self._bump("deadletter_spans", buf.n_owned)
+        tr = _selftrace.active()
+        if tr is not None:
+            tr.finish(self._trace_key(buf.k))
         self._since_checkpoint += 1
         if self.cfg.verbose:
             print("[stream] win=%d DEAD-LETTERED spans=%d owned=%d (%s)"
@@ -514,6 +540,9 @@ class StreamingReconstructor:
             )
             self.sink.write_line(json.dumps(rec, sort_keys=True))
         self.emitted_windows += 1
+        tr = _selftrace.active()
+        if tr is not None:
+            tr.finish(self._trace_key(buf.k))
         self._since_checkpoint += 1
         self._bump("spans_emitted", buf.n_owned)
         self._bump("traces_emitted", len(res.traces))
@@ -537,7 +566,33 @@ class StreamingReconstructor:
                    self.scheduler.backlog, rate))
 
     def _bump(self, key: str, n: float = 1) -> None:
+        _OBS_STREAM.inc(n, key=key)
         self.stats[key] = self.stats.get(key, 0) + n
+
+    # -- self-tracing hooks (obs/selftrace.py; all no-ops when no tracer
+    # is installed — one global read per call) ---------------------------
+    def _trace_key(self, k: int) -> str:
+        return self.trace_prefix + str(k)
+
+    def _trace_touch(self) -> None:
+        """First sight of any newly opened window buffers (the ingest
+        stage's start clock). Called after ``windower.add``."""
+        tr = _selftrace.active()
+        if tr is None:
+            return
+        for k in self.windower.open:
+            tr.touch(self._trace_key(k))
+
+    def _trace_seal(self, sealed) -> None:
+        """Sealed windows close their ingest stage and stamp the seal
+        instant. Called wherever ``windower.poll``/``flush`` hands
+        buffers to the scheduler."""
+        tr = _selftrace.active()
+        if tr is None or not sealed:
+            return
+        now = _selftrace.now_us()
+        for buf in sealed:
+            tr.seal(self._trace_key(buf.k), now)
 
     # -- checkpointing ----------------------------------------------------
     def state_dict(self) -> Dict:
@@ -629,6 +684,9 @@ class StreamingReconstructor:
             # the primary checkpoint was corrupt/truncated and the load
             # fell back to the rotated last-good generation — counted so
             # the summary says the run survived a checkpoint corruption
+            # twlint: disable=TW007 — checkpoint-dict fixup before
+            # apply_state, not a live counter (the dict is not self.stats
+            # yet; mirroring happens on every _bump after resume)
             state["stats"]["checkpoint_recovered"] = (
                 state["stats"].get("checkpoint_recovered", 0) + 1)
         svc.apply_state(state)
@@ -696,7 +754,9 @@ class StreamingReconstructor:
             self.watermark.observe(ev.event_us)
             span = self.live.add(ev)
             self.windower.add(span, ev.event_us)
+            self._trace_touch()
             sealed = self.windower.poll(self.watermark.value)
+            self._trace_seal(sealed)
             for buf in sealed:
                 self.scheduler.offer(buf)
             if self.scheduler.backlog >= c.solve_min_batch:
@@ -725,7 +785,9 @@ class StreamingReconstructor:
         """End of stream: seal and solve everything left, emit, final
         checkpoint, and (in grading mode) compute the end-to-end streamed
         accuracy with the batch metrics."""
-        for buf in self.windower.flush():
+        flushed = self.windower.flush()
+        self._trace_seal(flushed)
+        for buf in flushed:
             self.scheduler.offer(buf)
         for res in self.scheduler.pump():
             self._emit(res)

@@ -133,6 +133,8 @@ class CarriedState:
     def update(self, service: str, dists) -> None:
         if dists:
             self.dists[service] = dists
+        # twlint: disable=TW007 — warm-start solver state (rides the
+        # checkpoint and seeds the next window's EM), not telemetry
         self.windows_seen[service] = self.windows_seen.get(service, 0) + 1
 
 
