@@ -483,8 +483,13 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
                                    sub_ta, dag, store=store))
         sub_meta.append((label, f"{label}@{n_actual}", sub_in, sub_ta))
     accs_by_prec = {}
+    sub_confs = None
     for prec_leg in ("f32", "bf16"):
-        outs = solve_fleet(sub_items, precision=prec_leg)
+        confs = [None] * len(sub_items)
+        outs = solve_fleet(sub_items, precision=prec_leg,
+                           confidences=confs)
+        if prec_leg == precision or sub_confs is None:
+            sub_confs = confs  # the active precision's quality ledger
         accs_by_prec[prec_leg] = {
             label: accuracy_for_service(out[0], sub_ta, sub_in)
             for (label, _, sub_in, sub_ta), out in zip(sub_meta, outs)
@@ -498,6 +503,9 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         k: round(v, 4) for k, v in subset_accs.items()}
     report.update(bf16_delta_fields(accs_by_prec["f32"],
                                     accs_by_prec["bf16"]))
+    # the quality-telemetry ledger of the subset solve: what tw.confidence
+    # would say about these windows (docs/OBSERVABILITY.md)
+    report.update(confidence_fields(sub_confs))
     report["subset_solve_s"] = round(time.perf_counter() - t0, 2)
     if report["bf16_delta_exceeds_1pt"]:
         log("child: WARNING — bf16 accuracy delta exceeds 1 pt vs f32 on "
@@ -747,6 +755,101 @@ def serve_fields(n_tenants: int, clean: dict, storm: dict) -> dict:
             storm.get("healthy_quarantined", 1) == 0
             and storm.get("healthy_shed", 1) == 0),
     }
+
+
+def confidence_fields(conf_maps) -> dict:
+    """Per-span confidence ledger -> report fields (unit-tested like
+    chaos_fields/serve_fields, tests/test_bench.py).
+
+    ``conf_maps`` is a solve's per-item confidences list
+    (``solve_fleet(confidences=...)`` — obs/quality.py records). The
+    fields summarize the distribution the quality telemetry would emit:
+    population, mean/min, the low-confidence share (TW_CONF_LOW), and
+    the OT-override share."""
+    vals, overridden = [], 0
+    for m in conf_maps or ():
+        for rec in (m or {}).values():
+            vals.append(float(rec["conf"]))
+            overridden += bool(rec.get("not_best"))
+    if not vals:
+        return {"conf_spans": 0, "conf_mean": None, "conf_min": None,
+                "conf_low_frac": None, "conf_overridden_frac": None}
+    low = _knobs.get_float("TW_CONF_LOW")
+    return {
+        "conf_spans": len(vals),
+        "conf_mean": round(sum(vals) / len(vals), 4),
+        "conf_min": round(min(vals), 4),
+        "conf_low_frac": round(
+            sum(v <= low for v in vals) / len(vals), 4),
+        "conf_overridden_frac": round(overridden / len(vals), 4),
+    }
+
+
+def scorecard_fields(card: dict) -> dict:
+    """Scorecard artifact -> report fields (unit-tested like
+    chaos_fields/serve_fields, tests/test_bench.py).
+
+    ``card`` is :func:`traceweaver_tpu.metrics.scorecard.run_scorecard`'s
+    artifact. The headline fields are the per-regime accuracy matrix,
+    the TPU-vs-best-baseline delta per regime, and the calibration
+    verdict: ``scorecard_calibration_monotone_ok`` (warn-flagged — the
+    decile table must show higher-confidence >= lower-confidence
+    accuracy within tolerance) plus the cruder-but-unambiguous
+    ``scorecard_top_vs_bottom_ok`` (top decile >= bottom decile)."""
+    per_regime = card.get("per_regime", {})
+    vs_best = {}
+    for regime, accs in per_regime.items():
+        base = [v for m, v in accs.items() if m != "weaver_tpu"]
+        if base and "weaver_tpu" in accs:
+            vs_best[regime] = round(accs["weaver_tpu"] - max(base), 4)
+    cal = card.get("calibration", [])
+    top_vs_bottom = (cal[-1]["accuracy"] >= cal[0]["accuracy"]
+                     if len(cal) >= 2 else None)
+    return {
+        "scorecard_regimes": per_regime,
+        "scorecard_tpu_minus_best_baseline": vs_best,
+        "scorecard_exact_subset_spans": card.get(
+            "weaver_exact_subset_spans"),
+        "scorecard_calibration": cal,
+        "scorecard_calibration_monotone_ok": bool(
+            card.get("calibration_monotone_ok")),
+        "scorecard_calibration_violations": card.get(
+            "calibration_violations", []),
+        "scorecard_top_vs_bottom_ok": top_vs_bottom,
+    }
+
+
+def run_scorecard_leg(n_traces: int) -> dict:
+    """bench.py --scorecard N: the per-regime baseline scorecard leg.
+
+    Runs all five in-repo baselines + the TPU solver over the synthetic
+    labeled three-regime corpus (traceweaver_tpu/metrics/scorecard.py —
+    no datasets required) and reports per-regime accuracy plus the
+    confidence-decile calibration check. WARNS (never fails) when the
+    calibration table is not monotone-ish: confidence that does not
+    predict correctness is the regression this leg exists to catch."""
+    import jax
+
+    if _knobs.get("TW_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from traceweaver_tpu.metrics.scorecard import run_scorecard
+
+    t0 = time.perf_counter()
+    card = run_scorecard(n_traces=n_traces)
+    report = dict(mode="scorecard",
+                  scorecard_traces_per_service=n_traces,
+                  scorecard_wall_s=round(time.perf_counter() - t0, 2),
+                  **scorecard_fields(card))
+    if not report["scorecard_calibration_monotone_ok"]:
+        log("scorecard leg: WARNING — confidence-decile calibration is "
+            "not monotone-ish: %s"
+            % "; ".join(report["scorecard_calibration_violations"]))
+    log("scorecard leg: per-regime %s; calibration monotone_ok=%s "
+        "top_vs_bottom_ok=%s"
+        % (report["scorecard_regimes"],
+           report["scorecard_calibration_monotone_ok"],
+           report["scorecard_top_vs_bottom_ok"]))
+    return report
 
 
 def telemetry_fields(stage_stats: dict, snap_before: dict,
@@ -1622,6 +1725,14 @@ if __name__ == "__main__":
                          "shed/quarantine counts, and the healthy-tenant "
                          "isolation delta under tenant 0's fault storm "
                          "(TW_BENCH_FAULTS, default dispatch:0.5)")
+    ap.add_argument("--scorecard", type=int, nargs="?", const=48,
+                    default=None, metavar="N",
+                    help="standalone per-regime scorecard leg: all five "
+                         "baselines + the TPU solver over the synthetic "
+                         "three-regime labeled corpus (N traces per "
+                         "service); reports per-regime accuracy and the "
+                         "confidence-decile calibration check "
+                         "(warn-flagged when not monotone-ish)")
     args = ap.parse_args()
     if args.faults:
         # env, so the solver CHILD (where the leg runs) inherits it
@@ -1637,6 +1748,14 @@ if __name__ == "__main__":
     if args.serve_tenants:
         serve_report = run_serve_leg(args.serve_tenants)
         line = json.dumps(serve_report)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        sys.exit(0)
+    if args.scorecard:
+        scorecard_report = run_scorecard_leg(args.scorecard)
+        line = json.dumps(scorecard_report)
         if args.out:
             with open(args.out, "w") as f:
                 f.write(line + "\n")

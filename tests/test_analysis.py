@@ -543,6 +543,55 @@ def test_tw007_suppression_and_scope():
 
 
 # ---------------------------------------------------------------------------
+# TW008 — packed-block channel layout discipline
+# ---------------------------------------------------------------------------
+
+def test_tw008_raw_channel_index_flagged():
+    findings, _ = lint("""
+        def decode(o):
+            assign = o[..., 0]
+            not_best = o[..., 1].astype(bool)
+            topk = o[..., 3:]
+            tail = o[..., :5]
+            return assign, not_best, topk, tail
+    """, path=FLEET)
+    assert rules_of(findings) == ["TW008"] * 4
+
+
+def test_tw008_axis_insertion_and_explicit_dims_clean():
+    findings, _ = lint("""
+        def pack(assign, not_best, ranges):
+            a = assign[..., None]           # axis insertion, not a channel
+            b = not_best[..., None]
+            r0 = ranges[:, :, 0]            # explicit dims: not packed-block
+            s = assign[..., a:b]            # non-constant bounds
+            return a, b, r0, s
+    """, path="traceweaver_tpu/algorithms/weaver_tpu.py")
+    assert findings == []
+
+
+def test_tw008_layout_module_and_unwatched_files_exempt():
+    src = """
+        CH_ASSIGN = 0
+
+        def split(block):
+            return block[..., 0], block[..., 3:]
+    """
+    findings, _ = lint(src,
+                       path="traceweaver_tpu/algorithms/packed_layout.py")
+    assert findings == []
+    findings, _ = lint(src, path="traceweaver_tpu/parallel/mesh.py")
+    assert findings == []
+    # suppression works like every rule
+    findings, suppressed = lint("""
+        def f(o):
+            # twlint: disable=TW008 — test fixture
+            return o[..., 2]
+    """, path=FLEET)
+    assert findings == [] and suppressed == 1
+
+
+# ---------------------------------------------------------------------------
 # registry mirrors + TW002 regressions (the two unfrozen knobs)
 # ---------------------------------------------------------------------------
 

@@ -327,3 +327,73 @@ def test_telemetry_fields_agreement_and_mismatch(bench):
     out2 = bench.telemetry_fields(rogue, snap0, snap1)
     assert out2["telemetry_matches_legacy"] is False
     assert out2["telemetry_mismatch_keys"] == ["rogue_counter"]
+
+
+@pytest.mark.quality
+def test_confidence_fields_summary(bench, monkeypatch):
+    """The quality-ledger report builder: per-item confidence maps ->
+    population/mean/min, the TW_CONF_LOW low share, and the OT-override
+    share; empty input degrades to None fields, not a crash."""
+    monkeypatch.setenv("TW_CONF_LOW", "0.5")
+    maps = [
+        {("t", "a"): {"conf": 1.0, "not_best": False},
+         ("t", "b"): {"conf": 0.5, "not_best": True}},
+        None,  # a quarantined/None slot must not crash the summary
+        {("t", "c"): {"conf": 0.25, "not_best": True}},
+    ]
+    out = bench.confidence_fields(maps)
+    assert out["conf_spans"] == 3
+    assert out["conf_mean"] == pytest.approx((1.0 + 0.5 + 0.25) / 3,
+                                             abs=1e-4)
+    assert out["conf_min"] == 0.25
+    assert out["conf_low_frac"] == pytest.approx(2 / 3, abs=1e-4)
+    assert out["conf_overridden_frac"] == pytest.approx(2 / 3, abs=1e-4)
+
+    empty = bench.confidence_fields([])
+    assert empty["conf_spans"] == 0
+    assert empty["conf_mean"] is None
+    assert empty["conf_low_frac"] is None
+
+
+@pytest.mark.quality
+def test_scorecard_fields_regimes_and_calibration_flags(bench):
+    """The scorecard-leg report builder: per-regime matrix passthrough,
+    TPU-minus-best-baseline deltas, and BOTH calibration verdicts (the
+    noise-aware monotone flag and the crude top-vs-bottom check)."""
+    card = {
+        "per_regime": {
+            "sequential": {"fcfs": 1.0, "weaver_tpu": 1.0},
+            "fanout": {"fcfs": 0.1, "wap5": 0.0, "weaver_tpu": 0.3},
+        },
+        "weaver_exact_subset_spans": 12,
+        "calibration": [
+            {"decile": 1, "conf_lo": 0.2, "conf_hi": 0.5, "n": 20,
+             "accuracy": 0.2},
+            {"decile": 2, "conf_lo": 0.5, "conf_hi": 1.0, "n": 20,
+             "accuracy": 0.9},
+        ],
+        "calibration_monotone_ok": True,
+        "calibration_violations": [],
+    }
+    out = bench.scorecard_fields(card)
+    assert out["scorecard_regimes"] == card["per_regime"]
+    assert out["scorecard_tpu_minus_best_baseline"] == {
+        "sequential": 0.0, "fanout": 0.2}
+    assert out["scorecard_exact_subset_spans"] == 12
+    assert out["scorecard_calibration_monotone_ok"] is True
+    assert out["scorecard_top_vs_bottom_ok"] is True
+    assert out["scorecard_calibration_violations"] == []
+
+    # an inverted table flags BOTH verdicts (warn surface, not a crash)
+    inv = dict(card, calibration=list(reversed(card["calibration"])),
+               calibration_monotone_ok=False,
+               calibration_violations=["decile 2 ..."])
+    out2 = bench.scorecard_fields(inv)
+    assert out2["scorecard_calibration_monotone_ok"] is False
+    assert out2["scorecard_top_vs_bottom_ok"] is False
+    assert out2["scorecard_calibration_violations"] == ["decile 2 ..."]
+
+    # degenerate cards (no calibration rows) stay well-formed
+    bare = bench.scorecard_fields({"per_regime": {}, "calibration": []})
+    assert bare["scorecard_top_vs_bottom_ok"] is None
+    assert bare["scorecard_tpu_minus_best_baseline"] == {}

@@ -1,0 +1,466 @@
+"""Reconstruction-quality observability tests (tier-1, CPU — ISSUE 10).
+
+Contracts covered (docs/OBSERVABILITY.md "Quality telemetry"):
+
+- per-span confidence records ride every fleet solve (base tier: plan
+  support + OT-override from the EXISTING packed channels — default
+  device programs untouched); quarantined windows score zero;
+- the device tier (``TW_CONF_DEVICE=1``) adds quantized margin/entropy
+  channels as ONE extra program variant: assignments identical to the
+  base program, and a second enabled solve costs zero backend compiles;
+- every trace emitted by the stream sink carries ``tw.confidence``;
+  per-tenant ``tw_trace_confidence`` histograms + low-confidence
+  counters land in the obs registry;
+- the serve ring records carry per-trace confidence, the
+  ``low_confidence`` query ranks ascending, and the delay-culprit
+  bracket's ``min_confidence`` filter excludes (counted) low-trust
+  reconstructions;
+- the PSI drift watcher freezes a reference, tracks the rolling
+  distribution, alerts ONCE per excursion into the event sink;
+- calibration: accuracy bucketed by confidence decile via the scorecard
+  harness — top decile >= bottom decile on the synthetic labeled
+  corpus, monotone-ish check noise-aware (field unit-tested);
+- the registry's label-cardinality guard collapses past-cap label sets
+  into one counted ``overflow="1"`` series.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import networkx as nx
+
+from traceweaver_tpu.obs import events as obs_events
+from traceweaver_tpu.obs import quality
+from traceweaver_tpu.spans import Span
+from traceweaver_tpu.metrics import get_ground_truth
+from traceweaver_tpu.metrics.accuracy import (
+    accuracy_by_confidence_decile,
+    calibration_monotone,
+    overlap_fraction,
+    service_regime,
+)
+
+pytestmark = pytest.mark.quality
+
+
+# ---------------------------------------------------------------------------
+# helpers: a small solvable service problem
+# ---------------------------------------------------------------------------
+
+def _service_problem(n=20, burst=1, jitter=2.0, n_eps=2, seed=0):
+    rng = np.random.default_rng(seed)
+    in_spans, out_parts = [], {f"EP{e}": [] for e in range(n_eps)}
+    t = 0.0
+    for i in range(n):
+        t += 40.0 if (burst > 1 and i % burst) else 5000.0
+        tid = f"t{i:03d}"
+        in_spans.append(Span(tid, "in", t, 900.0, "op", [], "svc", "server"))
+        for e in range(n_eps):
+            start = t + 30.0 + 90.0 * e + float(rng.normal(0, jitter))
+            out_parts[f"EP{e}"].append(
+                Span(tid, f"c{e}", max(start, t + 1.0), 40.0, f"call{e}",
+                     [(tid, "in")], "svc", "client"))
+    for ep in out_parts:
+        out_parts[ep].sort(key=lambda s: (s.start_mus, s.sid))
+    in_parts = {"IN": in_spans}
+    truth = get_ground_truth(in_parts, out_parts)
+    dag = nx.DiGraph()
+    dag.add_nodes_from(out_parts)
+    return in_parts, out_parts, truth, dag
+
+
+def _solve(in_parts, out_parts, truth, dag, **fleet_kw):
+    from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+
+    item = FleetItem("svc", in_parts, out_parts, truth, dag)
+    confs = [None]
+    outs = solve_fleet([item], confidences=confs, **fleet_kw)
+    return outs[0], confs[0]
+
+
+# ---------------------------------------------------------------------------
+# knobs + score math
+# ---------------------------------------------------------------------------
+
+def test_quality_knobs_registered():
+    from traceweaver_tpu.runtime import knobs
+
+    for name in ("TW_CONFIDENCE", "TW_CONF_DEVICE", "TW_CONF_LOW",
+                 "TW_CONF_DRIFT_PSI", "TW_CONF_DRIFT_WINDOW",
+                 "TW_METRICS_MAX_SERIES"):
+        assert name in knobs.REGISTRY
+    assert knobs.get_bool("TW_CONFIDENCE") is True
+    assert knobs.get_bool("TW_CONF_DEVICE") is False
+
+
+def test_confidence_scores_monotone_in_inputs():
+    """The score must fall with more credible alternatives and with an
+    OT override — in both tiers (the calibration table leans on this)."""
+    base = dict(not_best=np.array([False, False, True, False]),
+                cands=np.array([1, 8, 8, 64]),
+                support=np.array([1, 2, 2, 5]))
+    conf = quality.confidence_scores(base)
+    assert conf[0] == 1.0
+    assert conf[1] < conf[0] and conf[3] < conf[1]   # support grows
+    assert conf[2] == pytest.approx(conf[1] / 2)     # override halves
+    dev = dict(base, margin=np.array([5.0, 1.0, 1.0, 0.0]),
+               entropy=np.zeros(4))
+    dconf = quality.confidence_scores(dev)
+    assert dconf[0] > dconf[1] > dconf[3]            # margin thins
+    assert dconf[2] == pytest.approx(dconf[1] / 2)
+    assert dconf[3] == 0.0                           # dead tie: no trust
+
+
+# ---------------------------------------------------------------------------
+# fleet path: records, quarantine, device tier
+# ---------------------------------------------------------------------------
+
+def test_fleet_solve_fills_confidence_records():
+    in_parts, out_parts, truth, dag = _service_problem(n=16)
+    out, recs = _solve(in_parts, out_parts, truth, dag)
+    in_ids = {s.GetId() for s in in_parts["IN"]}
+    assert set(recs) == in_ids
+    for rec in recs.values():
+        assert 0.0 < rec["conf"] <= 1.0
+        assert rec["support"] >= 1 and rec["cands"] >= 1
+    # sequential geometry: the solver is certain and right
+    assert out[3] == 16
+    assert all(r["conf"] == 1.0 for r in recs.values())
+
+
+def test_overlapping_geometry_lowers_confidence():
+    seq = _solve(*_service_problem(n=24, burst=1))[1]
+    hard = _solve(*_service_problem(n=24, burst=6, jitter=35.0))[1]
+    mean = lambda rs: sum(r["conf"] for r in rs.values()) / len(rs)  # noqa: E731
+    assert mean(hard) < mean(seq)
+    assert any(r["support"] > 1 for r in hard.values())
+
+
+def test_quarantined_item_scores_zero_confidence(monkeypatch):
+    from traceweaver_tpu.runtime import faults
+
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    in_parts, out_parts, truth, dag = _service_problem(n=8)
+    with faults.override("dispatch:1.0,host:1.0", seed=0):
+        out, recs = _solve(in_parts, out_parts, truth, dag)
+    assert out[5] == 8  # all-NA quarantine result
+    assert recs and all(r["conf"] == 0.0 for r in recs.values())
+
+
+def test_conf_device_variant_identical_and_zero_recompiles(monkeypatch):
+    """TW_CONF_DEVICE is a static program variant: assignments equal the
+    base program's, margin/entropy ride the records, and the SECOND
+    enabled solve costs zero backend compiles (the acceptance pin)."""
+    from traceweaver_tpu.runtime.jax_cache import (
+        compile_counters,
+        counters_delta,
+    )
+
+    prob = _service_problem(n=24, burst=6, jitter=35.0)
+    base_out, base_recs = _solve(*prob)
+    monkeypatch.setenv("TW_CONF_DEVICE", "1")
+    dev_out, dev_recs = _solve(*prob)
+    assert dev_out[0] == base_out[0]  # same assignments per endpoint
+    assert all("margin" in r and "entropy" in r for r in dev_recs.values())
+    assert any(r["entropy"] > 0 for r in dev_recs.values())
+    before = compile_counters()
+    dev_out2, dev_recs2 = _solve(*prob)
+    assert counters_delta(before)["backend_compiles"] == 0
+    assert dev_recs2 == dev_recs
+    # margins thin exactly where the base tier saw contested support
+    contested = [sid for sid, r in base_recs.items() if r["support"] > 1]
+    assert contested
+    assert min(dev_recs[sid]["margin"] for sid in contested) < 4.0
+
+
+def test_confidence_disabled_kills_the_path(monkeypatch):
+    monkeypatch.setenv("TW_CONFIDENCE", "0")
+    from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+
+    in_parts, out_parts, truth, dag = _service_problem(n=8)
+    confs = [None]
+    outs = solve_fleet([FleetItem("svc", in_parts, out_parts, truth, dag)],
+                       confidences=confs)
+    assert outs[0][3] == 8
+    assert confs[0] is None  # no records computed
+
+
+# ---------------------------------------------------------------------------
+# stream emission surface
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def stream_corpus(tmp_path_factory):
+    from traceweaver_tpu.alibaba.synthesize import synthesize_corpus
+    from traceweaver_tpu.ingest import load_corpus
+
+    root = tmp_path_factory.mktemp("quality_corpus")
+    dirs = synthesize_corpus(str(root / "cg"), n_graphs=1,
+                             traces_per_graph=40, seed=7)
+    return load_corpus(dirs[0], fix=5, max_traces=40, cache=False)
+
+
+def test_stream_sink_records_carry_tw_confidence(stream_corpus, tmp_path):
+    from traceweaver_tpu.obs.registry import get_registry
+    from traceweaver_tpu.stream import (
+        ReplaySource,
+        StreamConfig,
+        StreamingReconstructor,
+        TraceSink,
+    )
+
+    sink_path = str(tmp_path / "out.jsonl")
+    cfg = StreamConfig(window_us=20e6, overlap_us=4e6, ooo_bound_us=1e6,
+                       checkpoint_every=10_000, verbose=False)
+    svc = StreamingReconstructor(
+        ReplaySource(stream_corpus, ooo_us=0.0, seed=1), cfg,
+        sink=TraceSink(sink_path))
+    before = get_registry().snapshot()
+    summary = svc.run()
+    after = get_registry().snapshot()
+    assert summary["confidence"]["enabled"]
+
+    recs = [json.loads(line) for line in open(sink_path)]
+    assert recs
+    with_conf = [r for r in recs if "tw.confidence" in r]
+    assert with_conf, "no emitted window carried tw.confidence"
+    n_scored_traces = 0
+    for rec in with_conf:
+        win = rec["tw.confidence"]["window"]
+        assert win["n"] > 0 and 0.0 <= win["min"] <= 1.0
+        for tid, tconf in rec["tw.confidence"]["traces"].items():
+            assert tid in rec["traces"]
+            if tconf is not None:
+                assert 0.0 <= tconf["conf"] <= 1.0
+                n_scored_traces += 1
+    assert n_scored_traces > 0
+    # the per-tenant histogram saw every scored trace (tenant "default")
+    key = 'tw_trace_confidence_count{tenant="default"}'
+    assert after.get(key, 0) - before.get(key, 0) == n_scored_traces
+
+
+# ---------------------------------------------------------------------------
+# serve surface: ring confidence, low_confidence query, culprit filter
+# ---------------------------------------------------------------------------
+
+def _hotel_payload(n=24, prefix="q"):
+    from tests.test_serve import hotel_payload
+
+    return hotel_payload(n_traces=n, prefix=prefix)
+
+
+def test_serve_ring_low_confidence_and_culprit_filter(tmp_path):
+    from traceweaver_tpu.serve import ServeConfig, TenantService
+
+    svc = TenantService(ServeConfig(
+        fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+        verbose=False, pump_windows=10**9))
+    svc.ingest("acme", _hotel_payload())
+    svc.flush()
+
+    recs = svc.tenants["acme"].ring.records()
+    assert recs
+    scored = [r for r in recs if "tw.confidence" in r]
+    assert scored, "ring records carry no tw.confidence"
+    for r in scored:
+        assert 0.0 <= r["tw.confidence"]["conf"] <= 1.0
+
+    low = svc.query_low_confidence("acme", limit=5, max_conf=1.0)
+    assert low["n_scored"] == len(scored)
+    confs = [t["confidence"] for t in low["traces"]]
+    assert confs == sorted(confs)
+
+    # an impossible bar excludes every scored record — counted, and the
+    # unfiltered result is unchanged
+    res_all = svc.query_delay_culprit("acme", percentile=0.5)
+    res_f = svc.query_delay_culprit("acme", percentile=0.5,
+                                    min_confidence=1.01)
+    assert res_f["n_low_confidence_excluded"] == len(scored)
+    assert res_f["n_traces"] == res_all["n_traces"] - len(scored)
+    assert res_all["n_low_confidence_excluded"] == 0
+
+    # /metrics exposition carries the per-tenant confidence histogram
+    from traceweaver_tpu.obs.exposition import render_metrics
+
+    text = render_metrics(extra=svc.metrics_families())
+    assert any(line.startswith("tw_trace_confidence_bucket{")
+               and 'tenant="acme"' in line
+               for line in text.splitlines())
+
+
+def test_serve_http_low_confidence_endpoint(tmp_path):
+    import urllib.request
+
+    from traceweaver_tpu.serve import ServeConfig, TenantService
+    from traceweaver_tpu.serve.http import make_server
+
+    svc = TenantService(ServeConfig(
+        fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+        verbose=False, pump_windows=10**9))
+    svc.ingest("acme", _hotel_payload(prefix="h"))
+    svc.flush()
+    server = make_server(svc)
+    import threading
+
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        url = (f"http://127.0.0.1:{server.port}/api/v1/tenants/acme/"
+               "query/low_confidence?limit=3&max_conf=1.0")
+        body = json.loads(urllib.request.urlopen(url).read())
+        assert body["n_scored"] > 0
+        assert len(body["traces"]) <= 3
+        url2 = (f"http://127.0.0.1:{server.port}/api/v1/tenants/acme/"
+                "query/delay_culprit?percentile=0.5&min_conf=1.01")
+        body2 = json.loads(urllib.request.urlopen(url2).read())
+        assert body2["n_low_confidence_excluded"] > 0
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# drift watcher
+# ---------------------------------------------------------------------------
+
+def test_drift_psi_reference_rolling_and_single_alert(tmp_path):
+    log_path = str(tmp_path / "events.jsonl")
+    prev = obs_events.install(obs_events.EventLog(log_path))
+    try:
+        d = quality.ConfidenceDrift(window=16, threshold=0.2)
+        # freeze the reference on a high-confidence regime
+        assert d.update("svc", [0.9] * 16) is None or True
+        stat = d.update("svc", [0.9] * 16)
+        assert stat is not None and stat < 0.05
+        assert d.alerts == 0
+        # regime shift: confidence collapses -> PSI crosses, ONE alert
+        stat = d.update("svc", [0.2] * 16)
+        assert stat > 0.2
+        assert d.alerts == 1
+        d.update("svc", [0.2] * 16)  # sustained shift: no alert flood
+        assert d.alerts == 1
+        # recovery re-arms: a second excursion alerts again
+        d.update("svc", [0.9] * 16)
+        d.update("svc", [0.2] * 16)
+        assert d.alerts == 2
+    finally:
+        obs_events.install(prev)
+    events = [json.loads(line) for line in open(log_path)]
+    shifts = [e for e in events if e.get("kind") == "confidence_drift"]
+    assert len(shifts) == 2
+    assert shifts[0]["key"] == "svc" and shifts[0]["psi"] > 0.2
+
+
+def test_drift_state_roundtrip():
+    d = quality.ConfidenceDrift(window=8, threshold=0.3)
+    d.update("a", [0.8] * 8)
+    d.update("a", [0.7] * 4)
+    d2 = quality.ConfidenceDrift.from_state(d.state())
+    assert d2.last_psi("a") == d.last_psi("a")
+    assert d2.window == 8 and d2.threshold == 0.3
+
+
+# ---------------------------------------------------------------------------
+# calibration + regimes + scorecard
+# ---------------------------------------------------------------------------
+
+def test_regime_classifier():
+    seq = _service_problem(n=12, burst=1)
+    asy = _service_problem(n=12, burst=6, jitter=35.0)
+    fan = _service_problem(n=12, burst=6, jitter=35.0, n_eps=5)
+    assert service_regime(seq[0], seq[1])["regime"] == "sequential"
+    assert service_regime(asy[0], asy[1])["regime"] == "async"
+    assert service_regime(fan[0], fan[1])["regime"] == "fanout"
+    assert overlap_fraction(seq[0]["IN"]) == 0.0
+    assert overlap_fraction(asy[0]["IN"]) > 0.5
+
+
+def test_accuracy_by_confidence_decile_and_monotone_check():
+    conf = {("t", str(i)): i / 100.0 for i in range(100)}
+    # perfectly calibrated: correctness tracks confidence
+    correct = {sid: c >= 0.5 for sid, c in conf.items()}
+    table = accuracy_by_confidence_decile(conf, correct, nbins=10)
+    assert [row["decile"] for row in table] == list(range(1, 11))
+    assert sum(row["n"] for row in table) == 100
+    assert table[0]["accuracy"] == 0.0 and table[-1]["accuracy"] == 1.0
+    ok, violations = calibration_monotone(table)
+    assert ok and not violations
+    # a REAL inversion (confidently wrong at scale) fails despite the
+    # noise-aware slack
+    bad = [dict(decile=1, conf_lo=0.0, conf_hi=0.2, n=400, accuracy=0.9),
+           dict(decile=2, conf_lo=0.8, conf_hi=1.0, n=400, accuracy=0.3)]
+    ok, violations = calibration_monotone(bad)
+    assert not ok and "decile 2" in violations[0]
+    # small-bucket jitter at the same true accuracy passes
+    noisy = [dict(decile=1, conf_lo=0.0, conf_hi=0.5, n=14, accuracy=0.29),
+             dict(decile=2, conf_lo=0.5, conf_hi=1.0, n=14, accuracy=0.14)]
+    assert calibration_monotone(noisy)[0]
+
+
+def test_scorecard_harness_regimes_and_calibration():
+    """The acceptance pin: all 5 baselines + the TPU solver over the
+    labeled corpus, per-regime accuracy present, and the calibration
+    table's top decile >= bottom decile (confidence predicts)."""
+    from traceweaver_tpu.metrics.scorecard import (
+        ALL_METHODS,
+        format_scorecard,
+        run_scorecard,
+    )
+
+    card = run_scorecard(n_traces=24, exact_traces=8, nbins=5)
+    assert set(card["per_regime"]) == {"sequential", "async", "fanout"}
+    for regime, accs in card["per_regime"].items():
+        assert set(accs) == set(ALL_METHODS)
+        for acc in accs.values():
+            assert 0.0 <= acc <= 1.0
+    assert card["per_regime"]["sequential"]["weaver_tpu"] == 1.0
+    cal = card["calibration"]
+    assert cal and sum(row["n"] for row in cal) == 3 * 24
+    assert cal[-1]["accuracy"] >= cal[0]["accuracy"]
+    assert card["weaver_exact_subset_spans"] == 8
+    text = format_scorecard(card)
+    assert "sequential" in text and "weaver_tpu" in text
+
+
+# ---------------------------------------------------------------------------
+# registry label-cardinality guard
+# ---------------------------------------------------------------------------
+
+def test_metrics_label_cardinality_guard(monkeypatch):
+    from traceweaver_tpu.obs.registry import MetricsRegistry
+
+    monkeypatch.setenv("TW_METRICS_MAX_SERIES", "3")
+    reg = MetricsRegistry()
+    c = reg.counter("tw_test_guard_total", "t", labels=("tenant",))
+    for i in range(3):
+        c.inc(1.0, tenant=f"t{i}")
+    # past the cap: new label sets collapse into ONE counted overflow
+    c.inc(2.0, tenant="t3")
+    c.inc(3.0, tenant="t4")
+    # existing series keep counting normally
+    c.inc(1.0, tenant="t0")
+    samples = {tuple(sorted(lab.items())): v for lab, v in c.samples()}
+    assert samples[(("tenant", "t0"),)] == 2.0
+    assert samples[(("overflow", "1"),)] == 5.0
+    assert len(samples) == 4  # 3 real series + the overflow series
+    # histograms guard too (the per-tenant confidence histogram is the
+    # many-tenant risk this exists for)
+    h = reg.histogram("tw_test_guard_seconds", "t", labels=("tenant",),
+                      buckets=(1.0,))
+    for i in range(5):
+        h.observe(0.5, tenant=f"t{i}")
+    hs = h.samples()
+    overflow_counts = [v for lab, v in hs
+                       if lab.get("overflow") == "1"
+                       and lab.get("__name__", "").endswith("_count")]
+    assert overflow_counts == [2.0]
+    # unlabeled families are untouched by the cap
+    u = reg.counter("tw_test_guard_unlabeled_total", "t")
+    u.inc(5.0)
+    assert u.samples() == [({}, 5.0)]

@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from traceweaver_tpu.algorithms import packed_layout as _layout
 from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
 from traceweaver_tpu.algorithms.weaver_tpu import (
     DEFAULT_MAX_WINDOW,
@@ -60,6 +61,7 @@ from traceweaver_tpu.algorithms.weaver_tpu import (
 )
 from traceweaver_tpu.obs import events as _events
 from traceweaver_tpu.obs import profile as _profile
+from traceweaver_tpu.obs import quality as _quality
 from traceweaver_tpu.obs import selftrace as _selftrace
 from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.ops.precision import (
@@ -445,14 +447,17 @@ def _raw_cells(item: FleetItem, max_window: int) -> float:
 
 
 def _run_fallback(entries, results, all_spans, all_processes,
-                  solver_kwargs, stats) -> None:
+                  solver_kwargs, stats, confidences=None) -> None:
     """Per-service solves for items the fused dispatch cannot carry.
 
     Dispatches overlap through a thread pool (the reference's own
     ThreadPool-over-services model, executor.py:1015-1026) and each
     solver's stage stats merge into the caller's dict — a mixed workload
     keeps both the overlap and the accounting it had on the pre-fleet
-    bench path."""
+    bench path. ``confidences`` (the caller's per-item quality slots,
+    obs/quality.py) receives each solver's own per-span records, so
+    fallback-path windows carry ``tw.confidence`` exactly like fused
+    ones."""
     st = _as_stats(stats)
 
     def run(entry):
@@ -473,11 +478,13 @@ def _run_fallback(entries, results, all_spans, all_processes,
             item.out_span_partitions, False, [], item.true_assignments,
             item.dag, **kwargs,
         )
-        return i, out, algo.stats
+        return i, out, algo.stats, algo.per_span_confidence
 
     with ThreadPoolExecutor(max_workers=max(1, len(entries))) as pool:
-        for i, out, solver_stats in pool.map(run, entries):
+        for i, out, solver_stats, conf in pool.map(run, entries):
             results[i] = out
+            if confidences is not None:
+                confidences[i] = conf
             st.merge(solver_stats)
 
 
@@ -495,6 +502,7 @@ def solve_fleet(
     item_cells: Optional[List[float]] = None,
     precision: Optional[str] = None,
     quarantined: Optional[List[int]] = None,
+    confidences: Optional[List[Optional[Dict]]] = None,
 ) -> List[Tuple]:
     """Solve every item, fusing eligible ones into one device dispatch.
 
@@ -542,6 +550,17 @@ def solve_fleet(
     ``fault_ladder`` event list). Non-transient errors (bugs) propagate
     unchanged. See docs/ROBUSTNESS.md.
 
+    ``confidences`` (when given, a list the caller sized to
+    ``len(items)``) receives each item's per-span reconstruction-quality
+    records (``{in span id: {conf, not_best, cands, support, ...}}`` —
+    :mod:`traceweaver_tpu.obs.quality`), reduced host-side from the SAME
+    packed block the decode already fetched. Quarantined items get
+    zero-confidence records (a fully failed window must be excludable
+    from culprit queries). ``TW_CONF_DEVICE=1`` additionally dispatches
+    the confidence program variant, whose quantized margin/entropy
+    channels sharpen the score; at default settings the device programs
+    are byte-identical to the pre-quality ones.
+
     Returns one FindAssignments-style 6-tuple per item, in order:
     ``(all_assignments, all_topk, not_best_count, n_spans,
     per_span_candidates, cnt_unassigned)``.
@@ -575,7 +594,7 @@ def solve_fleet(
             prepared.append((i, item, prep))
     if fallback_entries:
         _run_fallback(fallback_entries, results, all_spans, all_processes,
-                      solver_kwargs, st)
+                      solver_kwargs, st, confidences=confidences)
     if not prepared:
         return results  # type: ignore[return-value]
 
@@ -664,17 +683,25 @@ def solve_fleet(
         groups.append(carry)
 
     # --- budget + dispatch per group -------------------------------------
+    # TW_CONF_DEVICE opts every fused dispatch into the confidence
+    # program variant (quantized margin/entropy channels appended to the
+    # packed block — packed_layout.py). A static jit arg, so the default
+    # False keeps the dispatched programs byte-identical to the
+    # pre-quality ones, and an enabled steady state recompiles nothing.
+    conf_device = _quality.conf_device_enabled()
     hypers_common = dict(epsilon=epsilon, n_sinkhorn=n_sinkhorn,
                          n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
-                         precision=precision)
+                         precision=precision, confidence=conf_device)
     itemsize = score_itemsize(precision)
     # supervisor context: what the degradation ladder needs to route a
-    # failing singleton to the per-service host fallback, and where it
+    # failing singleton to the per-service host fallback, where it
     # records quarantined item indices for the caller (the stream service
-    # dead-letters the owning windows from this list)
+    # dead-letters the owning windows from this list), and the caller's
+    # per-item confidence slots the decode fills
     ctx = dict(all_spans=all_spans, all_processes=all_processes,
                solver_kwargs=solver_kwargs,
-               quarantined=quarantined if quarantined is not None else [])
+               quarantined=quarantined if quarantined is not None else [],
+               confidences=confidences)
     specs: List[_GroupSpec] = []
     for group in groups:
         spec = _make_spec(group, itemsize)
@@ -683,7 +710,8 @@ def solve_fleet(
             # The counter accumulates — a mixed workload can trip the
             # budget on several groups and the ledger must say how many.
             _run_fallback([(p[0], p[1]) for p in group], results,
-                          all_spans, all_processes, solver_kwargs, st)
+                          all_spans, all_processes, solver_kwargs, st,
+                          confidences=confidences)
             st.add("fleet_fallback_budget", 1.0)
             continue
         # depth-limit observability (bytes): the largest single admission
@@ -764,14 +792,15 @@ def _make_spec(group: List, itemsize: int) -> _GroupSpec:
 # Solve supervisor: retry -> bisect -> XLA -> host fallback -> quarantine
 # ---------------------------------------------------------------------------
 
-def _attempt_group(solver, pg, spec, results, st, hypers_common, mesh):
+def _attempt_group(solver, pg, spec, results, st, hypers_common, mesh,
+                   ctx=None):
     """One supervised dispatch+decode attempt of a packed group — the
     unit every ladder rung retries. ``pg`` stays host-side NumPy, so a
     failed attempt's donated device buffers never poison the retry:
     every attempt places fresh device copies."""
     _fault_check("dispatch", st)
     pend = _dispatch_packed(pg, spec, st, hypers_common, mesh)
-    _decode_group(solver, pend, results, st)
+    _decode_group(solver, pend, results, st, ctx=ctx)
 
 
 def _enter_ladder(err, solver, pg, spec, results, st, hypers_common, mesh,
@@ -825,7 +854,7 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
         _trace_stage(rung_keys, "retry", _selftrace.now_us())
         try:
             _attempt_group(solver, pg, spec, results, st, hypers_common,
-                           mesh)
+                           mesh, ctx)
             st.add("fault_recovered_retry")
             return
         except Exception as e:  # noqa: BLE001 — classified below
@@ -845,7 +874,7 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
             half_pg = _pack_group(half_spec, hypers_common, st)
             try:
                 _attempt_group(solver, half_pg, half_spec, results, st,
-                               hypers_common, mesh)
+                               hypers_common, mesh, ctx)
             except Exception as e:  # noqa: BLE001
                 _enter_ladder(e, solver, half_pg, half_spec, results, st,
                               hypers_common, mesh, ctx)
@@ -857,7 +886,7 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
     _trace_stage(rung_keys, "xla-fallback", _selftrace.now_us())
     try:
         _attempt_group(solver, pg, spec, results, st,
-                       {**hypers_common, "pallas": False}, mesh)
+                       {**hypers_common, "pallas": False}, mesh, ctx)
         return
     except Exception as e:  # noqa: BLE001
         if not _faults.is_transient_fault(e):
@@ -871,7 +900,8 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
     try:
         _fault_check("host", st)
         _run_fallback([(plan[0], plan[1])], results, ctx["all_spans"],
-                      ctx["all_processes"], ctx["solver_kwargs"], st)
+                      ctx["all_processes"], ctx["solver_kwargs"], st,
+                      confidences=ctx.get("confidences"))
         if results[plan[0]] is not None:
             return
     except Exception as e:  # noqa: BLE001
@@ -883,6 +913,12 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
     st.note("fault_ladder", "quarantine")
     _trace_stage(rung_keys, "quarantine", _selftrace.now_us())
     results[plan[0]] = _quarantine_result(plan)
+    if ctx.get("confidences") is not None:
+        # a quarantined window's reconstruction is all-NA: zero
+        # confidence by definition, so culprit queries can exclude it
+        ctx["confidences"][plan[0]] = {
+            s.GetId(): _quality.zero_confidence()
+            for s in plan[2]["in_spans"]}
     ctx["quarantined"].append(plan[0])
 
 
@@ -915,7 +951,7 @@ def _solve_groups_serial(specs, solver, results, st, hypers_common, mesh,
     def finish(entry):
         spec, pg, pend = entry
         try:
-            _decode_group(solver, pend, results, st)
+            _decode_group(solver, pend, results, st, ctx=ctx)
         except Exception as e:  # noqa: BLE001
             _enter_ladder(e, solver, pg, spec, results, st, hypers_common,
                           mesh, ctx)
@@ -973,7 +1009,7 @@ def _solve_groups_pipelined(specs, solver, results, st, hypers_common,
         try:
             try:
                 _attempt_group(solver, pg, spec, results, st, hypers_common,
-                               mesh)
+                               mesh, ctx)
             except Exception as e:  # noqa: BLE001 — transient faults
                 # degrade on THIS flow worker (the ladder's retries and
                 # sub-dispatches keep riding the pool, so other flows'
@@ -1178,6 +1214,10 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
                   # program variant); the default True is the historical
                   # program and cache key
                   pallas=hypers_common.get("pallas", True),
+                  # the quality-telemetry program variant (TW_CONF_DEVICE;
+                  # packed_layout.py): default False = the historical
+                  # packed block, byte-identical programs
+                  confidence=hypers_common.get("confidence", False),
                   max_preds=pg["max_preds"], max_succs=pg["max_succs"])
     warm = _compaction_warm()
     use_compact = (_compaction_on() and warm < n_sweeps
@@ -1254,7 +1294,9 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
     _OBS_DISPATCH_S.observe(dispatch_s)
     _trace_stage(trace_keys, "dispatch", w0)
     _copy_async(out)
-    return pg["per_item_pack"], out
+    # the decode ticket carries the program-variant flag so the decode
+    # worker splits the packed channels by the layout the dispatch used
+    return pg["per_item_pack"], out, hypers.get("confidence", False)
 
 
 def _tables_of(params: Dict) -> Tuple:
@@ -1388,7 +1430,7 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
     if n_passes == 1:
         return out0
     new_tables = refit_fleet_params(
-        out0[..., 0].astype(np.int32),
+        out0[..., _layout.CH_ASSIGN].astype(np.int32),
         batch["in_start"], batch["in_end"], batch["in_valid"],
         batch["out_start"], batch["out_end"], pidx,
         window_rows, window_valid,
@@ -1409,14 +1451,17 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
                            trace_keys=trace_keys)
 
 
-def _decode_group(solver, pend, results, stats):
+def _decode_group(solver, pend, results, stats, ctx=None):
     """Fetch one group's packed output and decode it per service.
 
     Safe on a pipeline decode worker: every write lands in that group's
-    own input-order ``results`` slots and all counter updates go through
-    the lock-guarded accumulator."""
+    own input-order ``results`` slots (and its own ``confidences``
+    slots) and all counter updates go through the lock-guarded
+    accumulator."""
     st = _as_stats(stats)
-    per_item_pack, out = pend
+    per_item_pack, out, conf_device = pend
+    confidences = (ctx or {}).get("confidences")
+    conf_on = confidences is not None and _quality.conf_enabled()
     # the compacted flow already fetched + merged on the host; the
     # single-dispatch flows hand over an async device handle
     o = out if isinstance(out, np.ndarray) else _fetch(out, st)
@@ -1431,10 +1476,11 @@ def _decode_group(solver, pend, results, stats):
             # tenancy column, decode end: packed == decoded per tenant is
             # the conservation check the serve tests assert from stats
             st.bucket("tenant_windows_decoded", item.tenant, float(n_w))
-        assign = rows[..., 0]
-        not_best = rows[..., 1].astype(bool)
-        feas = rows[..., 2]
-        topk_cols = rows[..., 3:]
+        ch = _layout.split_packed(rows, confidence=conf_device)
+        assign = ch["assign"]
+        not_best = ch["not_best"]
+        feas = ch["feas"]
+        topk_cols = ch["topk_cols"]
         out_eps = prep["out_eps"]
         in_ids = (prep["in_cols"].ids.tolist()
                   if prep.get("in_cols") is not None
@@ -1448,6 +1494,14 @@ def _decode_group(solver, pend, results, stats):
         span_cands = np.ones(n_in, dtype=np.int64)
         scatter_window_span_stats(packed.windows, not_best, feas,
                                   span_not_best, span_cands)
+        if conf_on:
+            # per-span quality reductions from the SAME fetched block —
+            # no extra transfer, no device change (obs/quality.py); the
+            # slot write is race-free like the results slot (input-order,
+            # one writer per item)
+            arrs = _quality.span_confidence_arrays(
+                packed.windows, rows, n_in, device=conf_device)
+            confidences[i] = _quality.confidence_records(in_ids, arrs)
         solver._resolve_cross_window_duplicates(
             all_assignments, all_topk, in_ids, prep["skip_budget"])
         cnt_unassigned = sum(

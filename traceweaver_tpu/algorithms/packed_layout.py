@@ -1,0 +1,97 @@
+"""Canonical channel layout of the packed solver output block.
+
+Every fleet/packed entry point returns one int32 tensor whose LAST axis
+multiplexes the per-span solver outputs (``weaver_tpu._pack_solver_outputs``).
+The channel indices used to live as magic ``0``/``1``/``2``/``3`` literals
+duplicated across the ``weaver_tpu`` and ``fleet`` decoders — a silent
+corruption hazard the moment anyone grows the block (exactly what the
+confidence channels below did). This module is now the single source of
+truth; twlint rule TW008 (docs/ANALYSIS.md) flags raw channel-index
+subscripts on packed blocks anywhere else.
+
+Base layout (historical, byte-identical to the pre-confidence program)::
+
+    [B, E, W, N_FIXED + topk]
+      channel CH_ASSIGN   (0)   assign       — column index per incoming span
+                                               (M = skip, -1 = unassigned)
+      channel CH_NOT_BEST (1)   not_best     — OT choice differs from the row
+                                               argmax (bool as int32)
+      channel CH_FEAS     (2)   feas_count   — feasible candidates per row
+      channels CH_TOPK..        topk columns — plan-mass-ranked alternatives
+                                               (-1 below MIN_TOPK_MASS)
+
+Confidence extension (``confidence=True`` static arg — an opt-in program
+variant; the default block above is untouched)::
+
+    [..., N_FIXED + topk + N_CONF]
+      channel ch_margin(topk)   margin_q  — top1-top2 row score margin,
+                                            fixed-point x CONF_SCALE
+      channel ch_entropy(topk)  entropy_q — entropy (nats) of the row's
+                                            entropic-OT conditional
+                                            softmax(S/eps), x CONF_SCALE
+
+The per-window sweep-convergence flag is NOT a channel: it rides its own
+``[B]`` bool array so compaction can fetch O(B) bytes (PR 3).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+#: fixed (non-topk) channel indices of the packed block
+CH_ASSIGN = 0
+CH_NOT_BEST = 1
+CH_FEAS = 2
+#: first top-k column channel
+CH_TOPK = 3
+#: number of fixed channels before the top-k block
+N_FIXED = 3
+#: extra trailing channels under the confidence program variant
+N_CONF = 2
+#: fixed-point scale of the quantized confidence channels (int32 = value
+#: x CONF_SCALE, saturating — 3 decimal digits is plenty for log-margin
+#: and nat-entropy magnitudes)
+CONF_SCALE = 1000.0
+
+
+def n_channels(topk: int, confidence: bool = False) -> int:
+    """Last-axis width of the packed block for a given ``topk``."""
+    return N_FIXED + topk + (N_CONF if confidence else 0)
+
+
+def ch_margin(topk: int) -> int:
+    return N_FIXED + topk
+
+
+def ch_entropy(topk: int) -> int:
+    return N_FIXED + topk + 1
+
+
+def topk_of(block_channels: int, confidence: bool = False) -> int:
+    """Recover ``topk`` from a block's channel count."""
+    return block_channels - N_FIXED - (N_CONF if confidence else 0)
+
+
+def split_packed(block, confidence: bool = False,
+                 topk: Optional[int] = None) -> Dict[str, object]:
+    """Named views of a packed block's channels (no copies).
+
+    Returns ``assign`` (int32), ``not_best`` (bool), ``feas`` (int32),
+    ``topk_cols`` (int32 ``[..., topk]``), and — under the confidence
+    variant — ``margin_q`` / ``entropy_q`` (int32, fixed-point
+    ``x CONF_SCALE``). ``topk`` is inferred from the channel count when
+    not given.
+    """
+    n_ch = block.shape[-1]
+    if topk is None:
+        topk = topk_of(n_ch, confidence)
+    out = dict(
+        assign=block[..., CH_ASSIGN],
+        not_best=block[..., CH_NOT_BEST].astype(bool),
+        feas=block[..., CH_FEAS],
+        topk_cols=block[..., CH_TOPK:CH_TOPK + topk],
+    )
+    if confidence:
+        out["margin_q"] = block[..., ch_margin(topk)]
+        out["entropy_q"] = block[..., ch_entropy(topk)]
+    return out

@@ -55,11 +55,13 @@ import jax
 import jax.numpy as jnp
 import networkx as nx
 
+from traceweaver_tpu.algorithms import packed_layout as _layout
 from traceweaver_tpu.algorithms import timing
 from traceweaver_tpu.algorithms.skips import water_fill_skip_caps
 from traceweaver_tpu.algorithms.timing import MAX_COMPONENTS, EdgeDist
 from traceweaver_tpu.metrics.accuracy import get_out_eps_in_order
 from traceweaver_tpu.obs import profile as _obs_profile
+from traceweaver_tpu.obs import quality as _quality
 from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.ops.pallas_sinkhorn import assign_topk
 from traceweaver_tpu.ops.precision import (
@@ -138,6 +140,7 @@ def _solve_windows_impl(
     max_succs: int = 0,
     precision: str = "f32",
     pallas: bool = True,
+    confidence: bool = False,
 ):
     """Shared body of :func:`solve_windows` / :func:`solve_windows_fleet`.
 
@@ -166,6 +169,17 @@ def _solve_windows_impl(
     and rounding margins stay f32 throughout (``ops/sinkhorn.py`` /
     ``ops/pallas_sinkhorn.py``); ``"f32"`` compiles the historical
     program bit-identically (no cast is inserted at all).
+
+    ``confidence`` (static; default False — the historical program, no
+    trace change at all) additionally exports two quantized per-row
+    quality channels derived from the assembled OT block the solver
+    already holds in registers (:mod:`traceweaver_tpu.algorithms.packed_layout`):
+    the top1-top2 row score margin and the entropy of the row's
+    entropic-OT conditional ``softmax(S/epsilon)`` — the plan-derived
+    confidence signals the quality telemetry path
+    (:mod:`traceweaver_tpu.obs.quality`) reduces per span. A distinct
+    static-arg program variant, so enabling it costs ONE compile and
+    every later solve runs from cache (zero recompiles, test-pinned).
     """
     precision = validate_precision(precision)
     B, E, M = out_start.shape
@@ -324,8 +338,31 @@ def _solve_windows_impl(
             )
 
             not_best = (assign != jnp.argmax(Sfull, axis=1)) & in_v
-            return (chosen_end, chosen_start, backward), (
-                assign, tk.astype(jnp.int32), not_best, feas_count)
+            outs = (assign, tk.astype(jnp.int32), not_best, feas_count)
+            if confidence:
+                # plan-derived quality channels, from the SAME assembled
+                # block the solve ranked (f32 view; under bf16 the rows
+                # are already best-centered, so margins keep mantissa).
+                # Quantized fixed-point so they ride the existing int32
+                # packed transfer — no extra D2H stream, no f32 output.
+                Sf = Sfull.astype(jnp.float32)
+                top2 = jax.lax.top_k(Sf, 2)[0]               # [W, 2]
+                margin = jnp.maximum(top2[:, 0] - top2[:, 1], 0.0)
+                # entropic-OT row conditional: the Sinkhorn plan row is
+                # softmax((S + g)/eps) up to the column potentials; the
+                # unconstrained conditional softmax(S/eps) is the
+                # potential-free row entropy (0 = one-hot certainty)
+                logits = jnp.where(Sf > NEG / 2, Sf / epsilon, NEG)
+                p = jax.nn.softmax(logits, axis=1)
+                ent = -jnp.sum(jnp.where(p > 0.0,
+                                         p * jnp.log(p + 1e-30), 0.0),
+                               axis=1)
+                scale = _layout.CONF_SCALE
+                margin_q = (jnp.minimum(margin, 2.0e6) * scale).astype(
+                    jnp.int32)
+                ent_q = (jnp.maximum(ent, 0.0) * scale).astype(jnp.int32)
+                outs = outs + (margin_q, ent_q)
+            return (chosen_end, chosen_start, backward), outs
 
         def sweep_body(carry):
             (chosen_end, chosen_start, _), outs, sweep, _ = carry
@@ -358,6 +395,11 @@ def _solve_windows_impl(
             jnp.zeros((E, W), dtype=bool),
             jnp.zeros((E, W), dtype=jnp.int32),
         )
+        if confidence:
+            init_outs = init_outs + (
+                jnp.zeros((E, W), dtype=jnp.int32),   # margin_q
+                jnp.zeros((E, W), dtype=jnp.int32),   # entropy_q
+            )
         # one traced sweep body (compile surface independent of n_sweeps)
         _, outs, _, changed = jax.lax.while_loop(
             sweep_cond, sweep_body,
@@ -434,9 +476,14 @@ def solve_windows(
     return assign, tk, not_best, feas
 
 
-def _pack_solver_outputs(assign, tk, not_best, feas):
-    """The single-transfer int32 layout ``[B, E, W, 3 + topk]``:
-    channel 0 = assign, 1 = not_best, 2 = feas_count, 3.. = topk columns.
+def _pack_solver_outputs(assign, tk, not_best, feas,
+                         margin_q=None, entropy_q=None):
+    """The single-transfer int32 layout ``[B, E, W, 3 + topk]`` (see
+    :mod:`traceweaver_tpu.algorithms.packed_layout` — the single source
+    of truth for the channel indices): ``CH_ASSIGN``, ``CH_NOT_BEST``,
+    ``CH_FEAS``, then the topk columns, then (confidence variant only)
+    the two quantized quality channels appended at the END so every
+    historical channel index is unchanged.
 
     The per-window sweep-convergence flag is deliberately NOT a channel
     of this block any more: the fleet entry points return it as a
@@ -444,10 +491,11 @@ def _pack_solver_outputs(assign, tk, not_best, feas):
     can fetch B bytes instead of blocking on the whole packed block
     (the ``copy-start`` D2H cost the r05 profile billed at parity with
     the sweep loops themselves)."""
-    return jnp.concatenate(
-        [assign[..., None], not_best[..., None].astype(jnp.int32),
-         feas[..., None], tk], axis=-1,
-    )
+    chans = [assign[..., None], not_best[..., None].astype(jnp.int32),
+             feas[..., None], tk]
+    if margin_q is not None:
+        chans += [margin_q[..., None], entropy_q[..., None]]
+    return jnp.concatenate(chans, axis=-1)
 
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
@@ -609,7 +657,7 @@ def solve_em_packed(
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
                                    "sinkhorn_tol", "max_preds", "max_succs",
-                                   "precision", "pallas"),
+                                   "precision", "pallas", "confidence"),
          donate_argnums=tuple(range(8)))
 def solve_windows_fleet(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
@@ -623,6 +671,7 @@ def solve_windows_fleet(
     max_preds: int = 0, max_succs: int = 0,
     precision: str = "f32",
     pallas: bool = True,
+    confidence: bool = False,
 ):
     """Multi-service :func:`solve_windows` with the packed int32 output
     (window tensors donated — see :func:`solve_windows_packed`).
@@ -633,10 +682,12 @@ def solve_windows_fleet(
     endpoints have no valid columns, assign nothing, and pass predecessor
     times through, so they cannot disturb real endpoints).
 
-    Returns ``(packed, converged)``: the ``[B, E, W, 3+topk]`` block plus
-    the per-window sweep-fixed-point flags as a SEPARATE ``[B]`` bool
-    array, so the convergence-compaction host step can fetch B bytes
-    alone while the packed block streams D2H asynchronously."""
+    Returns ``(packed, converged)``: the ``[B, E, W, 3+topk]`` block
+    (``confidence=True`` appends the two quantized quality channels —
+    :mod:`traceweaver_tpu.algorithms.packed_layout`) plus the per-window
+    sweep-fixed-point flags as a SEPARATE ``[B]`` bool array, so the
+    convergence-compaction host step can fetch B bytes alone while the
+    packed block streams D2H asynchronously."""
     outs = _solve_windows_impl(
         in_start, in_end, in_valid, out_start, out_end, out_valid,
         skip_cap, force_skip, param_idx,
@@ -646,9 +697,9 @@ def solve_windows_fleet(
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs, precision=precision,
-        pallas=pallas,
+        pallas=pallas, confidence=confidence,
     )
-    return _pack_solver_outputs(*outs[:4]), outs[4]
+    return _pack_solver_outputs(*outs[:-1]), outs[-1]
 
 
 def _fleet_refit_tables(assign0, in_start, in_end, in_valid,
@@ -723,7 +774,7 @@ def refit_fleet_params(assign0, in_start, in_end, in_valid,
 
 @partial(jax.jit, static_argnames=("epsilon", "n_sinkhorn", "topk", "n_sweeps",
                                    "sinkhorn_tol", "max_preds", "max_succs",
-                                   "precision", "pallas"),
+                                   "precision", "pallas", "confidence"),
          donate_argnums=tuple(range(8)))
 def solve_em_fleet(
     in_start, in_end, in_valid, out_start, out_end, out_valid,
@@ -737,6 +788,7 @@ def solve_em_fleet(
     max_preds: int = 0, max_succs: int = 0,
     precision: str = "f32",
     pallas: bool = True,
+    confidence: bool = False,
 ):
     """Both EM iterations for a whole service fleet in ONE dispatch.
 
@@ -780,7 +832,7 @@ def solve_em_fleet(
         epsilon=epsilon, n_sinkhorn=n_sinkhorn, topk=topk,
         n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
         max_preds=max_preds, max_succs=max_succs, precision=precision,
-        pallas=pallas,
+        pallas=pallas, confidence=confidence,
     )
 
 
@@ -1450,6 +1502,12 @@ class WeaverTPU:
         # per-solve stage accounting (seconds / analytic op counts),
         # populated by FindAssignments; read by the benchmark
         self.stats: Dict[str, float] = {}
+        # per-span reconstruction-quality records of the LAST solve
+        # ({in span id: {conf, not_best, cands, support}} —
+        # obs/quality.py); the fleet's per-service fallback path copies
+        # this into the caller's confidences slot, so fallback windows
+        # carry tw.confidence exactly like fused ones
+        self.per_span_confidence: Dict = {}
 
     # -- helpers -----------------------------------------------------------
     @staticmethod
@@ -1665,11 +1723,9 @@ class WeaverTPU:
             # pass above started every transfer; fleet-path fetches go
             # through fleet._fetch instead)
             o = np.asarray(out)
-            assign = o[..., 0]
-            not_best = o[..., 1].astype(bool)
-            feas = o[..., 2]
-            topk_cols = o[..., 3:]
-            results.append((packed, (assign, topk_cols, not_best, feas)))
+            ch = _layout.split_packed(o)
+            results.append((packed, (ch["assign"], ch["topk_cols"],
+                                     ch["not_best"], ch["feas"])))
         _stat_add(stats, "wait_s", _time.perf_counter() - t0)
         return results
 
@@ -1830,15 +1886,23 @@ class WeaverTPU:
             # confidence: a span is "not best" if OT overrode the row argmax
             span_not_best = np.zeros(n_in, dtype=bool)
             span_cands = np.ones(n_in, dtype=np.int64)
+            conf_on = _quality.conf_enabled()
+            conf_arrs = _quality.new_span_arrays(n_in) if conf_on else None
             for packed, (assign, topk_cols, not_best, feas) in batches:
                 self._decode(packed, assign, topk_cols,
                              all_assignments, all_topk)
                 scatter_window_span_stats(packed.windows, not_best, feas,
                                           span_not_best, span_cands)
+                if conf_on:
+                    _quality.scatter_confidence(packed.windows, not_best,
+                                                feas, topk_cols, conf_arrs)
             not_best_count = int(span_not_best.sum())
             per_span_candidates = {
                 in_ids[i]: int(span_cands[i]) for i in range(n_in)
             }
+            self.per_span_confidence = (_quality.confidence_records(
+                in_ids, _quality.finish_confidence(conf_arrs))
+                if conf_on else {})
             self._resolve_cross_window_duplicates(
                 all_assignments, all_topk, in_ids, skip_budget)
             _stat_add(self.stats, "decode_s", _time.perf_counter() - t0)

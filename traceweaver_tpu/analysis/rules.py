@@ -896,7 +896,78 @@ class MetricDiscipline:
         return findings
 
 
+# ---------------------------------------------------------------------------
+# TW008 — packed-block channel layout discipline
+# ---------------------------------------------------------------------------
+
+class ChannelLayoutDiscipline:
+    """Packed-block channel indices come from ``algorithms/packed_layout.py``.
+
+    The packed solver output multiplexes per-span channels on its last
+    axis (``[B, E, W, N_FIXED + topk (+ conf)]``); the indices used to
+    live as magic ``0``/``1``/``2``/``3:`` literals duplicated across the
+    ``weaver_tpu`` and ``fleet`` decoders. That duplication is a silent
+    data-corruption hazard: growing the block (the confidence channels
+    did) shifts the top-k base, and a stale literal decodes margins as
+    top-k candidate columns without any error. ``packed_layout.py`` is
+    now the single source of truth (named constants +
+    ``split_packed``); this rule flags raw trailing-axis integer
+    subscripts — ``x[..., 2]``, ``x[..., 3:]`` — in the modules that
+    touch packed blocks.
+
+    Narrow by design: only Ellipsis-leading subscripts with an integer
+    constant (or an integer-bounded slice) are channel accesses;
+    ``x[..., None]`` (axis insertion) and explicit-dim indexing like
+    ``arr[:, :, 0]`` on non-packed tensors are untouched, and only the
+    packed-block-bearing modules are watched.
+    """
+
+    id = "TW008"
+    title = "raw packed-block channel index outside packed_layout.py"
+
+    #: modules that decode/construct packed solver blocks
+    WATCH_FILES = ("algorithms/weaver_tpu.py", "algorithms/fleet.py",
+                   "obs/quality.py")
+    #: the layout module itself is the one legitimate home of the indices
+    ALLOWED = ("algorithms/packed_layout.py",)
+
+    @staticmethod
+    def _int_const(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Constant)
+                and isinstance(node.value, int)
+                and not isinstance(node.value, bool))
+
+    def _channel_elt(self, node: ast.AST) -> bool:
+        if self._int_const(node):
+            return True
+        if isinstance(node, ast.Slice):
+            return any(part is not None and self._int_const(part)
+                       for part in (node.lower, node.upper))
+        return False
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not _path_in(mod, self.WATCH_FILES) or _path_in(mod, self.ALLOWED):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Subscript):
+                continue
+            sl = node.slice
+            if not (isinstance(sl, ast.Tuple) and sl.elts
+                    and isinstance(sl.elts[0], ast.Constant)
+                    and sl.elts[0].value is Ellipsis):
+                continue
+            if any(self._channel_elt(e) for e in sl.elts[1:]):
+                findings.append(mod.finding(
+                    self.id, node,
+                    "raw channel index on a packed-block trailing axis — "
+                    "use the named constants / split_packed from "
+                    "traceweaver_tpu.algorithms.packed_layout (the single "
+                    "source of truth for the [*, 3+topk(+conf)] layout)"))
+        return findings
+
+
 #: registration order == reporting order for same-line findings
 RULE_CLASSES = [KnobDiscipline, ImportTimeFreeze, HostSyncHazard,
                 RecompileDiscipline, LockDiscipline, PrecisionDiscipline,
-                MetricDiscipline]
+                MetricDiscipline, ChannelLayoutDiscipline]

@@ -12,11 +12,23 @@ numbers are directly comparable:
   right (utils.py:99-117);
 - accuracy binned into 10 response-time percentile bins (utils.py:187-214);
 - end-to-end trace assembly for the query engine (utils.py:216-252).
+
+Plus the reconstruction-quality additions (ISSUE 10, ROADMAP item 5b):
+
+- **regime bucketing** (:func:`service_regime`) — classify a service
+  problem by the structural features that drive assignment difficulty
+  (fan-out degree, async-overlap fraction), so accuracy can be reported
+  per regime instead of as one blended number (PAPER.md concedes the
+  blend hides 0.36-vs-exact services);
+- **confidence calibration** (:func:`accuracy_by_confidence_decile` /
+  :func:`calibration_monotone`) — bucket exact-match correctness by the
+  solver's own confidence deciles: monotone-ish accuracy over deciles is
+  what makes ``tw.confidence`` *predictive* rather than decorative.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from traceweaver_tpu.spans import NA, SKIP, Span, SpanId
 
@@ -190,6 +202,160 @@ def bin_accuracy_by_response_times(
         prev_c, prev_n = prev_c + c, prev_n + n
         out.append(((b + 1) * 100 / nbins, c / n, d / 1000.0))
     return out
+
+
+# ---------------------------------------------------------------------------
+# regime bucketing + confidence calibration (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+#: regime thresholds: fan-out at/above this is the "fanout" regime
+#: (media/nginx — the paper's hard case — has 6 outgoing endpoints)
+FANOUT_DEGREE = 4
+#: fraction of consecutive incoming spans whose intervals overlap at/above
+#: which a non-fanout service counts as "async"
+ASYNC_OVERLAP_FRAC = 0.25
+
+
+def overlap_fraction(in_spans: List[Span]) -> float:
+    """Async-overlap fraction of a sorted-or-not incoming partition: the
+    share of consecutive (by start time) spans whose [start, end)
+    intervals overlap. 0.0 = strictly sequential requests (each finishes
+    before the next starts — every window is one span, the easy case);
+    near 1.0 = heavily interleaved traffic where candidate sets share
+    members (the statistically hard case)."""
+    if len(in_spans) < 2:
+        return 0.0
+    ordered = sorted(in_spans, key=lambda s: (s.start_mus, s.end_mus))
+    n_overlap = sum(
+        1 for a, b in zip(ordered[:-1], ordered[1:])
+        if float(b.start_mus) < float(a.start_mus) + float(a.duration_mus)
+    )
+    return n_overlap / (len(ordered) - 1)
+
+
+def service_regime(in_span_partitions: Dict[str, List[Span]],
+                   out_span_partitions: Dict[str, List[Span]],
+                   fanout_degree: int = FANOUT_DEGREE,
+                   overlap_frac: float = ASYNC_OVERLAP_FRAC) -> Dict:
+    """Classify one service problem into the scorecard's regimes.
+
+    - ``"fanout"``     — ``fan_out >= fanout_degree`` outgoing endpoints
+      (the media/nginx shape PAPER.md measures at 0.36 vs exact);
+    - ``"async"``      — below the fan-out bar but with an incoming
+      overlap fraction at/above ``overlap_frac`` (interleaved requests:
+      candidate sets overlap, timing alone cannot separate them);
+    - ``"sequential"`` — neither: requests barely interleave and
+      assignment is near-deterministic.
+
+    Returns ``{"regime", "fan_out", "overlap_frac"}`` so scorecards can
+    report the raw features alongside the bucket.
+    """
+    assert len(in_span_partitions) == 1
+    _, in_spans = next(iter(in_span_partitions.items()))
+    fan_out = len(out_span_partitions)
+    frac = overlap_fraction(in_spans)
+    if fan_out >= fanout_degree:
+        regime = "fanout"
+    elif frac >= overlap_frac:
+        regime = "async"
+    else:
+        regime = "sequential"
+    return dict(regime=regime, fan_out=fan_out,
+                overlap_frac=round(frac, 4))
+
+
+def span_correctness(pred_assignments: Dict, true_assignments: Dict,
+                     in_span_partitions: Dict[str, List[Span]],
+                     ) -> Dict[SpanId, bool]:
+    """Per-span exact-match correctness — the per-span form of
+    :func:`accuracy_for_service` (same truth/normalization rules), keyed
+    by incoming span id. This is the calibration table's ground-truth
+    column: a span is correct only if EVERY endpoint matched."""
+    assert len(in_span_partitions) == 1
+    _, in_spans = next(iter(in_span_partitions.items()))
+    out: Dict[SpanId, bool] = {}
+    for in_span in in_spans:
+        correct = True
+        for ep in true_assignments:
+            ok, val = _normalize_pred(pred_assignments, ep, in_span.GetId())
+            correct = correct and ok and \
+                val == _truth(true_assignments, ep, in_span.GetId())
+        out[in_span.GetId()] = correct
+    return out
+
+
+def accuracy_by_confidence_decile(
+    confidence: Dict[SpanId, float],
+    correct: Dict[SpanId, bool],
+    nbins: int = 10,
+) -> List[Dict]:
+    """Exact-match accuracy bucketed by the solver's OWN confidence.
+
+    Spans are sorted by (confidence, id) and split into ``nbins``
+    near-equal contiguous buckets (deciles by default); each row carries
+    the bucket's confidence range, population, and accuracy. Sorting —
+    rather than fixed value edges — keeps every bucket populated even
+    though the base-tier score is discrete-valued.
+
+    The table is the calibration evidence: confidence *predicts*
+    correctness exactly when accuracy is (tolerantly) non-decreasing
+    over the rows (:func:`calibration_monotone`).
+    """
+    sids = [sid for sid in confidence if sid in correct]
+    sids.sort(key=lambda sid: (confidence[sid], repr(sid)))
+    n = len(sids)
+    table: List[Dict] = []
+    if n == 0:
+        return table
+    for b in range(nbins):
+        lo = n * b // nbins
+        hi = n * (b + 1) // nbins
+        chunk = sids[lo:hi]
+        if not chunk:
+            continue
+        accs = [correct[sid] for sid in chunk]
+        table.append(dict(
+            decile=b + 1,
+            conf_lo=round(confidence[chunk[0]], 4),
+            conf_hi=round(confidence[chunk[-1]], 4),
+            n=len(chunk),
+            accuracy=round(sum(accs) / len(accs), 4),
+        ))
+    return table
+
+
+def calibration_monotone(table: Sequence[Dict],
+                         tol: float = 0.05) -> Tuple[bool, List[str]]:
+    """Monotone-ish check over a decile table: every row's accuracy must
+    be at least the running maximum of earlier rows minus a slack of
+    ``tol`` plus one binomial standard error of the difference — deciles
+    hold only n/10 spans each, so two buckets at the same true accuracy
+    routinely differ by ~sqrt(p(1-p)/n), and a fixed tolerance would
+    flap on exactly the corpora small enough for CI. A REAL inversion
+    (confidently wrong at scale) still fails: the noise term vanishes as
+    bucket populations grow. Returns ``(ok, violations)`` with
+    human-readable violation strings for the warn path."""
+    import math
+
+    ok = True
+    violations: List[str] = []
+    run_max: Optional[float] = None
+    run_row = 0
+    run_n = 1
+    for row in table:
+        acc, n = row["accuracy"], max(1, row["n"])
+        if run_max is not None:
+            noise = math.sqrt(run_max * (1.0 - run_max) / run_n
+                              + acc * (1.0 - acc) / n)
+            if acc < run_max - tol - noise:
+                ok = False
+                violations.append(
+                    "decile %d accuracy %.3f < decile %d accuracy %.3f "
+                    "- tol %.2f - noise %.3f"
+                    % (row["decile"], acc, run_row, run_max, tol, noise))
+        if run_max is None or acc > run_max:
+            run_max, run_row, run_n = acc, row["decile"], n
+    return ok, violations
 
 
 def construct_end_to_end_traces(

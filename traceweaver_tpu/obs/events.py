@@ -143,7 +143,9 @@ def tail_main(argv: List[str]) -> int:
         print(f"events: no such file: {args.path}", file=sys.stderr)
         return 2
 
-    def emit_line(raw: str) -> None:
+    def emit_line(raw) -> None:
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8", errors="replace")
         raw = raw.strip()
         if not raw:
             return
@@ -159,18 +161,58 @@ def tail_main(argv: List[str]) -> int:
             return
         print(_fmt_record(dict(rec)))
 
-    with open(args.path, "r", encoding="utf-8") as f:
+    # binary mode: the follow loop does byte-offset arithmetic (seek /
+    # pread anchors), which text-mode tell() cookies cannot support
+    with open(args.path, "rb") as f:
         lines = f.readlines()
         for raw in (lines[-args.n:] if args.n else lines):
             emit_line(raw)
         if not args.follow:
             return 0
+        # rotation/truncate splice (TraceSink/EventLog semantics: the
+        # checkpoint/resume path truncates back to a recorded offset and
+        # immediately re-appends). Two detectors, both needed:
+        #  - size < offset: plain truncation caught before regrowth;
+        #  - the ANCHOR: the last line read, re-verified by pread at its
+        #    recorded offset on every idle tick. A truncate+reappend that
+        #    regrows past the follower's offset between polls leaves
+        #    size >= offset — only the rewritten bytes under the anchor
+        #    betray the splice. On mismatch, rewind to the anchor (the
+        #    earliest rewritten point the follower can prove) and
+        #    re-read: re-emitted records print and the follow never
+        #    sticks at a stale offset.
+        anchor_pos, anchor_bytes = 0, b""
+        if lines and lines[-1].endswith(b"\n"):
+            # seed the anchor from the initial dump's last record, so a
+            # splice that lands before the first live read is caught too
+            anchor_bytes = lines[-1]
+            anchor_pos = f.tell() - len(anchor_bytes)
         try:
             while True:
+                if anchor_bytes:
+                    # verify BEFORE consuming: a splice that already
+                    # regrew past our offset would otherwise hand us a
+                    # mid-record tail to read (and re-anchor on) first
+                    cur = os.pread(f.fileno(), len(anchor_bytes),
+                                   anchor_pos)
+                    if cur != anchor_bytes:
+                        f.seek(anchor_pos)
+                        anchor_pos, anchor_bytes = 0, b""
+                        continue
+                pos = f.tell()
                 raw = f.readline()
-                if raw:
+                if raw.endswith(b"\n"):
                     emit_line(raw)
-                else:
-                    time.sleep(0.2)
+                    anchor_pos, anchor_bytes = pos, raw
+                    continue
+                f.seek(pos)  # partial line: re-read once it completes
+                try:
+                    size = os.path.getsize(args.path)
+                except OSError:
+                    size = None
+                if size is not None and size < pos:
+                    f.seek(size)
+                    anchor_pos, anchor_bytes = 0, b""
+                time.sleep(0.2)
         except KeyboardInterrupt:
             return 0

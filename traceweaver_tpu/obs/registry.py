@@ -44,6 +44,24 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
+#: child key of a family's cardinality-overflow series (rendered as
+#: ``{overflow="1"}``): once a family holds ``TW_METRICS_MAX_SERIES``
+#: distinct label-value sets, updates for NEW sets collapse into this
+#: one counted series instead of growing the registry unbounded — the
+#: many-tenant protection (docs/OBSERVABILITY.md "Quality telemetry").
+OVERFLOW_KEY = ("__overflow__",)
+
+
+def _max_series() -> int:
+    """The per-family series cap (``TW_METRICS_MAX_SERIES``), read at
+    new-series-admission time only — the hot inc path on an existing
+    series never touches the environment. Imported lazily: the knob
+    registry lives under ``runtime/`` and this module must stay
+    import-light for the lint/events CLI fast paths."""
+    from traceweaver_tpu.runtime import knobs
+
+    return knobs.get_int("TW_METRICS_MAX_SERIES")
+
 #: default histogram buckets (seconds-flavored: 1 ms .. 60 s, then +Inf)
 DEFAULT_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0)
 
@@ -83,12 +101,32 @@ class _Family:
                 f"got {tuple(sorted(labelkw))}")
         return tuple(str(labelkw[lab]) for lab in self.labels)
 
+    def _admit(self, key: Tuple[str, ...], table: Dict) -> Tuple[str, ...]:
+        """Cardinality guard (caller holds the lock): an update for a
+        label-value set the family already tracks passes through; a NEW
+        set is admitted only while the family holds fewer than
+        ``TW_METRICS_MAX_SERIES`` distinct sets, else it lands on the
+        single :data:`OVERFLOW_KEY` series — counted, never silently
+        dropped, and the registry stays bounded under many tenants."""
+        if key in table or not self.labels:
+            return key
+        n_real = len(table) - (1 if OVERFLOW_KEY in table else 0)
+        if n_real >= _max_series():
+            return OVERFLOW_KEY
+        return key
+
+    def _sample_labels(self, key: Tuple[str, ...]) -> Dict[str, str]:
+        if key == OVERFLOW_KEY:
+            return {"overflow": "1"}
+        return dict(zip(self.labels, key))
+
     def samples(self) -> List[Tuple[Dict[str, str], float]]:
         """``[(labels_dict, value)]`` snapshot, label-sorted (stable
-        exposition order)."""
+        exposition order; the overflow series, if any, rides along as
+        ``{overflow="1"}``)."""
         with self._lock:
             items = sorted(self._children.items())
-        return [(dict(zip(self.labels, key)), val) for key, val in items]
+        return [(self._sample_labels(key), val) for key, val in items]
 
 
 class Counter(_Family):
@@ -103,6 +141,7 @@ class Counter(_Family):
                 f"counter {self.name!r}: negative increment {value}")
         key = self._key(labels)
         with self._lock:
+            key = self._admit(key, self._children)
             self._children[key] = self._children.get(key, 0.0) + value
 
 
@@ -115,11 +154,13 @@ class Gauge(_Family):
     def set(self, value: float, **labels) -> None:
         key = self._key(labels)
         with self._lock:
+            key = self._admit(key, self._children)
             self._children[key] = float(value)
 
     def set_max(self, value: float, **labels) -> None:
         key = self._key(labels)
         with self._lock:
+            key = self._admit(key, self._children)
             self._children[key] = max(self._children.get(key, float(value)),
                                       float(value))
 
@@ -146,6 +187,7 @@ class Histogram(_Family):
         key = self._key(labels)
         v = float(value)
         with self._lock:
+            key = self._admit(key, self._hchildren)
             child = self._hchildren.get(key)
             if child is None:
                 child = [0.0] * (len(self.buckets) + 2)
@@ -164,7 +206,7 @@ class Histogram(_Family):
         with self._lock:
             items = sorted(self._hchildren.items())
         for key, child in items:
-            base = dict(zip(self.labels, key))
+            base = self._sample_labels(key)
             for i, bound in enumerate(self.buckets):
                 out.append(({**base, "le": _fmt_bound(bound),
                              "__name__": self.name + "_bucket"}, child[i]))

@@ -91,6 +91,7 @@ def live_delay_culprit(
     records: List[dict],
     percentile: float = 0.95,
     after_us: Optional[float] = None,
+    min_confidence: Optional[float] = None,
 ) -> dict:
     """The live form of the query, over emitted-trace records.
 
@@ -102,12 +103,31 @@ def live_delay_culprit(
     time that makes "worst service" mean the service that *spent* the
     latency, not the frontend that merely contained it).
 
+    ``min_confidence`` excludes records whose ``tw.confidence`` summary
+    (attached by the serve ring / stream sink, obs/quality.py) falls
+    below the bar — culprit attribution over inferred traces is only as
+    good as the inference, so low-trust reconstructions can be kept out
+    of the bracket entirely. Records carrying NO confidence (pre-quality
+    emitters) pass the filter: they cannot be judged, and silently
+    dropping them would empty legacy brackets. The count of excluded
+    records ships as ``n_low_confidence_excluded``.
+
     Returns a counted zero-result (``empty: True``) for an empty bracket
     instead of crashing — the query surface must tolerate a tenant whose
     first window has not sealed yet.
     """
     usable = [r for r in records
               if r.get("spans") and r.get("complete", True)]
+    n_low_excluded = 0
+    if min_confidence is not None:
+        kept = []
+        for r in usable:
+            conf = (r.get("tw.confidence") or {}).get("conf")
+            if conf is not None and conf < min_confidence:
+                n_low_excluded += 1
+            else:
+                kept.append(r)
+        usable = kept
     ordered = sorted(usable, key=lambda r: float(r["e2e_us"]))
     cut = int(percentile * len(ordered))
     bracket = ordered[cut:]
@@ -137,6 +157,8 @@ def live_delay_culprit(
         "n_bracket": len(bracket),
         "percentile": percentile,
         "after_us": after_us,
+        "min_confidence": min_confidence,
+        "n_low_confidence_excluded": n_low_excluded,
         "worst_service": worst_svc,
         "worst_mean_self_us": (service_means[worst_svc]
                                if worst_svc is not None else 0.0),
@@ -224,6 +246,10 @@ def main(argv=None) -> int:
                         "emitted-trace records (the serve ring's format)")
     p.add_argument("--percentile", type=float, default=0.95)
     p.add_argument("--after_mus", type=float, default=None)
+    p.add_argument("--min_confidence", type=float, default=None,
+                   help="exclude records whose tw.confidence falls below "
+                        "this bar (JSONL/live form only) — culprit "
+                        "attribution without the garbage reconstructions")
     p.add_argument("--out", default=None, help="write query_latency pickle")
     args = p.parse_args(argv)
 
@@ -231,7 +257,11 @@ def main(argv=None) -> int:
         # offline form of the LIVE query: the paper's use case without a
         # running server, straight off an emitted-trace record file
         res = live_delay_culprit(load_trace_records(args.traces),
-                                 args.percentile, args.after_mus)
+                                 args.percentile, args.after_mus,
+                                 min_confidence=args.min_confidence)
+        if res["n_low_confidence_excluded"]:
+            print(f"(excluded {res['n_low_confidence_excluded']} "
+                  f"record(s) under confidence {args.min_confidence:g})")
         if res["empty"]:
             print(f"{args.traces}: empty bracket "
                   f"({res['n_traces']} traces, 0 in the "

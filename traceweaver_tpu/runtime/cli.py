@@ -389,6 +389,23 @@ def main(argv=None) -> int:
         from traceweaver_tpu.query.delay_culprit import main as query_main
 
         return query_main(argv[1:])
+    if argv and argv[0] == "scorecard":
+        # per-regime baseline scorecard + confidence calibration
+        # (docs/OBSERVABILITY.md "Quality telemetry"): all five baselines
+        # + the TPU solver over a synthetic labeled corpus — same
+        # backend discipline as `stream` (the solver leg needs JAX)
+        import jax
+
+        if knobs.get("TW_BACKEND") == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        from traceweaver_tpu.runtime.jax_cache import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
+        from traceweaver_tpu.metrics.scorecard import main as scorecard_main
+
+        return scorecard_main(argv[1:])
     if argv and argv[0] == "serve":
         # network service mode: same backend discipline as `stream`
         import jax

@@ -140,6 +140,28 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("TW_EVENTS", "str", None,
        help="structured JSONL event sink path (fault-ladder rungs, "
             "injections; tail with `cli events`)"),
+    # --- reconstruction-quality telemetry (obs/quality.py) ---------------
+    _k("TW_CONFIDENCE", "bool", True,
+       help="0 kills the quality telemetry path: no per-span confidence "
+            "reductions, no tw.confidence on emitted traces"),
+    _k("TW_CONF_DEVICE", "bool", False,
+       help="1 opts fleet dispatches into the confidence program variant "
+            "(quantized margin/entropy channels; one extra compile, then "
+            "zero recompiles — default programs stay byte-identical)"),
+    _k("TW_CONF_LOW", "float", 0.35, lo=0.0, hi=1.0,
+       help="low-confidence threshold: emitted traces at or below it "
+            "count in tw_low_confidence_traces_total and default the "
+            "low_confidence query"),
+    _k("TW_CONF_DRIFT_PSI", "float", 0.25, lo=0.0,
+       help="PSI alert threshold for the per-service confidence drift "
+            "gauge (>0.25 = shifted, the standard reading)"),
+    _k("TW_CONF_DRIFT_WINDOW", "int", 256, lo=8,
+       help="confidence-drift window: observations frozen as the "
+            "reference distribution and kept in the rolling current one"),
+    _k("TW_METRICS_MAX_SERIES", "int", 512, lo=1,
+       help="per-metric label-cardinality cap: past it, new label-value "
+            "sets collapse into one counted overflow=\"1\" series "
+            "instead of growing the registry unbounded"),
     # --- bench orchestration ---------------------------------------------
     _k("TW_BENCH_SUBSET", "int", 25, lo=1, help="subset spans per service"),
     _k("TW_BENCH_EXACT_ALARM", "int", 95, lo=1,

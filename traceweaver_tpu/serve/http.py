@@ -13,7 +13,8 @@ Endpoint reference (full table + curl quickstart in docs/SERVING.md)::
     GET  /api/v1/tenants                           tenant list
     GET  /api/v1/tenants/<id>/traces               recent trace ids (ring)
     GET  /api/v1/tenants/<id>/traces/<trace_id>    one reconstructed trace
-    GET  /api/v1/tenants/<id>/query/delay_culprit  ?percentile=&after_us=
+    GET  /api/v1/tenants/<id>/query/delay_culprit  ?percentile=&after_us=&min_conf=
+    GET  /api/v1/tenants/<id>/query/low_confidence ?limit=&max_conf=
     GET  /api/v1/tenants/<id>/stats                per-tenant ledger
     GET  /api/v1/stats                             service-wide ledger
     GET  /metrics                                  Prometheus exposition
@@ -180,9 +181,18 @@ class ServeHandler(BaseHTTPRequestHandler):
             elif sub == "/query/delay_culprit":
                 percentile = float(query.get("percentile", "0.95"))
                 after = query.get("after_us")
+                min_conf = query.get("min_conf")
                 self._reply(200, self.service.query_delay_culprit(
                     tenant_id, percentile,
-                    float(after) if after is not None else None))
+                    float(after) if after is not None else None,
+                    min_confidence=(float(min_conf)
+                                    if min_conf is not None else None)))
+            elif sub == "/query/low_confidence":
+                self._reply(200, self.service.query_low_confidence(
+                    tenant_id,
+                    limit=int(query.get("limit", "20")),
+                    max_conf=(float(query["max_conf"])
+                              if "max_conf" in query else None)))
             else:
                 self._error(404, f"no such endpoint: GET {sub}")
         except KeyError:

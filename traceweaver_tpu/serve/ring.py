@@ -68,7 +68,8 @@ class TraceRing:
 
 
 def build_trace_records(traces: Dict[str, List], live,
-                        window_k: int) -> List[dict]:
+                        window_k: int,
+                        confidence: Optional[Dict] = None) -> List[dict]:
     """Turn one emitted window's stitched traces into ring records.
 
     ``traces`` is the window's ``trace_id -> [span ids]`` map
@@ -78,7 +79,14 @@ def build_trace_records(traces: Dict[str, List], live,
     pruned from the live store are skipped and the record marked
     ``complete: False`` so the query layer can exclude partial traces the
     same way the reference excludes traces with unreconstructed hops.
+
+    ``confidence`` (``{span id: quality record}`` —
+    :mod:`traceweaver_tpu.obs.quality`) attaches each trace's
+    ``tw.confidence`` summary, which the low-confidence query sorts by
+    and the delay-culprit bracket can filter on.
     """
+    from traceweaver_tpu.obs import quality as _quality
+
     records = []
     for tid, span_ids in sorted(traces.items()):
         spans, missing = [], 0
@@ -106,7 +114,7 @@ def build_trace_records(traces: Dict[str, List], live,
         spans.sort(key=lambda s: (s["start_us"], s["sid"]))
         start = min(s["start_us"] for s in spans)
         end = max(s["start_us"] + s["dur_us"] for s in spans)
-        records.append(dict(
+        rec = dict(
             trace_id=tid,
             window=window_k,
             root_start_us=start,
@@ -114,5 +122,10 @@ def build_trace_records(traces: Dict[str, List], live,
             n_spans=len(spans),
             complete=missing == 0,
             spans=spans,
-        ))
+        )
+        if confidence:
+            tconf = _quality.trace_confidence(span_ids, confidence)
+            if tconf is not None:
+                rec["tw.confidence"] = tconf
+        records.append(rec)
     return records

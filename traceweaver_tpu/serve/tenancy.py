@@ -52,6 +52,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from traceweaver_tpu.ingest.jaeger import FIX_ROOT_OPS, parse_trace_payload
+from traceweaver_tpu.obs import quality as _quality
 from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.ops.precision import precision_from_env
 from traceweaver_tpu.query.delay_culprit import live_delay_culprit
@@ -281,7 +282,9 @@ class Tenant:
     def emit_results(self, results) -> None:
         """Emit one batch's solved windows: sink/dead-letter via the
         stream service's own emission path, plus ring insertion for the
-        live query surface and per-tenant quarantine accounting."""
+        live query surface and per-tenant quarantine accounting. Ring
+        records carry each trace's ``tw.confidence`` so the live query
+        surface can rank/exclude by reconstruction trust."""
         for res in results:
             self.svc._emit(res)
             if res.poisoned:
@@ -289,8 +292,12 @@ class Tenant:
                 self._bump("quarantined_services",
                            max(1, len(res.quarantined_services)))
                 continue
+            conf_by_span: Dict = {}
+            for recs in (res.confidence or {}).values():
+                conf_by_span.update(recs)
             for rec in build_trace_records(res.traces, self.svc.live,
-                                           res.buf.k):
+                                           res.buf.k,
+                                           confidence=conf_by_span):
                 self.ring.add(rec)
         self.svc.scheduler.solved_windows += len(results)
 
@@ -385,6 +392,8 @@ class Tenant:
             late_dropped=svc.windower.late_dropped,
             deadletter_windows=int(svc.stats.get("deadletter_windows", 0)),
             deadletter_spans=int(svc.stats.get("deadletter_spans", 0)),
+            low_confidence_traces=int(
+                svc.stats.get("low_confidence_traces", 0)),
             quarantined_windows=int(
                 self.counters.get("quarantined_windows", 0)),
             ring_traces=len(self.ring),
@@ -509,10 +518,13 @@ class TenantService:
             prepared.append((t, bufs, per_buf, t_owners, lo, len(items)))
         quarantined: List[int] = []
         outs: List = []
+        confidences: Optional[List] = (
+            [None] * len(items) if _quality.conf_enabled() else None)
         if items:
             outs = solve_fleet(items, stats=self.fleet_stats,
                                precision=self.precision,
-                               quarantined=quarantined)
+                               quarantined=quarantined,
+                               confidences=confidences)
         solve_s = time.perf_counter() - t0
         self._bump("shared_solves")
         self._bump("tenant_batches", len(batches))
@@ -522,7 +534,9 @@ class TenantService:
             t.svc._bump("solve_s", share)
             results = t.svc.consume_batch_results(
                 bufs, per_buf, t_owners, outs[lo:hi],
-                [k - lo for k in quarantined if lo <= k < hi], share)
+                [k - lo for k in quarantined if lo <= k < hi], share,
+                confidences=(confidences[lo:hi]
+                             if confidences is not None else None))
             t.emit_results(results)
             n += len(bufs)
         return n
@@ -538,16 +552,20 @@ class TenantService:
         per_buf, items, owners = t.svc.prepare_batch_items(bufs, tenant=t.id)
         quarantined: List[int] = []
         outs: List = []
+        confidences: Optional[List] = (
+            [None] * len(items) if _quality.conf_enabled() else None)
         if items:
             with faults.override_plan(t.fault_plan()):
                 outs = solve_fleet(items, stats=t.fleet_stats,
                                    precision=self.precision,
-                                   quarantined=quarantined)
+                                   quarantined=quarantined,
+                                   confidences=confidences)
         solve_s = time.perf_counter() - t0
         t.svc._bump("solve_s", solve_s)
         self._bump("isolated_solves")
         results = t.svc.consume_batch_results(bufs, per_buf, owners, outs,
-                                              quarantined, solve_s)
+                                              quarantined, solve_s,
+                                              confidences=confidences)
         t.emit_results(results)
         return len(bufs)
 
@@ -612,11 +630,42 @@ class TenantService:
 
     # -- query surface ----------------------------------------------------
     def query_delay_culprit(self, tenant_id: str, percentile: float = 0.95,
-                            after_us: Optional[float] = None) -> Dict:
+                            after_us: Optional[float] = None,
+                            min_confidence: Optional[float] = None) -> Dict:
         with self._lock:
             t = self.tenant(tenant_id, create=False)
             return live_delay_culprit(t.ring.records(), percentile,
-                                      after_us)
+                                      after_us,
+                                      min_confidence=min_confidence)
+
+    def query_low_confidence(self, tenant_id: str, limit: int = 20,
+                             max_conf: Optional[float] = None) -> Dict:
+        """The ring's least-trusted reconstructions, ascending by
+        confidence (docs/OBSERVABILITY.md "Quality telemetry"): the
+        traces an operator should re-examine — or exclude from culprit
+        attribution — first. ``max_conf`` defaults to ``TW_CONF_LOW``."""
+        if max_conf is None:
+            max_conf = _quality.low_threshold()
+        with self._lock:
+            t = self.tenant(tenant_id, create=False)
+            records = t.ring.records()
+        scored = [r for r in records if r.get("tw.confidence")]
+        scored.sort(key=lambda r: (r["tw.confidence"]["conf"],
+                                   r["trace_id"]))
+        low = [r for r in scored if r["tw.confidence"]["conf"] <= max_conf]
+        return dict(
+            n_traces=len(records),
+            n_scored=len(scored),
+            n_low=len(low),
+            max_conf=max_conf,
+            traces=[dict(trace_id=r["trace_id"],
+                         confidence=r["tw.confidence"]["conf"],
+                         mean_confidence=r["tw.confidence"].get("mean"),
+                         window=r.get("window"),
+                         e2e_us=r.get("e2e_us"),
+                         n_spans=r.get("n_spans"))
+                    for r in low[:max(0, int(limit))]],
+        )
 
     def trace_ids(self, tenant_id: str) -> List[str]:
         with self._lock:
@@ -632,7 +681,8 @@ class TenantService:
         "backlog", "solved_windows", "shed_spilled",
         "shed_dropped_windows", "shed_dropped_spans", "late_rerouted",
         "late_dropped", "deadletter_windows", "deadletter_spans",
-        "quarantined_windows", "ring_traces", "ring_evicted")
+        "low_confidence_traces", "quarantined_windows", "ring_traces",
+        "ring_evicted")
 
     def metrics_families(self) -> List:
         """Collector-style families for ``GET /metrics``

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from traceweaver_tpu.obs import quality as _quality
 from traceweaver_tpu.obs import selftrace as _selftrace
 from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.ops.precision import precision_from_env
@@ -147,6 +148,9 @@ class WindowResult:
     poisoned: bool = False
     poison_reason: str = ""
     quarantined_services: Tuple[str, ...] = ()
+    # per-span reconstruction-quality records (obs/quality.py):
+    # svc -> {in span id: {conf, not_best, cands, support, ...}}
+    confidence: Optional[Dict[str, Dict]] = None
 
 
 def _sid(span_id) -> List[str]:
@@ -188,6 +192,12 @@ class StreamingReconstructor:
         # "<prefix><window k>"; the serve layer sets "<tenant>:" so one
         # tracer can hold many tenants' journeys apart
         self.trace_prefix = ""
+        # reconstruction-quality telemetry (obs/quality.py,
+        # docs/OBSERVABILITY.md "Quality telemetry"): per-service
+        # confidence-distribution drift watcher, ground-truth-free; the
+        # whole path is inert under TW_CONFIDENCE=0
+        self.drift = _quality.ConfidenceDrift() \
+            if _quality.conf_enabled() else None
         # score-path precision (TW_PRECISION, read at service start) —
         # labels every micro-batch/window line and rides the checkpoint
         # so a resume under a DIFFERENT precision is visible, not silent
@@ -310,6 +320,8 @@ class StreamingReconstructor:
         per_buf, items, owners = self.prepare_batch_items(bufs)
         outs = []
         quarantined: List[int] = []
+        confidences: List[Optional[Dict]] = (
+            [None] * len(items) if _quality.conf_enabled() else None)
         if items:
             from traceweaver_tpu.runtime.jax_cache import (
                 compile_counters,
@@ -321,7 +333,8 @@ class StreamingReconstructor:
                                all_processes=self.live.all_processes,
                                stats=self.fleet_stats,
                                precision=self.precision,
-                               quarantined=quarantined)
+                               quarantined=quarantined,
+                               confidences=confidences)
             delta = counters_delta(counters_before)
             self._bump("micro_batches")
             # per-dispatch compile/cache visibility: a warm stream runs at
@@ -340,12 +353,14 @@ class StreamingReconstructor:
         self._bump("solve_s", solve_s)
         _OBS_SOLVE_S.observe(solve_s)
         return self.consume_batch_results(bufs, per_buf, owners, outs,
-                                          quarantined, solve_s)
+                                          quarantined, solve_s,
+                                          confidences=confidences)
 
     def consume_batch_results(self, bufs: List[WindowBuffer], per_buf,
                               owners: List[int], outs,
                               quarantined: List[int],
-                              solve_s: float) -> List[WindowResult]:
+                              solve_s: float,
+                              confidences=None) -> List[WindowResult]:
         """Decode one micro-batch's fleet results into
         :class:`WindowResult`\\ s (the second half of :meth:`_solve_batch`,
         split out for the serve layer's shared multi-tenant dispatches:
@@ -367,6 +382,7 @@ class StreamingReconstructor:
         for buf, probs, buf_outs, buf_idx in zip(bufs, per_buf, by_buf_outs,
                                                  by_buf_idx):
             assignments: Dict[str, Dict[str, Dict]] = {}
+            conf_by_svc: Dict[str, Dict] = {}
             n_rows = 0
             quarantined_svcs = tuple(
                 wp.service for wp, idx in zip(probs, buf_idx) if idx in qset)
@@ -374,6 +390,8 @@ class StreamingReconstructor:
                 amap = out[0]
                 assignments[wp.service] = amap
                 n_rows += len(wp.in_spans)
+                if confidences is not None and confidences[idx]:
+                    conf_by_svc[wp.service] = confidences[idx]
                 if idx in qset:
                     # a quarantined item's all-NA result must not feed
                     # the carried statistics or the grader — the window
@@ -401,7 +419,8 @@ class StreamingReconstructor:
                 poison_reason=("quarantined service(s): %s"
                                % ", ".join(quarantined_svcs)
                                if poisoned else ""),
-                quarantined_services=quarantined_svcs))
+                quarantined_services=quarantined_svcs,
+                confidence=conf_by_svc or None))
         return results
 
     def _poison_batch(self, bufs: List[WindowBuffer],
@@ -512,11 +531,55 @@ class StreamingReconstructor:
             print("[stream] win=%d DEAD-LETTERED spans=%d owned=%d (%s)"
                   % (buf.k, buf.n_spans, buf.n_owned, res.poison_reason))
 
+    def _conf_tenant(self) -> str:
+        """Tenant label of the quality metrics: the serve layer's tenant
+        id (the trace prefix it installs), "default" on the
+        single-tenant stream path."""
+        return self.trace_prefix.rstrip(":") or "default"
+
+    def window_confidence(self, res: WindowResult) -> Optional[Dict]:
+        """The window's ``tw.confidence`` payload: the per-window summary
+        plus one per-trace summary per stitched trace (min over the
+        trace's solved spans — a trace is right only if every span is).
+        None when the quality path is off or the solve produced no
+        records (docs/OBSERVABILITY.md "Quality telemetry")."""
+        if not res.confidence:
+            return None
+        merged: Dict = {}
+        for recs in res.confidence.values():
+            merged.update(recs)
+        return dict(
+            window=_quality.window_confidence_summary(merged),
+            traces={tid: _quality.trace_confidence(ids, merged)
+                    for tid, ids in sorted(res.traces.items())},
+        )
+
+    def _observe_confidence(self, res: WindowResult,
+                            conf: Optional[Dict]) -> None:
+        """Land one emitted window's quality telemetry: per-trace
+        histogram + low-confidence counters (per tenant) and the
+        per-service drift watcher."""
+        if conf is None:
+            return
+        tenant = self._conf_tenant()
+        n_low = 0
+        for tconf in conf["traces"].values():
+            if tconf is not None:
+                n_low += _quality.observe_trace(tconf["conf"], tenant)
+        if n_low:
+            self._bump("low_confidence_traces", n_low)
+        if self.drift is not None:
+            for svc, recs in sorted(res.confidence.items()):
+                self.drift.update(self.trace_prefix + svc,
+                                  [r["conf"] for r in recs.values()])
+
     def _emit(self, res: WindowResult) -> None:
         if res.poisoned:
             self._deadletter(res)
             return
         buf = res.buf
+        conf = self.window_confidence(res)
+        self._observe_confidence(res, conf)
         if self.sink is not None:
             services = {}
             for wp in res.problems:
@@ -538,6 +601,12 @@ class StreamingReconstructor:
                 traces={tid: [_sid(x) for x in ids]
                         for tid, ids in sorted(res.traces.items())},
             )
+            if conf is not None:
+                # every emitted trace carries its reconstruction
+                # confidence (obs/quality.py): consumers can exclude
+                # low-trust reconstructions the way the culprit query
+                # does, straight off the record
+                rec["tw.confidence"] = conf
             self.sink.write_line(json.dumps(rec, sort_keys=True))
         self.emitted_windows += 1
         tr = _selftrace.active()
@@ -618,6 +687,7 @@ class StreamingReconstructor:
             live=self.live,
             carried=self.carried,
             grader=self.grader,
+            conf_drift=self.drift.state() if self.drift else None,
             stats=self.stats,
             fleet_stats=self.fleet_stats,
             pending=list(self.scheduler.pending),
@@ -706,6 +776,11 @@ class StreamingReconstructor:
         svc.live = state["live"]
         svc.carried = state["carried"]
         svc.grader = state["grader"]
+        # pre-quality checkpoints carry no drift state: keep the fresh
+        # watcher (it re-freezes a reference from post-resume windows)
+        if state.get("conf_drift") and svc.drift is not None:
+            svc.drift = _quality.ConfidenceDrift.from_state(
+                state["conf_drift"])
         svc.stats = state["stats"]
         svc.fleet_stats = state["fleet_stats"]
         svc.scheduler.pending.extend(state["pending"])
@@ -829,6 +904,11 @@ class StreamingReconstructor:
             ),
             pruned_spans=self.live.n_pruned,
             watermark_max_skew_us=self.watermark.max_skew_us,
+            confidence=dict(
+                enabled=self.drift is not None,
+                low_traces=int(self.stats.get("low_confidence_traces", 0)),
+                drift_alerts=self.drift.alerts if self.drift else 0,
+            ),
             stats=dict(self.stats),
             fleet=dict(self.fleet_stats),
             pipeline=dict(
