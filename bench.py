@@ -443,6 +443,15 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         "pipeline_depth": int(stage_stats.get("pipeline_depth", 0)),
         "d2h_bytes_fetched": float(stage_stats.get("d2h_bytes_fetched", 0.0)),
         "d2h_bytes_flags": float(stage_stats.get("d2h_bytes_flags", 0.0)),
+        # H2D ledger split (TW_DEVCOLS, docs/PERF.md "Device-resident
+        # span columns"): host window tensors shipped vs resident-ring
+        # appends vs gather index arrays. The resident path must show
+        # ring+index traffic — these fields existing means a devcols run
+        # can never silently claim zero H2D
+        "h2d_bytes_shipped": float(stage_stats.get("h2d_bytes_shipped", 0.0)),
+        "h2d_bytes_ring": float(stage_stats.get("h2d_bytes_ring", 0.0)),
+        "h2d_bytes_index": float(stage_stats.get("h2d_bytes_index", 0.0)),
+        "devcols_fallbacks": int(stage_stats.get("devcols_fallbacks", 0)),
         # device-busy time / stage wall-clock: how much of the timed pass
         # the device spent executing (wait_s proxy here; replaced by the
         # measured device plane after profile enrichment when available)
@@ -755,6 +764,202 @@ def serve_fields(n_tenants: int, clean: dict, storm: dict) -> dict:
             storm.get("healthy_quarantined", 1) == 0
             and storm.get("healthy_shed", 1) == 0),
     }
+
+
+def continuous_fields(n_tenants: int, slo_ms: float, fixed: dict,
+                      cont: dict) -> dict:
+    """Continuous-batching leg ledgers -> report fields (unit-tested
+    like chaos_fields/serve_fields, tests/test_bench.py).
+
+    ``fixed``/``cont`` summarize one multi-tenant run each (fixed
+    threshold pump vs the continuous-batching dispatcher) over the SAME
+    heavy-tailed feed: total emitted ``spans``, ``wall_s``, the max
+    per-tenant seal→emit ``p99_max_ms``, and the dispatcher ledger. The
+    headline pair: sustained spans/s must beat the fixed pump AND the
+    worst tenant's p99 must sit inside the SLO — throughput bought by
+    starving a tenant is a regression, not a win. ``steady_compiles``
+    (backend compiles during the measured continuous pass, post-warmup)
+    must be zero: adaptive bucket picks ride a bounded pow2 lattice."""
+    def rate(spans, wall):
+        return round(spans / wall, 1) if wall and wall > 0 else None
+
+    fixed_rate = rate(fixed.get("spans", 0), fixed.get("wall_s", 0))
+    cont_rate = rate(cont.get("spans", 0), cont.get("wall_s", 0))
+    speedup = (round((cont_rate - fixed_rate) / fixed_rate * 100.0, 2)
+               if fixed_rate and cont_rate is not None else None)
+    p99 = cont.get("p99_max_ms")
+    dispatcher = cont.get("continuous") or {}
+    return {
+        "continuous_tenants": int(n_tenants),
+        "continuous_slo_p99_ms": float(slo_ms),
+        "continuous_spans_total": int(cont.get("spans", 0)),
+        "continuous_spans_per_s": cont_rate,
+        "continuous_spans_per_s_fixed_pump": fixed_rate,
+        "continuous_speedup_vs_fixed_pct": speedup,
+        "continuous_beats_fixed_pump": bool(
+            cont_rate is not None and fixed_rate is not None
+            and cont_rate > fixed_rate),
+        "continuous_seal_emit_p99_ms_max": p99,
+        "continuous_seal_emit_p99_ms_max_fixed": fixed.get("p99_max_ms"),
+        "continuous_p99_within_slo": (bool(p99 <= slo_ms)
+                                      if p99 is not None else None),
+        "continuous_dispatches": int(dispatcher.get("dispatches", 0)),
+        "continuous_urgent_dispatches": int(
+            dispatcher.get("urgent_dispatches", 0)),
+        "continuous_fleet_dispatches": int(cont.get("dispatches", 0)),
+        "continuous_fleet_dispatches_fixed": int(fixed.get("dispatches", 0)),
+        "continuous_steady_compiles": int(cont.get("steady_compiles", 0)),
+        "continuous_zero_steady_compiles": bool(
+            cont.get("steady_compiles", 0) == 0),
+        "continuous_h2d_bytes_ring": float(cont.get("h2d_bytes_ring", 0.0)),
+        "continuous_h2d_bytes_index": float(
+            cont.get("h2d_bytes_index", 0.0)),
+    }
+
+
+def run_continuous_leg(n_tenants: int) -> dict:
+    """bench.py --continuous N: the continuous-batching service leg.
+
+    N tenants post at HEAVY-TAILED rates (tenant i ingests ~24/(i+1)
+    traces per chunk — the hot head is ~24× the tail) into one
+    TenantService, measured twice after a compile warmup: once under
+    the fixed threshold pump (the PR 6 baseline) and once under the
+    continuous-batching dispatcher (event-driven admission, SLO-aware,
+    adaptive pow2 size classes — serve/continuous.py). Reports
+    sustained spans/s, per-tenant seal→emit p99 (max across tenants vs
+    TW_SERVE_SLO_P99_MS), and the steady-state compile count (must be
+    zero: the admission lattice is bounded).
+
+    SLO sizing: the budget must be configured relative to the
+    deployment's warm solve latency (the admission deadline subtracts
+    2x the solve EWMA) — on the CPU stand-in, where a warm fleet solve
+    runs ~1 s, set TW_SERVE_SLO_P99_MS to ~4x that; the default 2 s is
+    sized for device-scale solves."""
+    import jax
+
+    if _knobs.get("TW_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("TW_RETRY_BACKOFF_S", "0")
+    from traceweaver_tpu.runtime.jax_cache import (
+        compile_counters,
+        counters_delta,
+        enable_persistent_compilation_cache,
+    )
+    from traceweaver_tpu.serve import ServeConfig, TenantService
+
+    # persistent compile cache (ROADMAP item 2): admission-lattice
+    # coverage accumulates ACROSS leg invocations — a warm-cache rerun
+    # deserializes every program and the steady state stays at zero
+    # backend compiles from the first pass
+    enable_persistent_compilation_cache()
+
+    slo_ms = _knobs.get_float("TW_SERVE_SLO_P99_MS")
+
+    def tenant_rate(i):
+        return max(1, 24 // (i + 1))  # heavy-tailed: ~1/i decay
+
+    def run_mode(continuous):
+        """One LONG-LIVED service per mode: round 0 is the cold start
+        (first-contact windows run the two-pass EM and compile the
+        solve shapes — real, but startup, not steady state), warm
+        rounds repeat until a round compiles nothing, then the best of
+        two measured rounds is the steady-state number (the ingest
+        leg's min-of-two convention — one OS scheduling stall in a
+        2-3 s round otherwise dominates). A fresh service per pass
+        would conflate cold-start compiles with the steady-state
+        claim; production serving is a long-lived process."""
+        svc = TenantService(ServeConfig(
+            fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+            verbose=False, continuous=continuous, slo_p99_ms=slo_ms,
+            # batch-fill scales with tenancy: at N=100 a fill target of
+            # 8 windows means ~12 dispatches per chunk — admission
+            # overhead swamps the win. Same value feeds the fixed
+            # pump's threshold, so the comparison stays apples-to-
+            # apples at every N.
+            pump_windows=max(8, n_tenants // 4)))
+        round_no = [0]
+
+        def one_round():
+            # 6 paced chunks (fresh trace ids, advancing event time):
+            # chunks sit far apart in event time so earlier windows
+            # SEAL while later chunks ingest — the continuous
+            # dispatcher admits them live and its device work OVERLAPS
+            # the ingest wall (the fixed pump solves inline on the
+            # ingesting request's thread); the inter-chunk pacing
+            # models request gaps, a pause both modes pay but only the
+            # dispatcher can use
+            r0 = round_no[0]
+            round_no[0] += 1
+            before = compile_counters()
+            spans0 = sum(t["spans_emitted"]
+                         for t in svc.stats()["tenants"].values())
+            t0 = time.perf_counter()
+            for chunk in range(6):
+                for i in range(n_tenants):
+                    svc.ingest(f"tenant-{i:04d}", {"data": [
+                        _serve_trace(k, f"u{i:04d}r{r0}c{chunk}",
+                                     base_us=(r0 * 6 + chunk + 1) * 100e6)
+                        for k in range(tenant_rate(i))]})
+                time.sleep(0.25)
+            svc.flush()
+            if continuous:
+                deadline = time.time() + 120
+                while (svc.total_backlog() or svc.in_flight_windows()) \
+                        and time.time() < deadline:
+                    time.sleep(0.02)
+            wall = time.perf_counter() - t0
+            st = svc.stats()
+            tstats = st["tenants"]
+            p99s = [t["seal_emit_p99_ms"] for t in tstats.values()
+                    if t["seal_emit_p99_ms"]]
+            return dict(
+                spans=sum(t["spans_emitted"]
+                          for t in tstats.values()) - spans0,
+                wall_s=wall,
+                p99_max_ms=round(max(p99s), 2) if p99s else None,
+                dispatches=st["dispatch"]["fleet_dispatches"],
+                continuous=st.get("continuous"),
+                steady_compiles=counters_delta(
+                    before)["backend_compiles"],
+                h2d_bytes_ring=float(
+                    st.get("fleet", {}).get("h2d_bytes_ring", 0.0)),
+                h2d_bytes_index=float(
+                    st.get("fleet", {}).get("h2d_bytes_index", 0.0)),
+            )
+
+        one_round()  # cold start: first-contact EM + compiles, untimed
+        for _ in range(3):  # warm until a whole round compiles nothing
+            if one_round()["steady_compiles"] == 0:
+                break
+        # grade the SLO over the steady state: cold-start compile
+        # stalls sit in the rolling latency window otherwise
+        svc.reset_latency_window()
+        best = max((one_round() for _ in range(2)),
+                   key=lambda r: r["spans"] / max(r["wall_s"], 1e-9))
+        svc.drain()
+        return best
+
+    log(f"continuous leg: {n_tenants} tenants, fixed-pump service "
+        "(cold start + warm rounds, best-of-two measured)")
+    fixed = run_mode(False)
+    log(f"continuous leg: fixed {fixed['spans']} spans in "
+        f"{fixed['wall_s']:.1f}s (p99 {fixed['p99_max_ms']} ms); "
+        "continuous service")
+    cont = run_mode(True)
+    report = continuous_fields(n_tenants, slo_ms, fixed, cont)
+    report["mode"] = "continuous"
+    log("continuous leg: %s spans/s vs %s fixed (%s%%), p99 %s ms vs "
+        "SLO %.0f ms (within=%s), steady compiles %d"
+        % (report["continuous_spans_per_s"],
+           report["continuous_spans_per_s_fixed_pump"],
+           report["continuous_speedup_vs_fixed_pct"],
+           report["continuous_seal_emit_p99_ms_max"], slo_ms,
+           report["continuous_p99_within_slo"],
+           report["continuous_steady_compiles"]))
+    if not report["continuous_zero_steady_compiles"]:
+        log("continuous leg: WARNING — steady-state continuous loop "
+            "recompiled; the admission bucket lattice leaked a shape")
+    return report
 
 
 def confidence_fields(conf_maps) -> dict:
@@ -1687,6 +1892,10 @@ def main() -> None:
         "pipeline_overlap_pct": solver.get("pipeline_overlap_pct"),
         "d2h_bytes_fetched": solver.get("d2h_bytes_fetched"),
         "d2h_bytes_flags": solver.get("d2h_bytes_flags"),
+        "h2d_bytes_shipped": solver.get("h2d_bytes_shipped"),
+        "h2d_bytes_ring": solver.get("h2d_bytes_ring"),
+        "h2d_bytes_index": solver.get("h2d_bytes_index"),
+        "devcols_fallbacks": solver.get("devcols_fallbacks"),
         "device_busy_s_measured": solver.get("device_busy_s_measured"),
         "profile_source": solver.get("profile_source"),
         "mfu_measured_pct": solver.get("mfu_measured_pct"),
@@ -1725,6 +1934,15 @@ if __name__ == "__main__":
                          "shed/quarantine counts, and the healthy-tenant "
                          "isolation delta under tenant 0's fault storm "
                          "(TW_BENCH_FAULTS, default dispatch:0.5)")
+    ap.add_argument("--continuous", type=int, nargs="?", const=100,
+                    default=None, metavar="N",
+                    help="standalone continuous-batching leg: N tenants "
+                         "at heavy-tailed rates through one "
+                         "TenantService, fixed-pump baseline vs the "
+                         "event-driven admission scheduler; reports "
+                         "sustained spans/s, per-tenant seal→emit p99 "
+                         "vs TW_SERVE_SLO_P99_MS, and the steady-state "
+                         "compile count (must be 0)")
     ap.add_argument("--scorecard", type=int, nargs="?", const=48,
                     default=None, metavar="N",
                     help="standalone per-regime scorecard leg: all five "
@@ -1748,6 +1966,14 @@ if __name__ == "__main__":
     if args.serve_tenants:
         serve_report = run_serve_leg(args.serve_tenants)
         line = json.dumps(serve_report)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        sys.exit(0)
+    if args.continuous:
+        continuous_report = run_continuous_leg(args.continuous)
+        line = json.dumps(continuous_report)
         if args.out:
             with open(args.out, "w") as f:
                 f.write(line + "\n")

@@ -592,6 +592,44 @@ def test_tw008_layout_module_and_unwatched_files_exempt():
 
 
 # ---------------------------------------------------------------------------
+# TW009 — device-resident column discipline
+# ---------------------------------------------------------------------------
+
+def test_tw009_bare_asarray_over_assembled_tensors_flagged():
+    findings, _ = lint("""
+        def dispatch(ring, idx):
+            outs = assemble_windows(ring.buf, ring.buf, idx, idx, idx, idx)
+            host = np.asarray(outs[0])
+            return host
+    """, path=FLEET)
+    assert "TW009" in rules_of(findings)
+
+
+def test_tw009_ring_buffer_attribute_is_resident():
+    findings, _ = lint("""
+        def peek(ring):
+            buf = ring.buf
+            return np.asarray(buf)
+    """, path="traceweaver_tpu/ops/devcols.py")
+    assert "TW009" in rules_of(findings)
+
+
+def test_tw009_ledgered_fetch_and_unwatched_files_clean():
+    # fetch_resident is THE ledgered materialization: launders taint
+    findings, _ = lint("""
+        def grab(ring):
+            return fetch_resident(ring.buf)
+    """, path="traceweaver_tpu/ops/devcols.py")
+    assert [f for f in findings if f.rule == "TW009"] == []
+    # outside the hot modules the rule does not apply
+    findings, _ = lint("""
+        def peek(ring):
+            return np.asarray(ring.buf)
+    """, path="traceweaver_tpu/parallel/mesh.py")
+    assert [f for f in findings if f.rule == "TW009"] == []
+
+
+# ---------------------------------------------------------------------------
 # registry mirrors + TW002 regressions (the two unfrozen knobs)
 # ---------------------------------------------------------------------------
 

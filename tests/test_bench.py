@@ -261,6 +261,45 @@ def test_serve_fields_ledger_and_isolation_delta(bench):
     assert empty["serve_isolation_delta_pct"] is None
 
 
+def test_continuous_fields_slo_and_throughput_verdicts(bench):
+    """The --continuous leg's report builder: fixed-pump vs continuous
+    run summaries -> the continuous_* field set, with the two headline
+    verdicts (beats the fixed pump on spans/s; worst-tenant p99 inside
+    the SLO) and the zero-steady-compiles flag."""
+    fixed = dict(spans=4000, wall_s=4.0, p99_max_ms=900.0, dispatches=6)
+    cont = dict(spans=4000, wall_s=2.0, p99_max_ms=750.0, dispatches=9,
+                steady_compiles=0, h2d_bytes_ring=1234.0,
+                h2d_bytes_index=5678.0,
+                continuous=dict(dispatches=7, urgent_dispatches=2))
+    out = bench.continuous_fields(100, 2000.0, fixed, cont)
+    assert out["continuous_tenants"] == 100
+    assert out["continuous_slo_p99_ms"] == 2000.0
+    assert out["continuous_spans_per_s"] == 2000.0
+    assert out["continuous_spans_per_s_fixed_pump"] == 1000.0
+    assert out["continuous_speedup_vs_fixed_pct"] == 100.0
+    assert out["continuous_beats_fixed_pump"] is True
+    assert out["continuous_seal_emit_p99_ms_max"] == 750.0
+    assert out["continuous_seal_emit_p99_ms_max_fixed"] == 900.0
+    assert out["continuous_p99_within_slo"] is True
+    assert out["continuous_dispatches"] == 7
+    assert out["continuous_urgent_dispatches"] == 2
+    assert out["continuous_steady_compiles"] == 0
+    assert out["continuous_zero_steady_compiles"] is True
+    assert out["continuous_h2d_bytes_ring"] == 1234.0
+    assert out["continuous_h2d_bytes_index"] == 5678.0
+    # an SLO breach and a recompiling steady state flip the verdicts
+    slow = bench.continuous_fields(
+        100, 2000.0, fixed,
+        dict(cont, p99_max_ms=2500.0, steady_compiles=3))
+    assert slow["continuous_p99_within_slo"] is False
+    assert slow["continuous_zero_steady_compiles"] is False
+    # empty/zero inputs degrade to None rates, never divide-by-zero
+    empty = bench.continuous_fields(0, 2000.0, {}, {})
+    assert empty["continuous_spans_per_s"] is None
+    assert empty["continuous_speedup_vs_fixed_pct"] is None
+    assert empty["continuous_p99_within_slo"] is None
+
+
 def test_ingest_fields_ledger_and_ratio(bench):
     """The --ingest-only leg's report builder: pack timings under both
     TW_COLUMNAR settings -> the pack_* field set (spans/s, s/window, and

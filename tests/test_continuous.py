@@ -1,0 +1,333 @@
+"""Continuous-batching dispatch scheduler (serve/continuous.py).
+
+Contracts pinned here:
+
+- admission mechanics (unit level, deterministic): SLO-at-risk windows
+  jump the queue, dispatches are class-coherent (one pow2 size class per
+  dispatch — the zero-recompile lattice), round-robin fill across
+  tenants (a hot tenant cannot monopolize admission), scheduler
+  ready()/take() at-most-once semantics;
+- end-to-end: a continuous service emits exactly what the fixed pump
+  emits for the same feed; a lone sealed window below the fill target
+  still dispatches (SLO urgency — no starvation by batch-fill greed);
+- the fairness regression: one tenant at 100× the rate of the rest must
+  not push the slow tenants' seal→emit p99 past the SLO;
+- steady state: re-feeding identical shape classes through the
+  continuous loop costs ZERO backend compiles (the admission lattice is
+  bounded).
+
+Synthetic feeds, JAX_PLATFORMS=cpu — tier-1.
+"""
+
+import time
+
+import pytest
+
+import jax
+
+# import-order bootstrap: initializing the runtime package first avoids
+# the ingest<->runtime circular-import trap a bare serve-first import
+# trips (the ingest package is mid-initialization when runtime.executor
+# re-imports it)
+import traceweaver_tpu.runtime.knobs  # noqa: F401  (import order)
+
+from traceweaver_tpu.serve import ServeConfig, TenantService
+from traceweaver_tpu.serve.continuous import ContinuousDispatcher
+from traceweaver_tpu.stream.scheduler import MicroBatchScheduler
+from traceweaver_tpu.stream.window import WindowBuffer
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.devcols
+
+
+def _trace(i, prefix, base_us, n_spans=5):
+    """One synthetic frontend->search->geo Jaeger trace (fix=2)."""
+    T = base_us + i * 10_000.0
+    tid = f"{prefix}{i:04d}"
+
+    def span(sid, start, dur, op, refs, pid, kind):
+        return dict(traceID=tid, spanID=sid, startTime=start, duration=dur,
+                    operationName=op,
+                    references=[{"traceID": tid, "spanID": r}
+                                for r in refs],
+                    processID=pid,
+                    tags=[{"key": "span.kind", "value": kind}])
+
+    return dict(traceID=tid, spans=[
+        span("root", T, 1500.0, "HTTP GET /hotels", [], "p1", "server"),
+        span("c1", T + 200, 1100.0, "call-search", ["root"], "p1",
+             "client"),
+        span("s1", T + 300, 600.0, "search", ["c1"], "p2", "server"),
+        span("c2", T + 400, 300.0, "call-geo", ["s1"], "p2", "client"),
+        span("s2", T + 450, 200.0, "geo", ["c2"], "p3", "server"),
+    ], processes=dict(p1={"serviceName": "frontend"},
+                      p2={"serviceName": "search"},
+                      p3={"serviceName": "geo"}))
+
+
+def _cfg(**kw):
+    base = dict(fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+                verbose=False)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _feed(svc, n_tenants=3, chunks=3, traces=3, hot=None):
+    """Chunked feed: chunk k+1's event times advance the watermark past
+    chunk k, so earlier windows SEAL during ingest (the admission
+    loop's food). ``hot`` = (tenant index, multiplier)."""
+    for chunk in range(chunks):
+        for i in range(n_tenants):
+            n = traces * (hot[1] if hot and i == hot[0] else 1)
+            svc.ingest(f"t{i:02d}", {"data": [
+                _trace(k, f"u{i}c{chunk}", base_us=(chunk + 1) * 200e6)
+                for k in range(n)]})
+
+
+@pytest.fixture(scope="module")
+def warm_programs():
+    """Compile the feed's solve shapes once per module so SLO-bounded
+    assertions below measure scheduling, not first-compile walls."""
+    svc = TenantService(_cfg(pump_windows=10**9))
+    _feed(svc, n_tenants=3, chunks=3, traces=3)
+    svc.flush()
+    svc.drain()
+
+
+# ---------------------------------------------------------------------------
+# scheduler admission primitives
+# ---------------------------------------------------------------------------
+
+def _buf(k, n_spans, sealed_ago_s=0.0):
+    buf = WindowBuffer(k, float(k), float(k) + 1.0)
+    buf.spans = [None] * n_spans
+    buf.sealed_wall = time.monotonic() - sealed_ago_s
+    return buf
+
+
+def test_scheduler_ready_and_take():
+    sched = MicroBatchScheduler(lambda b: [None] * len(b), max_pending=2,
+                                spill_max=8)
+    bufs = [_buf(k, 4) for k in range(5)]
+    for b in bufs:
+        sched.offer(b)  # 2 pending, 3 spill
+    assert sched.ready() == bufs
+    taken = sched.take([bufs[3], bufs[1]])
+    assert taken == [bufs[3], bufs[1]]
+    assert sched.ready() == [bufs[0], bufs[2], bufs[4]]
+    # at-most-once: re-taking already-taken buffers is a no-op
+    assert sched.take([bufs[1]]) == []
+
+
+def _admission_service(n_tenants=3, **cfg_kw):
+    """A pump-mode service (no dispatcher thread) whose tenants we seed
+    with synthetic sealed windows, for deterministic _admit tests."""
+    svc = TenantService(_cfg(pump_windows=10**9, **cfg_kw))
+    for i in range(n_tenants):
+        svc.tenant(f"t{i:02d}")
+    return svc
+
+
+def test_admission_urgent_jumps_queue():
+    svc = _admission_service()
+    disp = ContinuousDispatcher(svc, slo_ms=10_000.0, fill_target=4)
+    # plenty of fresh windows on t00, one SLO-at-risk window on t02
+    for k in range(6):
+        svc.tenant("t00").svc.scheduler.offer(_buf(k, 8))
+    svc.tenant("t02").svc.scheduler.offer(_buf(99, 8, sealed_ago_s=60.0))
+    with svc._lock:
+        plan, wait = disp._admit()
+    assert plan is not None and wait == 0.0
+    assert plan[0][0].id == "t02", "SLO-at-risk window did not jump"
+    assert disp.urgent_dispatches == 1
+
+
+def test_admission_is_class_coherent_and_defers_outliers():
+    svc = _admission_service()
+    disp = ContinuousDispatcher(svc, slo_ms=60_000.0, fill_target=8)
+    for k in range(8):
+        svc.tenant("t00").svc.scheduler.offer(_buf(k, 7))       # class 8
+    svc.tenant("t01").svc.scheduler.offer(_buf(50, 1000))       # class 1024
+    with svc._lock:
+        plan, _ = disp._admit()
+    assert plan is not None
+    sizes = {disp._size_class(b) for _, bufs in plan for b in bufs}
+    assert sizes == {8}, f"dispatch mixed size classes: {sizes}"
+
+
+def test_admission_fill_round_robins_tenants():
+    svc = _admission_service(n_tenants=4)
+    disp = ContinuousDispatcher(svc, slo_ms=60_000.0, fill_target=4)
+    for k in range(16):
+        svc.tenant("t00").svc.scheduler.offer(_buf(k, 8))       # hot
+    for i in (1, 2, 3):
+        svc.tenant(f"t{i:02d}").svc.scheduler.offer(_buf(100 + i, 8))
+    # force a fill dispatch (enough ready windows; the deep backlog
+    # grows the fill limit adaptively — pow2, capped at 4x the target)
+    with svc._lock:
+        plan, _ = disp._admit()
+    assert plan is not None
+    tenants = [t.id for t, _ in plan]
+    # every slow tenant got a slot before the hot tenant filled the
+    # batch — round-robin, not greed
+    assert set(tenants) == {"t00", "t01", "t02", "t03"}, tenants
+    per = {t.id: len(b) for t, b in plan}
+    assert per["t01"] == per["t02"] == per["t03"] == 1
+    n = sum(per.values())
+    assert 4 <= n <= 16 and (n & (n - 1)) == 0, n  # pow2-quantized
+
+
+def test_admission_waits_when_below_fill_and_no_urgency():
+    svc = _admission_service()
+    disp = ContinuousDispatcher(svc, slo_ms=60_000.0, fill_target=8)
+    svc.tenant("t00").svc.scheduler.offer(_buf(0, 8))
+    with svc._lock:
+        plan, wait = disp._admit()
+    assert plan is None and 0.0 < wait <= 0.25
+
+
+# ---------------------------------------------------------------------------
+# end-to-end
+# ---------------------------------------------------------------------------
+
+def _totals(svc):
+    st = svc.stats()
+    return {tid: (t["emitted_windows"], t["spans_emitted"],
+                  t["traces_emitted"])
+            for tid, t in st["tenants"].items()}
+
+
+@pytest.mark.slow
+def test_continuous_emits_exactly_what_the_pump_emits(warm_programs):
+    fixed = TenantService(_cfg(pump_windows=4))
+    _feed(fixed)
+    fixed.flush()
+    want = _totals(fixed)
+    fixed.drain()
+
+    cont = TenantService(_cfg(continuous=True, slo_p99_ms=30_000.0,
+                              pump_windows=4))
+    _feed(cont)
+    cont.flush()
+    deadline = time.time() + 30
+    while (cont.total_backlog() or cont.in_flight_windows()) \
+            and time.time() < deadline:
+        time.sleep(0.02)
+    got = _totals(cont)
+    cont.drain()
+    assert got == want
+
+
+def test_lone_window_dispatches_without_flush(warm_programs):
+    """Batch-fill greed must not starve a lone sealed window: the SLO
+    deadline admits it even though the fill target is far away."""
+    svc = TenantService(_cfg(continuous=True, slo_p99_ms=500.0,
+                             pump_windows=64))
+    # chunk 2's ingest advances the watermark past chunk 1 -> one
+    # sealed window for t00, far below the fill target
+    svc.ingest("t00", {"data": [_trace(k, "a", base_us=200e6)
+                                for k in range(3)]})
+    svc.ingest("t00", {"data": [_trace(k, "b", base_us=400e6)
+                                for k in range(3)]})
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if svc.stats()["tenants"]["t00"]["emitted_windows"] >= 1:
+            break
+        time.sleep(0.05)
+    st = svc.stats()
+    svc.drain()
+    assert st["tenants"]["t00"]["emitted_windows"] >= 1, \
+        "lone sealed window never dispatched (fill-greed starvation)"
+    assert st["continuous"]["dispatches"] >= 1
+
+
+@pytest.mark.slow
+def test_hot_tenant_cannot_starve_slow_tenants(warm_programs):
+    """The fairness regression (ISSUE 11): one tenant at 100× the rate
+    of the rest; the slow tenants' seal→emit p99 must stay within the
+    SLO — round-robin fill + SLO queue-jumping bound their wait no
+    matter how deep the hot tenant's backlog runs."""
+    slo_ms = 20_000.0
+    svc = TenantService(_cfg(continuous=True, slo_p99_ms=slo_ms,
+                             pump_windows=4))
+    _feed(svc, n_tenants=4, chunks=3, traces=1, hot=(0, 100))
+    svc.flush()
+    deadline = time.time() + 60
+    while (svc.total_backlog() or svc.in_flight_windows()) \
+            and time.time() < deadline:
+        time.sleep(0.05)
+    st = svc.stats()
+    svc.drain()
+    for tid in ("t01", "t02", "t03"):
+        t = st["tenants"][tid]
+        assert t["emitted_windows"] >= 3, f"{tid} starved: {t}"
+        assert 0 < t["seal_emit_p99_ms"] <= slo_ms, \
+            f"{tid} p99 {t['seal_emit_p99_ms']}ms blew the {slo_ms}ms SLO"
+    # the hot tenant's windows all landed too (just not preferentially)
+    assert st["tenants"]["t00"]["emitted_windows"] >= 3
+
+
+def test_steady_state_costs_zero_backend_compiles():
+    """The bounded-lattice pin: after one continuous round has compiled
+    its shape classes, further rounds of the SAME classes — fresh trace
+    ids, different tenant mixes, trace counts varying within a pow2
+    class — must not compile anything. pow2 padding of the batch-row /
+    service / refit-row-map axes plus class-coherent admission is what
+    makes admission composition shape-invisible. Driven synchronously
+    through the dispatcher's own admission chunking
+    (``drain_backlog``) so the pin is deterministic — the threaded loop
+    runs the same code paths."""
+    from traceweaver_tpu.runtime.jax_cache import (
+        compile_counters,
+        counters_delta,
+    )
+
+    svc = TenantService(_cfg(pump_windows=10**9))  # no auto-pump
+    disp = ContinuousDispatcher(svc, slo_ms=30_000.0, fill_target=4)
+
+    def round_(prefix, tenants, counts):
+        # identical event-time geometry every round (same chunk bases,
+        # trace counts within one pow2 class); only ids/tenants differ
+        for chunk in range(3):
+            for i, tid in enumerate(tenants):
+                svc.ingest(tid, {"data": [
+                    _trace(k, f"{prefix}{i}c{chunk}",
+                           base_us=(chunk + 1) * 200e6)
+                    for k in range(counts[(chunk + i) % len(counts)])]})
+        for t in svc.tenants.values():
+            t.flush()
+        return disp.drain_backlog()
+
+    assert round_("w", ("t00", "t01"), (2, 3)) > 0
+    before = compile_counters()
+    solved = round_("x", ("t02", "t03"), (3, 2))
+    delta = counters_delta(before)
+    svc.drain()
+    assert solved > 0
+    assert delta["backend_compiles"] == 0, \
+        f"steady continuous loop compiled {delta['backend_compiles']} " \
+        "programs — the admission shape lattice leaked"
+
+
+# ---------------------------------------------------------------------------
+# stream-side SLO admission
+# ---------------------------------------------------------------------------
+
+def test_stream_slo_pressure_unit():
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+    )
+
+    cfg = StreamConfig(slo_p99_ms=1000.0, verbose=False)
+    svc = StreamingReconstructor(None, cfg)
+    assert svc._slo_pressure() is False        # nothing sealed
+    svc.scheduler.offer(_buf(0, 4, sealed_ago_s=0.0))
+    assert svc._slo_pressure() is False        # fresh window: wait
+    svc.scheduler.offer(_buf(1, 4, sealed_ago_s=5.0))
+    assert svc._slo_pressure() is True         # past half the budget
+    cfg_off = StreamConfig(verbose=False)
+    svc2 = StreamingReconstructor(None, cfg_off)
+    svc2.scheduler.offer(_buf(2, 4, sealed_ago_s=500.0))
+    assert svc2._slo_pressure() is False       # knob unset: inert

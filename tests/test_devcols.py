@@ -1,0 +1,351 @@
+"""Golden parity: device-resident span columns vs the host packer.
+
+The resident path (``TW_DEVCOLS=1``, ops/devcols.py) keeps span columns
+in device ring buffers and assembles window tensors by on-device
+gathers; ``TW_DEVCOLS=0`` restores the PR 7 host columnar packer
+verbatim. The contract here:
+
+- assembled window tensors BYTE-IDENTICAL to the host fill on
+  integral-µs timestamps, across randomized geometries, forced skips,
+  padded axes;
+- end-to-end ``solve_fleet`` results identical under both switch
+  positions, across compaction/pipeline on+off and both score
+  precisions;
+- the H2D byte ledger splits resident vs shipped honestly (ring appends
+  + index arrays on the resident path, full window tensors on the host
+  path) and a re-solve of resident spans ships ZERO new column bytes;
+- ineligible inputs (non-integral timestamps, ring-overflow partitions)
+  fall back to the host packer, counted, never approximated;
+- a second identical resident solve costs zero backend compiles.
+
+Everything synthetic, no datasets, JAX_PLATFORMS=cpu — tier-1.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import traceweaver_tpu.algorithms.weaver_tpu as wt
+from traceweaver_tpu.algorithms.fleet import FleetItem, solve_fleet
+from traceweaver_tpu.ops import devcols
+from traceweaver_tpu.runtime import knobs
+from traceweaver_tpu.spans import SKIP, Span
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.devcols
+
+
+@pytest.fixture(autouse=True)
+def _fresh_rings():
+    """Every test starts from an empty device-column store (rings are
+    process-global residency by design)."""
+    devcols.get_store().clear()
+    yield
+    devcols.get_store().clear()
+
+
+def _random_problem(seed=0, n_traces=50, eps=("A", "B"), burst=6,
+                    drop_every=0, integral=True):
+    """Randomized single-service partitions with INTEGRAL-µs timestamps
+    (the Jaeger wire convention the resident path admits); integral=False
+    mints fractional times to exercise the eligibility fallback."""
+    import networkx as nx
+
+    rng = np.random.default_rng(seed)
+    in_spans = []
+    out_spans = {ep: [] for ep in eps}
+    ta = {ep: {} for ep in eps}
+    t = 0.0
+    frac = 0.0 if integral else 0.25
+    for i in range(n_traces):
+        t += float(rng.integers(20, 60)) if i % burst else 4000.0
+        s_in = Span(f"t{i}", "in", t + frac, 350.0 + 30.0 * len(eps),
+                    "op", [], "svc", "server")
+        in_spans.append(s_in)
+        dropped = drop_every and (i % drop_every == 0)
+        prev = t + 8.0
+        for ep in eps:
+            if dropped:
+                ta[ep][s_in.GetId()] = SKIP
+                continue
+            start = prev + 12.0 + float(rng.integers(0, 6))
+            s_out = Span(f"t{i}", f"out-{ep}", start + frac, 40.0,
+                         f"op{ep}", [], "svc", "client")
+            out_spans[ep].append(s_out)
+            ta[ep][s_in.GetId()] = s_out.GetId()
+            prev = start + 40.0
+    dag = nx.DiGraph()
+    for a, b in zip(eps, eps[1:]):
+        dag.add_edge(a, b)
+    if len(eps) == 1:
+        dag.add_node(eps[0])
+    in_spans.sort(key=lambda s: (s.start_mus, s.end_mus))
+    for part in out_spans.values():
+        part.sort(key=lambda s: (s.start_mus, s.end_mus))
+    return in_spans, out_spans, list(eps), ta, dag
+
+
+def _items(n_services=2, method="MaxScoreBatchSubsetWithSkips",
+           drop_every=0, integral=True, seed0=0):
+    items = []
+    for k in range(n_services):
+        i, o, _eps, ta, dag = _random_problem(
+            seed=seed0 + k, eps=("A", "B") if k % 2 == 0 else ("A",),
+            drop_every=drop_every, integral=integral)
+        items.append(FleetItem(f"svc{k}", {"IN": i}, o, ta, dag,
+                               method=method))
+    return items
+
+
+def _solve(monkeypatch, devflag, items, **kw):
+    monkeypatch.setenv("TW_DEVCOLS", devflag)
+    devcols.get_store().clear()
+    stats = {}
+    res = solve_fleet(items, stats=stats, **kw)
+    key = [(r[0], r[1], r[2], r[3], r[4], r[5]) for r in res]
+    return key, stats
+
+
+# ---------------------------------------------------------------------------
+# assembled-tensor byte parity (the pack-level contract)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed,eps,drop", [
+    (0, ("A", "B"), 0),
+    (1, ("A", "B", "C"), 0),
+    (2, ("A",), 0),
+    (3, ("A", "B"), 5),     # skip budget > 0, forced-skip-capable
+])
+def test_assembled_tensors_byte_identical(monkeypatch, seed, eps, drop):
+    in_spans, out_parts, out_eps, ta, dag = _random_problem(
+        seed=seed, eps=eps, drop_every=drop)
+    plan = wt.plan_find_assignments({"IN": in_spans}, out_parts, out_eps,
+                                    dag, ta)
+    monkeypatch.setenv("TW_COLUMNAR", "1")
+    host = wt._pack_problem_columnar(
+        in_spans, out_parts, out_eps, plan["dists"], "IN", dag,
+        force_skip_ids=plan["force_skip_ids"])
+
+    in_cols = wt.in_columns(in_spans)
+    out_cols = wt.out_columns(out_parts, out_eps)
+    store = devcols.get_store()
+    ring_in = store.ring(None, "svc", "in")
+    ring_out = store.ring(None, "svc", "out")
+    in_slots = ring_in.resolve(in_cols)
+    out_slots = {ep: ring_out.resolve(out_cols[ep], endpoint=ep)
+                 for ep in out_eps}
+    assert in_slots is not None and all(
+        s is not None for s in out_slots.values())
+    dc = wt._pack_problem_devcols(
+        in_spans, out_parts, out_eps, plan["dists"], "IN", dag,
+        in_slots, out_slots, ring_in, ring_out,
+        force_skip_ids=plan["force_skip_ids"])
+
+    assert dc.windows == host.windows
+    assert dc.M == host.arrays["out_start"].shape[2]
+    b = dc.devcols
+    outs = devcols.assemble_windows(
+        ring_in.buf, ring_out.buf, b["in_idx"], b["out_idx"],
+        b["origin_in"], b["origin_out"])
+    names = ("in_start", "in_end", "in_valid",
+             "out_start", "out_end", "out_valid")
+    for name, dev in zip(names, outs):
+        got = devcols.fetch_resident(dev)
+        want = host.arrays[name]
+        assert got.dtype == want.dtype and got.shape == want.shape, name
+        assert got.tobytes() == want.tobytes(), \
+            f"{name} not byte-identical to the host fill"
+    # the host-shipped small tensors and the decode id maps match too
+    for name in ("skip_cap", "force_skip"):
+        assert dc.arrays[name].tobytes() == host.arrays[name].tobytes()
+    for e in range(len(out_eps)):
+        a, c = host.out_id_array(e), dc.out_id_array(e)
+        assert a.shape == c.shape and all(x == y for x, y in zip(a, c))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end solve parity across flow variants
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pipeline,compact", [
+    ("0", "0"), ("0", "1"), ("1", "0"), ("1", "1")])
+def test_solve_fleet_parity_flow_matrix(monkeypatch, pipeline, compact):
+    monkeypatch.setenv("TW_PIPELINE", pipeline)
+    monkeypatch.setenv("TW_COMPACT", compact)
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    host, _ = _solve(monkeypatch, "0", _items(3))
+    dev, st = _solve(monkeypatch, "1", _items(3))
+    assert st.get("h2d_bytes_ring", 0) > 0, "resident path did not run"
+    assert host == dev
+
+
+@pytest.mark.parametrize("precision", ["f32", "bf16"])
+def test_solve_fleet_parity_precisions(monkeypatch, precision):
+    host, _ = _solve(monkeypatch, "0", _items(2), precision=precision)
+    dev, st = _solve(monkeypatch, "1", _items(2), precision=precision)
+    assert st.get("h2d_bytes_ring", 0) > 0
+    assert host == dev
+
+
+def test_solve_fleet_parity_forced_skips(monkeypatch):
+    """The true-skips oracle's forced rows ride force_skip tensors —
+    still host-shipped under devcols, identical results."""
+    host, _ = _solve(monkeypatch, "0", _items(
+        2, method="MaxScoreBatchSubsetWithTrueSkips", drop_every=4))
+    dev, st = _solve(monkeypatch, "1", _items(
+        2, method="MaxScoreBatchSubsetWithTrueSkips", drop_every=4))
+    assert st.get("h2d_bytes_ring", 0) > 0
+    assert host == dev
+
+
+# ---------------------------------------------------------------------------
+# ledger + residency economics
+# ---------------------------------------------------------------------------
+
+def test_h2d_ledger_splits_resident_vs_shipped(monkeypatch):
+    host, s0 = _solve(monkeypatch, "0", _items(2))
+    dev, s1 = _solve(monkeypatch, "1", _items(2))
+    # host path: full window tensors shipped, no ring/index traffic
+    assert s0.get("h2d_bytes_shipped", 0) > 0
+    assert s0.get("h2d_bytes_ring", 0) == 0
+    assert s0.get("h2d_bytes_index", 0) == 0
+    # resident path: ring appends + index arrays, and the residual
+    # shipped tensors (skip/force) are a fraction of the host path's
+    assert s1.get("h2d_bytes_ring", 0) > 0
+    assert s1.get("h2d_bytes_index", 0) > 0
+    assert s1["h2d_bytes_shipped"] < s0["h2d_bytes_shipped"]
+
+
+def test_second_solve_ships_zero_column_bytes(monkeypatch):
+    """Residency is the point: re-solving spans already in the rings
+    appends nothing — only index arrays ship."""
+    monkeypatch.setenv("TW_DEVCOLS", "1")
+    devcols.get_store().clear()
+    items = _items(2)
+    s1, s2 = {}, {}
+    solve_fleet(_items(2), stats=s1)
+    solve_fleet(items, stats=s2)
+    assert s1.get("h2d_bytes_ring", 0) > 0
+    assert s2.get("h2d_bytes_ring", 0) == 0, \
+        "resident spans re-shipped on the second solve"
+    assert s2.get("h2d_bytes_index", 0) > 0
+
+
+def test_second_solve_zero_recompiles(monkeypatch):
+    from traceweaver_tpu.runtime.jax_cache import (
+        compile_counters,
+        counters_delta,
+    )
+
+    monkeypatch.setenv("TW_DEVCOLS", "1")
+    devcols.get_store().clear()
+    solve_fleet(_items(2), stats={})
+    before = compile_counters()
+    solve_fleet(_items(2), stats={})
+    delta = counters_delta(before)
+    assert delta["backend_compiles"] == 0, \
+        "identical resident solve recompiled"
+
+
+# ---------------------------------------------------------------------------
+# eligibility fallback
+# ---------------------------------------------------------------------------
+
+def test_fractional_timestamps_fall_back_counted(monkeypatch):
+    """Non-integral µs cannot ride the int32 rings bit-exactly: the
+    group falls back to the host packer, counted — and the results
+    still match the TW_DEVCOLS=0 reference exactly."""
+    host, _ = _solve(monkeypatch, "0", _items(2, integral=False))
+    dev, st = _solve(monkeypatch, "1", _items(2, integral=False))
+    assert st.get("devcols_fallbacks", 0) > 0
+    assert st.get("h2d_bytes_ring", 0) == 0
+    assert host == dev
+
+
+def test_oversized_partition_falls_back(monkeypatch):
+    """A partition larger than the ring capacity cannot be resident."""
+    monkeypatch.setenv("TW_DEVCOLS_RING", "1024")
+    host, _ = _solve(monkeypatch, "0", _items(1, seed0=7))
+    monkeypatch.setenv("TW_DEVCOLS_RING", "1024")
+    ring = devcols.ColumnRing("test", cap=16)
+    in_spans, out_parts, out_eps, ta, dag = _random_problem(seed=9,
+                                                            n_traces=40)
+    cols = wt.in_columns(in_spans)
+    assert ring.resolve(cols) is None  # > cap live spans
+
+
+# ---------------------------------------------------------------------------
+# ring mechanics
+# ---------------------------------------------------------------------------
+
+def _cols(times):
+    from traceweaver_tpu.spans import SpanArray
+
+    spans = [Span(f"r{i}", "s", float(t), 10.0, "op", [], "p", "server")
+             for i, t in enumerate(times)]
+    return SpanArray.from_spans(spans)
+
+
+def test_ring_eviction_and_reappend():
+    ring = devcols.ColumnRing("t", cap=8)
+    a = _cols([100, 200, 300, 400])
+    s1 = ring.resolve(a)
+    assert s1 is not None and len(set(s1.tolist())) == 4
+    # push enough NEW spans through to evict the first batch
+    ring.resolve(_cols([500, 600, 700, 800]))
+    ring.resolve(_cols([900, 1000, 1100, 1200]))
+    # the original spans were evicted: resolving them re-appends (new
+    # slots, correct values), never aliases stale slots
+    before = ring.appended_rows
+    s2 = ring.resolve(a)
+    assert s2 is not None
+    assert ring.appended_rows == before + 4
+    got = devcols.fetch_resident(ring.buf)
+    np.testing.assert_array_equal(got[s2, 0] + ring.epoch, a.start)
+
+
+def test_ring_id_collision_reappends():
+    """Same span ids with DIFFERENT times (another corpus reusing the
+    id space) must re-append, not alias the stale values."""
+    ring = devcols.ColumnRing("t", cap=64)
+    ring.resolve(_cols([100, 200, 300]))
+    b = _cols([1100, 1200, 1300])   # same ids r0..r2, shifted times
+    slots = ring.resolve(b)
+    got = devcols.fetch_resident(ring.buf)
+    np.testing.assert_array_equal(got[slots, 0] + ring.epoch, b.start)
+
+
+def test_resident_resolve_is_free():
+    ring = devcols.ColumnRing("t", cap=64)
+    a = _cols([100, 200, 300, 400, 500])
+    ring.resolve(a)
+    before_rows, before_bytes = ring.appended_rows, ring.appended_bytes
+    s2 = ring.resolve(a)
+    assert ring.appended_rows == before_rows
+    assert ring.appended_bytes == before_bytes
+    assert s2 is not None
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def test_knobs_registered():
+    for name in ("TW_DEVCOLS", "TW_DEVCOLS_RING", "TW_SERVE_SLO_P99_MS",
+                 "TW_SERVE_CONTINUOUS"):
+        assert name in knobs.REGISTRY, name
+    assert knobs.get_bool("TW_DEVCOLS") is True
+    assert knobs.get_int("TW_DEVCOLS_RING") >= 1 << 10
+
+
+def test_devcols_rides_only_the_columnar_path(monkeypatch):
+    """TW_COLUMNAR=0 (object packer) implies the host path even with
+    TW_DEVCOLS=1 — the rings are built FROM the SpanArray columns."""
+    monkeypatch.setenv("TW_COLUMNAR", "0")
+    dev, st = _solve(monkeypatch, "1", _items(2))
+    assert st.get("h2d_bytes_ring", 0) == 0
+    monkeypatch.setenv("TW_COLUMNAR", "1")
+    host, _ = _solve(monkeypatch, "0", _items(2))
+    assert dev == host

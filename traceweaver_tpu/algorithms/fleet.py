@@ -46,6 +46,7 @@ from traceweaver_tpu.algorithms.weaver_tpu import (
     DEFAULT_MAX_WINDOW,
     WeaverTPU,
     _bucket,
+    _pack_problem_devcols,
     candidate_ranges,
     columnar_enabled,
     in_columns,
@@ -59,6 +60,7 @@ from traceweaver_tpu.algorithms.weaver_tpu import (
     solve_em_fleet,
     solve_windows_fleet,
 )
+from traceweaver_tpu.ops import devcols as _devcols
 from traceweaver_tpu.obs import events as _events
 from traceweaver_tpu.obs import profile as _profile
 from traceweaver_tpu.obs import quality as _quality
@@ -100,6 +102,12 @@ def _fleet_budget_bytes() -> int:
 # window-axis keys of a packed fleet batch, dispatch argument order
 _BATCH_KEYS = ("in_start", "in_end", "in_valid", "out_start", "out_end",
                "out_valid", "skip_cap", "force_skip")
+
+# the only window-axis tensors that still ship H2D under the
+# device-resident path (TW_DEVCOLS): the per-window skip capacities and
+# forced-skip rows. Everything else is assembled on device from the
+# resident column rings (ops/devcols.py).
+_DEVCOLS_BATCH_KEYS = ("skip_cap", "force_skip")
 
 # per-problem param tables, dispatch argument order (after the batch keys)
 _TABLE_KEYS = ("pred_mask", "root_mask", "is_last",
@@ -689,9 +697,17 @@ def solve_fleet(
     # False keeps the dispatched programs byte-identical to the
     # pre-quality ones, and an enabled steady state recompiles nothing.
     conf_device = _quality.conf_device_enabled()
+    # device-resident span columns (TW_DEVCOLS, ops/devcols.py): window
+    # tensors become on-device gathers from per-service HBM rings, with
+    # only index arrays + skip/force tensors shipped per dispatch. Rides
+    # the columnar host path's SpanArray columns, single-device only
+    # (the mesh path re-places host tensors per shard); the flag travels
+    # in hypers_common so the supervisor's bisect re-packs inherit it.
     hypers_common = dict(epsilon=epsilon, n_sinkhorn=n_sinkhorn,
                          n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
-                         precision=precision, confidence=conf_device)
+                         precision=precision, confidence=conf_device,
+                         devcols=(_devcols.devcols_enabled()
+                                  and columnar_enabled() and mesh is None))
     itemsize = score_itemsize(precision)
     # supervisor context: what the degradation ladder needs to route a
     # failing singleton to the per-service host fallback, where it
@@ -1055,10 +1071,64 @@ def _solve_groups_pipelined(specs, solver, results, st, hypers_common,
         flow_pool.shutdown(wait=True)
 
 
+def _resolve_group_devcols(group, st: _Stats):
+    """Resolve every item of a dispatch group onto its device-resident
+    column rings (``TW_DEVCOLS``): per item, the in partition and each
+    endpoint's out slice map to live ring slots, appending only spans
+    not already resident (the H2D saving — ``h2d_bytes_ring`` counts
+    what actually shipped). Returns one
+    ``(in_slots, out_slots, ring_in, ring_out)`` tuple per item, or
+    None when ANY partition cannot ride the resident path
+    (non-integral timestamps, window origins outside the rings' int32
+    epoch span, partitions larger than a ring) — the whole group then
+    falls back to the host packer, counted in ``devcols_fallbacks``;
+    mixed-path groups would make the parity contract unauditable."""
+    store = _devcols.get_store()
+    resolved = []
+    for i, item, prep, windows, ranges, skip_caps, _, _ in group:
+        in_cols = prep.get("in_cols")
+        out_cols = prep.get("out_cols")
+        if in_cols is None or out_cols is None or not windows:
+            return None
+        ring_in = store.ring(item.tenant, item.svc, "in")
+        ring_out = store.ring(item.tenant, item.svc, "out")
+        scope = (item.tenant, item.svc)
+        in_slots = ring_in.resolve(in_cols, ledger=st.add, scope=scope)
+        if in_slots is None:
+            return None
+        out_slots = {}
+        for ep in prep["out_eps"]:
+            slots = ring_out.resolve(out_cols[ep], endpoint=ep,
+                                     ledger=st.add, scope=scope)
+            if slots is None:
+                return None
+            out_slots[ep] = slots
+        # window origins must be representable relative to BOTH rings'
+        # epochs (the assembly subtracts them on device in int32)
+        origins = in_cols.start[[lo for lo, _ in windows]]
+        for ring in (ring_in, ring_out):
+            if ring.epoch is None:
+                return None
+            rel = origins - ring.epoch
+            if np.any(np.abs(rel) >= _devcols._INT32_SPAN):
+                return None
+        resolved.append((in_slots, out_slots, ring_in, ring_out))
+    return resolved
+
+
 def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
     """Host packing of one shape-class group (pure NumPy — safe on the
     pipeline's pack thread): concatenated window tensors, stacked param
-    tables, the refit row maps, and the analytic op accounting."""
+    tables, the refit row maps, and the analytic op accounting.
+
+    Under ``TW_DEVCOLS`` the pack thread feeds INDEX ARRAYS, not
+    tensors: each item packs through
+    :func:`~traceweaver_tpu.algorithms.weaver_tpu._pack_problem_devcols`
+    (ring-slot maps over the resident device columns) and only the
+    skip/force tensors concatenate host-side; the dispatch assembles
+    the window tensors on device. A group whose partitions cannot ride
+    the resident path falls back to the host packer wholesale
+    (``devcols_fallbacks``)."""
     group = spec.group
     W_pad, M_pad, E_pad, bmax = spec.W_pad, spec.M_pad, spec.E_pad, spec.bmax
     n_passes = spec.n_passes
@@ -1078,23 +1148,52 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
                            if item.tenant is not None})
     tenant_of = {t: ti for ti, t in enumerate(tenant_table)}
     tenant_idx: List[int] = []
+    use_devcols = bool(hypers_common.get("devcols"))
+    devcols_items: List[Dict] = []
+    dc_resolved = None
+    if use_devcols:
+        dc_resolved = _resolve_group_devcols(group, st)
+        if dc_resolved is None:
+            st.add("devcols_fallbacks")
+            use_devcols = False
+    batch_keys = _DEVCOLS_BATCH_KEYS if use_devcols else _BATCH_KEYS
     for p, (i, item, prep, windows, ranges, skip_caps, _, _) in enumerate(group):
-        packed = pack_problem(
-            prep["in_spans"], item.out_span_partitions, prep["out_eps"],
-            prep["dists"], prep["in_ep"], item.dag,
-            force_skip_ids=prep["force_skip_ids"],
-            parallel=False, windows=windows,
-            pad_w=W_pad, pad_m=M_pad, pad_e=E_pad,
-            ranges=ranges, skip_caps=skip_caps,
-            in_cols=prep.get("in_cols"), out_cols=prep.get("out_cols"),
-        )
+        if use_devcols:
+            in_slots, out_slots, ring_in, ring_out = dc_resolved[p]
+            packed = _pack_problem_devcols(
+                prep["in_spans"], item.out_span_partitions, prep["out_eps"],
+                prep["dists"], prep["in_ep"], item.dag,
+                in_slots, out_slots, ring_in, ring_out,
+                force_skip_ids=prep["force_skip_ids"],
+                parallel=False, windows=windows,
+                pad_w=W_pad, pad_m=M_pad, pad_e=E_pad,
+                ranges=ranges, skip_caps=skip_caps,
+                in_cols=prep.get("in_cols"), out_cols=prep.get("out_cols"),
+            )
+        else:
+            packed = pack_problem(
+                prep["in_spans"], item.out_span_partitions, prep["out_eps"],
+                prep["dists"], prep["in_ep"], item.dag,
+                force_skip_ids=prep["force_skip_ids"],
+                parallel=False, windows=windows,
+                pad_w=W_pad, pad_m=M_pad, pad_e=E_pad,
+                ranges=ranges, skip_caps=skip_caps,
+                in_cols=prep.get("in_cols"), out_cols=prep.get("out_cols"),
+            )
         a = packed.arrays
         n_w = len(windows)
-        for key in _BATCH_KEYS:
+        for key in batch_keys:
             # drop pack_problem's power-of-two B padding: the fleet batch
             # is exact, and decode indexes out_ids by original row b which
             # is preserved under row slicing
             arrays_cat.setdefault(key, []).append(a[key][:n_w])
+        if use_devcols:
+            dc = packed.devcols
+            devcols_items.append(dict(
+                n_w=n_w, ring_in=dc["ring_in"], ring_out=dc["ring_out"],
+                in_idx=dc["in_idx"][:n_w], out_idx=dc["out_idx"][:n_w],
+                origin_in=dc["origin_in"][:n_w],
+                origin_out=dc["origin_out"][:n_w]))
         # keep the id maps consistent with the sliced row count
         # (_decode sizes its gather table from the assign rows it is given)
         packed.truncate_rows(n_w)
@@ -1162,7 +1261,8 @@ def _pack_group(spec: _GroupSpec, hypers_common, st: _Stats):
                 per_item_pack=per_item_pack, max_preds=_mp, max_succs=_ms,
                 tenant_table=tenant_table,
                 tenant_col=np.asarray(tenant_idx, dtype=np.int32),
-                trace_keys=trace_keys)
+                trace_keys=trace_keys, n_rows=n_windows_total,
+                devcols_items=devcols_items if use_devcols else None)
 
 
 def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
@@ -1197,6 +1297,15 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
     """
     batch, params, pidx = pg["batch"], pg["params"], pg["pidx"]
     window_rows, window_valid = pg["window_rows"], pg["window_valid"]
+    dc_items = pg.get("devcols_items")
+    assemble = (_make_assembler(dc_items, batch, st)
+                if dc_items is not None else None)
+    if assemble is not None:
+        # bounded compile lattice under continuous batching: the group's
+        # service-count and refit-row-map axes pad to pow2 like every
+        # other dispatch shape (all-invalid padding services)
+        params, window_rows, window_valid = _pad_tables_pow2(
+            params, window_rows, window_valid)
     # the host-side tenancy column rides the dispatch ticket so the
     # compacted flow can attribute straggler redispatches per tenant;
     # None whenever no item in the group is tenant-tagged (every
@@ -1221,7 +1330,7 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
                   max_preds=pg["max_preds"], max_succs=pg["max_succs"])
     warm = _compaction_warm()
     use_compact = (_compaction_on() and warm < n_sweeps
-                   and batch["in_start"].shape[0] > 1)
+                   and pg["n_rows"] > 1)
     if mesh is not None:
         # batch rows pad to the mesh size ON THE HOST and stay numpy here:
         # the compacted flow gathers redispatch rows from these host
@@ -1255,7 +1364,28 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
             window_valid, n_passes, n_sweeps, warm, hypers, st,
             mesh=mesh, flow_wait=flow_wait,
             tenant_col=tenant_col, tenant_table=tenant_table,
-            trace_keys=trace_keys)
+            trace_keys=trace_keys, assemble=assemble)
+    elif assemble is not None:
+        # device-resident path: window tensors are assembled on device
+        # from the rings; only index arrays + skip/force shipped. The
+        # batch-row axis pads to a power of two with all-invalid rows
+        # (decoded by nobody, converge instantly) so the continuous-
+        # batching scheduler's varying admission counts dispatch against
+        # a BOUNDED shape lattice — steady state mints zero compiles
+        # (tests/test_continuous.py pins it)
+        with _profile.annotate("tw:fleet:dispatch"):
+            pad_b = _bucket(pg["n_rows"], minimum=1) - pg["n_rows"]
+            common = assemble(None, pad_b) + (_pad_pidx(pidx, pad_b),)
+            if n_passes == 2:
+                out, _ = solve_em_fleet(
+                    *common, window_rows, window_valid, *_tables_of(params),
+                    n_sweeps=n_sweeps, **hypers,
+                )
+            else:
+                out, _ = solve_windows_fleet(
+                    *common, *_tables_of(params), n_sweeps=n_sweeps,
+                    **hypers,
+                )
     else:
         with _profile.annotate("tw:fleet:dispatch"):
             if mesh is not None:
@@ -1278,6 +1408,7 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
                 pidx = jax.device_put(
                     pidx,
                     NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
+            _bill_shipped(st, batch)
             common = tuple(batch[k] for k in _BATCH_KEYS) + (pidx,)
             if n_passes == 2:
                 out, _ = solve_em_fleet(
@@ -1303,9 +1434,110 @@ def _tables_of(params: Dict) -> Tuple:
     return tuple(params[k] for k in _TABLE_KEYS)
 
 
+def _pad_tables_pow2(params: Dict, window_rows: np.ndarray,
+                     window_valid: np.ndarray):
+    """Pow2-pad the per-service table axes of one packed group: the
+    stacked param tables' ``P`` axis (services in the group) and the
+    refit row map's ``Bmax`` axis (max windows per service). Under
+    continuous batching the admission scheduler hands the fleet
+    arbitrary tenant subsets, so P and Bmax vary per dispatch — without
+    padding, every distinct count is a fresh compiled program and the
+    steady state never stops compiling. Padding services follow the
+    all-invalid convention of ``pack_problem``'s ``pad_e`` endpoints:
+    false masks, zero weights, unit σ; no window row ever points at
+    them (only pow2 batch padding rows do, and those are all-invalid)."""
+    P, bmax = window_rows.shape
+    P_pad = _bucket(P, minimum=1)
+    bmax_pad = _bucket(bmax, minimum=1)
+    if P_pad == P and bmax_pad == bmax:
+        return params, window_rows, window_valid
+    out = {}
+    for k, a in params.items():
+        pad = np.zeros((P_pad - P,) + a.shape[1:], dtype=a.dtype)
+        if k.endswith("_sd"):
+            pad = np.ones_like(pad)
+        out[k] = np.concatenate([a, pad]) if P_pad > P else a
+    wr = np.zeros((P_pad, bmax_pad), dtype=window_rows.dtype)
+    wv = np.zeros((P_pad, bmax_pad), dtype=bool)
+    wr[:P, :bmax] = window_rows
+    wv[:P, :bmax] = window_valid
+    return out, wr, wv
+
+
+def _pad_pidx(pidx: np.ndarray, pad: int) -> np.ndarray:
+    """Zero-extend the param-index column for pow2 batch-row padding
+    (padding rows are all-invalid windows; the row-0 tables they point
+    at never see a valid span)."""
+    if not pad:
+        return pidx
+    return np.concatenate([pidx, np.zeros(pad, dtype=pidx.dtype)])
+
+
+def _bill_shipped(st: _Stats, arrs: Dict) -> None:
+    """H2D byte ledger, shipped side: every host window tensor placed on
+    device for a dispatch (fresh copies per attempt/pass — each
+    placement is real tunnel traffic and bills again). The resident
+    path's counterpart ledgers are ``h2d_bytes_ring`` (column appends)
+    and ``h2d_bytes_index`` (gather index arrays), so a ``TW_DEVCOLS``
+    solve can never silently claim zero traffic while still shipping."""
+    st.add("h2d_bytes_shipped",
+           float(sum(np.asarray(arrs[k]).nbytes
+                     for k in _BATCH_KEYS if k in arrs)))
+
+
+def _make_assembler(dc_items: List[Dict], batch: Dict, st: _Stats):
+    """Build the device-assembly closure for one packed group
+    (``TW_DEVCOLS``): ``assemble(active, pad)`` returns the eight
+    window tensors of ``_BATCH_KEYS`` order for the given row subset
+    (``active=None`` = all rows, ascending indices otherwise) plus
+    ``pad`` trailing all-invalid rows — the drop-in replacement for
+    host-tensor placement at every dispatch site (warm, compacted
+    redispatch, retry). The rings are global per-partition arenas, so
+    the WHOLE group assembles in ONE jitted gather over host-built
+    index arrays (row selection/padding is NumPy — no eager device op
+    ever sees a data-dependent shape, which is what keeps the steady
+    state at zero compiles). Each call gathers FRESH device tensors, so
+    donated buffers of a failed attempt can never poison a retry, and
+    ships only int32 index arrays (``h2d_bytes_index``) plus the small
+    skip/force tensors (``h2d_bytes_shipped``)."""
+    ring_in = dc_items[0]["ring_in"]
+    ring_out = dc_items[0]["ring_out"]
+    cat = (lambda key: dc_items[0][key] if len(dc_items) == 1
+           else np.concatenate([it[key] for it in dc_items]))
+    in_idx, out_idx = cat("in_idx"), cat("out_idx")
+    origin_in, origin_out = cat("origin_in"), cat("origin_out")
+
+    def assemble(active: Optional[np.ndarray], pad: int) -> Tuple:
+        def rows(arr, fill):
+            a = arr if active is None else arr[active]
+            if pad:
+                a = np.concatenate(
+                    [a, np.full((pad,) + a.shape[1:], fill,
+                                dtype=a.dtype)])
+            return a
+
+        si, so = rows(in_idx, -1), rows(out_idx, -1)
+        oi, oo = rows(origin_in, 0), rows(origin_out, 0)
+        st.add("h2d_bytes_index",
+               float(si.nbytes + so.nbytes + oi.nbytes + oo.nbytes))
+        outs = _devcols.assemble_resident(ring_in, ring_out,
+                                          si, so, oi, oo)
+        skip_cap = rows(batch["skip_cap"], 0)
+        force_skip = rows(batch["force_skip"], False)
+        st.add("h2d_bytes_shipped",
+               float(skip_cap.nbytes + force_skip.nbytes))
+        return tuple(outs) + (skip_cap, force_skip)
+
+    # true (un-padded) row count, for callers that pad the batch-row
+    # axis to pow2 and must slice per-row outputs (convergence flags)
+    # back to the real windows
+    assemble.n_rows = int(in_idx.shape[0])
+    return assemble
+
+
 def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
                     mesh=None, flow_wait=None, tenant_col=None,
-                    tenant_table=None, trace_keys=()):
+                    tenant_table=None, trace_keys=(), assemble=None):
     """One solve pass as warm dispatch + compacted full redispatch.
 
     Returns the packed [B, E, W, 3+topk] output as a host array,
@@ -1332,6 +1564,7 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
     n_shards = int(mesh.devices.size) if mesh is not None else 1
 
     def place(arrs, pidx_np):
+        _bill_shipped(st, arrs)
         if mesh is None:
             return tuple(arrs[k] for k in _BATCH_KEYS) + (pidx_np,)
         import jax
@@ -1354,8 +1587,15 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
                            for t in tables)
 
     with _profile.annotate("tw:fleet:warm-dispatch"):
+        if assemble is not None:
+            # pow2 batch-row padding (all-invalid rows): bounded shape
+            # lattice under continuous batching, see _dispatch_packed
+            pad0 = _bucket(assemble.n_rows, minimum=1) - assemble.n_rows
+            warm_common = assemble(None, pad0) + (_pad_pidx(pidx, pad0),)
+        else:
+            warm_common = place(batch, pidx)
         out_warm, flags = solve_windows_fleet(
-            *place(batch, pidx), *tables_dev, n_sweeps=warm, **hypers)
+            *warm_common, *tables_dev, n_sweeps=warm, **hypers)
     # the big warm block starts its D2H NOW — it overlaps the flag fetch,
     # the host gather, and the redispatch's device execution below
     _copy_async(out_warm)
@@ -1363,6 +1603,11 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
     with _profile.annotate("tw:fleet:flag-fetch"):
         converged = _fetch(flags, st, flow_wait,
                            flag_fetch=True).astype(bool)
+    if assemble is not None:
+        # drop the pow2 padding rows: all-invalid windows converge by
+        # construction and must not inflate the compaction ledger (or
+        # reach the redispatch row gather)
+        converged = converged[:assemble.n_rows]
     _trace_stage(trace_keys, "compact-fetch", w0)
     active = np.flatnonzero(~converged)
     st.add("compact_windows_total", float(converged.shape[0]))
@@ -1384,24 +1629,32 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
 
     b_pad = bucket_rows_per_shard(int(active.size), n_shards)
     pad = b_pad - int(active.size)
-    gathered = {}
-    for k in _BATCH_KEYS:
-        a = np.asarray(batch[k])[active]
-        if pad:
-            # padding rows are all-invalid windows: no valid spans or
-            # columns, so they assign nothing and are decoded by nobody
-            # (same convention as pack_problem's pad_b rows)
-            a = np.concatenate(
-                [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)])
-        gathered[k] = a
     pidx_active = np.asarray(pidx)[active]
     if pad:
         pidx_active = np.concatenate(
             [pidx_active, np.zeros(pad, dtype=pidx_active.dtype)])
+    if assemble is not None:
+        # resident path: re-gather the straggler rows from the rings on
+        # device (the warm dispatch donated its assembled tensors; a
+        # fresh assembly is index-array traffic only, never a re-ship
+        # of the column data)
+        redispatch_common = assemble(active, pad) + (pidx_active,)
+    else:
+        gathered = {}
+        for k in _BATCH_KEYS:
+            a = np.asarray(batch[k])[active]
+            if pad:
+                # padding rows are all-invalid windows: no valid spans or
+                # columns, so they assign nothing and are decoded by nobody
+                # (same convention as pack_problem's pad_b rows)
+                a = np.concatenate(
+                    [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)])
+            gathered[k] = a
+        redispatch_common = place(gathered, pidx_active)
     w0 = _selftrace.now_us()
     with _profile.annotate("tw:fleet:redispatch"):
         out_full, _ = solve_windows_fleet(
-            *place(gathered, pidx_active), *tables_dev,
+            *redispatch_common, *tables_dev,
             n_sweeps=n_sweeps, **hypers)
     _trace_stage(trace_keys, "redispatch", w0)
     _copy_async(out_full)
@@ -1414,25 +1667,39 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
                            window_valid, n_passes, n_sweeps, warm, hypers,
                            stats, mesh=None, flow_wait=None,
                            tenant_col=None, tenant_table=None,
-                           trace_keys=()):
+                           trace_keys=(), assemble=None):
     """Compacted replacement for one fused group dispatch: per-pass
     warm/redispatch compaction, with the two-pass EM's on-device refit as
     its own dispatch between the passes (same refit program
     ``solve_em_fleet`` runs in-graph, so the flows cannot drift).
     ``batch`` stays host-side NumPy throughout — each dispatch places
     (and, mesh-less, uploads) fresh device copies, which is what makes
-    the donated window tensors safe to regather for the redispatch."""
+    the donated window tensors safe to regather for the redispatch.
+    Under ``assemble`` (TW_DEVCOLS) there ARE no host window tensors:
+    every dispatch, and the refit's sample extraction, re-gathers fresh
+    device tensors from the resident rings instead."""
     st = _as_stats(stats)
     out0 = _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, st,
                            mesh=mesh, flow_wait=flow_wait,
                            tenant_col=tenant_col, tenant_table=tenant_table,
-                           trace_keys=trace_keys)
+                           trace_keys=trace_keys, assemble=assemble)
     if n_passes == 1:
         return out0
+    if assemble is not None:
+        # refit inputs straight off the rings (device tensors; the refit
+        # program does not donate, so they survive the call), padded to
+        # the same pow2 row count as out0 so the refit program's shapes
+        # ride the bounded lattice too
+        pad0 = _bucket(assemble.n_rows, minimum=1) - assemble.n_rows
+        bi = dict(zip(_BATCH_KEYS, assemble(None, pad0)))
+        pidx_refit = _pad_pidx(np.asarray(pidx), pad0)
+    else:
+        bi = batch
+        pidx_refit = pidx
     new_tables = refit_fleet_params(
         out0[..., _layout.CH_ASSIGN].astype(np.int32),
-        batch["in_start"], batch["in_end"], batch["in_valid"],
-        batch["out_start"], batch["out_end"], pidx,
+        bi["in_start"], bi["in_end"], bi["in_valid"],
+        bi["out_start"], bi["out_end"], pidx_refit,
         window_rows, window_valid,
         params["pred_mask"], params["root_mask"],
         params["edge_wt"], params["edge_mu"], params["edge_sd"],
@@ -1448,7 +1715,7 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
                            n_sweeps, warm, hypers, st, mesh=mesh,
                            flow_wait=flow_wait,
                            tenant_col=tenant_col, tenant_table=tenant_table,
-                           trace_keys=trace_keys)
+                           trace_keys=trace_keys, assemble=assemble)
 
 
 def _decode_group(solver, pend, results, stats, ctx=None):

@@ -1055,6 +1055,14 @@ class PackedProblem:
     ``[B * M]`` id list or the columnar path's :class:`EndpointIds`
     (id-table + ranges, gathered at decode time);
     :meth:`out_id_array` is the single accessor decode reads through.
+
+    ``devcols`` (``TW_DEVCOLS`` fleet path only) replaces the six big
+    window tensors with ring-slot INDEX arrays plus the owning
+    :class:`~traceweaver_tpu.ops.devcols.ColumnRing` handles — the
+    window tensors themselves are assembled on device from the resident
+    columns (:func:`traceweaver_tpu.ops.devcols.assemble_windows`) and
+    never exist in host memory. ``arrays`` then carries only the small
+    host-shipped tensors (skip_cap/force_skip) and the problem tables.
     """
 
     arrays: Dict[str, np.ndarray]
@@ -1063,6 +1071,14 @@ class PackedProblem:
     in_ids: List  # [n_in] span ids, window order == original sort order
     out_ids: List  # per ep: [B*M] id list OR EndpointIds
     n_in: int
+    devcols: Optional[Dict] = None
+
+    @property
+    def M(self) -> int:
+        """Padded candidate-column count (the decode stride)."""
+        if self.devcols is not None:
+            return int(self.devcols["out_idx"].shape[2])
+        return int(self.arrays["out_start"].shape[2])
 
     def out_id_array(self, e: int) -> np.ndarray:
         """[B * M] object array of candidate ids for endpoint ``e``."""
@@ -1078,7 +1094,7 @@ class PackedProblem:
         packer slices every batch tensor to its exact window count; the
         id maps must follow so decode's ``b * M + j`` indexing stays
         aligned)."""
-        M = self.arrays["out_start"].shape[2]
+        M = self.M
         self.out_ids = [
             col.rows(n_rows) if isinstance(col, EndpointIds)
             else col[:n_rows * M]
@@ -1311,6 +1327,112 @@ def _pack_problem_columnar(
     return PackedProblem(arrays=arrays, out_eps=out_eps, windows=windows,
                          in_ids=in_cols.ids, out_ids=out_ids,
                          n_in=len(in_cols))
+
+
+def _pack_problem_devcols(
+    in_spans, out_span_partitions, out_eps, dists, in_ep, dag,
+    in_slots, out_slots, ring_in, ring_out,
+    force_skip_ids=None, max_window=DEFAULT_MAX_WINDOW, parallel=False,
+    windows=None, pad_w=None, pad_b=None, pad_m=None, pad_e=None,
+    ranges=None, skip_caps=None, in_cols=None, out_cols=None,
+) -> PackedProblem:
+    """Device-resident :func:`pack_problem` body (``TW_DEVCOLS``, fleet
+    path): the SAME windowing, candidate ranges, skip caps, id maps, and
+    problem tables as :func:`_pack_problem_columnar`, but instead of
+    filling the six dense window tensors in host memory it emits int32
+    ring-slot INDEX arrays (``in_idx [B, W]`` / ``out_idx [B, E, M]``,
+    −1 = invalid) over the resident device columns
+    (:mod:`traceweaver_tpu.ops.devcols`). The tensors themselves are
+    assembled by on-device gathers at dispatch time — bit-identical to
+    the host fill on the integral-µs timestamps the ring admits (the
+    ``TW_DEVCOLS`` parity suite pins it).
+
+    ``in_slots`` / ``out_slots[ep]`` map each sorted partition position
+    to its live ring slot (``ColumnRing.resolve``); the caller resolved
+    them before packing, so ineligible partitions never reach here."""
+    E = len(out_eps)
+    E_pad = max(E, pad_e or E)
+    if in_cols is None:
+        in_cols = in_columns(in_spans)
+    if out_cols is None:
+        out_cols = out_columns(out_span_partitions, out_eps)
+    if windows is None:
+        windows = perfect_cut_windows_cols(in_cols, max_window)
+    n_windows = len(windows)
+    B = _bucket(max(n_windows, pad_b or 1), minimum=1)
+    W = _bucket(max(max(hi - lo for lo, hi in windows), pad_w or 1))
+
+    if ranges is None:
+        out_starts_np = {ep: out_cols[ep].start for ep in out_eps}
+        ranges = candidate_ranges(in_spans, windows, out_eps, out_starts_np,
+                                  in_cols=in_cols)
+    M = _bucket(max(int((ranges[:, :, 1] - ranges[:, :, 0]).max(initial=1)),
+                    pad_m or 1))
+
+    skip_cap = np.zeros((B, E_pad), dtype=np.float32)
+    force_skip = np.zeros((B, E_pad, W), dtype=bool)
+    in_idx = np.full((B, W), -1, dtype=np.int32)
+    out_idx = np.full((B, E_pad, M), -1, dtype=np.int32)
+    origin_in = np.zeros(B, dtype=np.int32)
+    origin_out = np.zeros(B, dtype=np.int32)
+
+    los = np.fromiter((lo for lo, _ in windows), np.int64, n_windows)
+    his = np.fromiter((hi for _, hi in windows), np.int64, n_windows)
+    n_w = his - los
+    origins = in_cols.start[los]                       # [Bw] f64 absolute
+    origin_in[:n_windows] = ring_in.rel32(origins)
+    origin_out[:n_windows] = ring_out.rel32(origins)
+
+    jw = np.arange(W)
+    w_valid = jw[None, :] < n_w[:, None]               # [Bw, W]
+    w_src = np.where(w_valid, los[:, None] + jw[None, :], 0)
+    in_idx[:n_windows][w_valid] = in_slots[w_src][w_valid]
+
+    jm = np.arange(M)
+    r0 = ranges[:, :, 0]
+    m_w = ranges[:, :, 1] - r0
+    out_ids: List[EndpointIds] = []
+    for e, ep in enumerate(out_eps):
+        cols = out_cols[ep]
+        c_valid = jm[None, :] < m_w[:, e][:, None]     # [Bw, M]
+        c_src = np.where(c_valid, r0[:, e][:, None] + jm[None, :], 0)
+        out_idx[:n_windows, e][c_valid] = out_slots[ep][c_src][c_valid]
+        r0_pad = np.zeros(B, dtype=np.int64)
+        cnt_pad = np.zeros(B, dtype=np.int64)
+        r0_pad[:n_windows] = r0[:, e]
+        cnt_pad[:n_windows] = m_w[:, e]
+        out_ids.append(EndpointIds(cols.ids, r0_pad, cnt_pad, M))
+
+    if skip_caps is not None:
+        skip_cap[:n_windows, :E] = skip_caps
+    else:
+        skip_cap[:n_windows, :E] = np.maximum(n_w[:, None] - m_w, 0)
+
+    if force_skip_ids:
+        in_ids_arr = in_cols.ids
+        for e, ep in enumerate(out_eps):
+            fs = force_skip_ids.get(ep, set())
+            if not fs:
+                continue
+            for b in range(n_windows):
+                lo, hi = int(los[b]), int(his[b])
+                mask = np.fromiter((i in fs for i in in_ids_arr[lo:hi]),
+                                   bool, hi - lo)
+                n_forced = int(mask.sum())
+                if n_forced:
+                    force_skip[b, e, :hi - lo] = mask
+                skip_cap[b, e] = max(skip_cap[b, e], n_forced)
+
+    arrays = dict(
+        skip_cap=skip_cap, force_skip=force_skip,
+        **_problem_tables(out_eps, E_pad, dists, in_ep, dag, parallel),
+    )
+    return PackedProblem(
+        arrays=arrays, out_eps=out_eps, windows=windows,
+        in_ids=in_cols.ids, out_ids=out_ids, n_in=len(in_cols),
+        devcols=dict(in_idx=in_idx, out_idx=out_idx,
+                     origin_in=origin_in, origin_out=origin_out,
+                     ring_in=ring_in, ring_out=ring_out))
 
 
 def _pack_problem_objects(
@@ -1741,7 +1863,7 @@ class WeaverTPU:
         otherwise host-bound).
         """
         B, E, W = assign.shape
-        M = packed.arrays["out_start"].shape[2]
+        M = packed.M
         K = topk_cols.shape[3]
         # 0-d object holders let tuple sentinels assign under boolean masks
         skip_v = np.empty((), dtype=object)

@@ -314,6 +314,8 @@ class HostSyncHazard:
            "stream/service.py")
     #: functions allowed to convert device handles: THE ledgered helper
     ALLOWED_FUNCS = ("_fetch",)
+    #: the helper named in finding messages (subclasses re-point it)
+    LEDGER_HINT = "fleet._fetch"
     _DEVICE_RE = re.compile(r"^(solve_|refit_|fused_)")
     _DEVICE_EXACT = {"jax.device_put", "device_put"}
     _CONVERSIONS = {"np.asarray", "np.array", "numpy.asarray",
@@ -409,14 +411,15 @@ class HostSyncHazard:
                         f"{name}() over a device handle blocks on device "
                         "execution + D2H without billing wait_s / "
                         "d2h_bytes_* — fetch through the ledgered helper "
-                        "(fleet._fetch) or justify with a suppression"))
+                        f"({self.LEDGER_HINT}) or justify with a "
+                        "suppression"))
                 elif (isinstance(node.func, ast.Attribute)
                         and node.func.attr == "item" and not node.args
                         and self._value_tainted(node.func.value, tainted)):
                     findings.append(mod.finding(
                         self.id, node,
                         ".item() over a device handle is an unledgered "
-                        "blocking sync — fetch through fleet._fetch"))
+                        f"blocking sync — fetch through {self.LEDGER_HINT}"))
         return findings
 
 
@@ -967,7 +970,52 @@ class ChannelLayoutDiscipline:
         return findings
 
 
+# ---------------------------------------------------------------------------
+# TW009 — device-resident column discipline
+# ---------------------------------------------------------------------------
+
+class DevcolsResidency(HostSyncHazard):
+    """Ring-resident columns materialize on host only through the
+    ledgered fetch.
+
+    The device-resident span-column path (``TW_DEVCOLS``,
+    :mod:`traceweaver_tpu.ops.devcols`) exists so the window tensors
+    never cross the host↔device tunnel: the ring buffers live in HBM
+    and :func:`~traceweaver_tpu.ops.devcols.assemble_windows` gathers
+    from them on device. A bare ``np.asarray`` over a ring buffer or an
+    assembled window tensor silently re-ships the very data the path
+    keeps resident — and, worse, bills nothing, so the ``h2d``/``d2h``
+    byte ledger (the resident path's honesty contract) lies. Host
+    copies of resident values go through
+    ``ops/devcols.fetch_resident`` (``d2h_bytes_resident``) or the
+    fleet's ``_fetch``.
+
+    Same name-taint mechanics as TW003; the taint SOURCES here are the
+    devcols programs (``assemble_windows``/``ring_append``) and ``.buf``
+    ring-buffer attribute reads.
+    """
+
+    id = "TW009"
+    title = "unledgered host copy of ring-resident device columns"
+
+    HOT = ("algorithms/fleet.py", "algorithms/weaver_tpu.py",
+           "stream/service.py", "ops/devcols.py")
+    ALLOWED_FUNCS = ("_fetch", "fetch_resident")
+    LEDGER_HINT = "ops/devcols.fetch_resident"
+    _DEVICE_RE = re.compile(r"^(assemble_|ring_append$)")
+    _DEVICE_EXACT: set = set()
+    _LAUNDER = {"_fetch", "fetch_resident", "np.asarray", "np.array",
+                "numpy.asarray", "numpy.array", "float"}
+
+    def _is_device_call(self, node: ast.AST) -> bool:
+        # a ring buffer read (`ring.buf`) is resident data, call or not
+        if isinstance(node, ast.Attribute) and node.attr == "buf":
+            return True
+        return super()._is_device_call(node)
+
+
 #: registration order == reporting order for same-line findings
 RULE_CLASSES = [KnobDiscipline, ImportTimeFreeze, HostSyncHazard,
                 RecompileDiscipline, LockDiscipline, PrecisionDiscipline,
-                MetricDiscipline, ChannelLayoutDiscipline]
+                MetricDiscipline, ChannelLayoutDiscipline,
+                DevcolsResidency]

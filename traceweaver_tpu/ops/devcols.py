@@ -1,0 +1,413 @@
+"""Device-resident span columns: HBM ring buffers + on-device assembly.
+
+The columnar host path (PR 7, ``TW_COLUMNAR``) made window-tensor
+*construction* cheap — array slicing instead of per-span Python — but
+every fleet dispatch still materializes the dense ``[B, W]`` /
+``[B, E, M]`` window tensors in host NumPy and ships them H2D. At
+streaming cadence the same spans ship again and again: overlapping
+windows re-pack their overlap region every micro-batch, and the r05
+on-chip profile shows the device idle most of the wall while the host
+assembles and feeds (mfu_measured_pct 0.39, BENCH_r05_builder_tpu.json).
+
+This module keeps the hot span columns RESIDENT in device memory
+instead (``TW_DEVCOLS``, default on):
+
+- :class:`ColumnRing` — one global arena per partition kind ("in"
+  server spans, "out" client spans; see :class:`DeviceColumnStore` for
+  why sharing one arena is what bounds the compile lattice) — is a
+  circular ``[cap, 3]`` int32 device buffer of span columns (start/end
+  microseconds relative to a per-ring epoch, plus the endpoint id
+  column), appended via :func:`jax.lax.dynamic_update_slice` with the
+  buffer donated, so an append is an in-place device write of ONLY the
+  new rows. A span that already sits in the ring ships zero bytes on
+  every later dispatch that references it — the resident win. Sizing
+  contract: ``TW_DEVCOLS_RING`` must exceed the in-flight working set
+  (spans referenced by dispatches not yet retired) — appends past
+  capacity evict oldest-first, and an in-flight dispatch whose slots
+  are overwritten would gather stale columns; the occupancy gauge
+  (``tw_devcols_ring_fill``) is the pressure signal, the same sizing
+  discipline as ``TW_FLEET_BUDGET``.
+- :func:`assemble_windows` is the jitted assembly program: it takes the
+  ring buffers plus small host-computed **index arrays** (the window →
+  ring-slot maps derived from the existing ``SpanArray`` searchsorted
+  candidate ranges) and produces the six window tensors by on-device
+  gathers. H2D per dispatch drops from the full f32/bool window tensors
+  to int32 index arrays (< half the bytes) plus the once-per-span ring
+  appends.
+
+Exactness contract (the ``TW_DEVCOLS=1`` vs ``0`` golden parity,
+tests/test_devcols.py): the host path computes
+``float32(float64(t) - float64(origin))``; the device path computes
+``float32(int32(t - epoch) - int32(origin - epoch))``. The two are
+bit-identical whenever every timestamp is an integral number of
+microseconds (the Jaeger wire convention) and window-relative offsets
+fit int32 — both checked per resolve; a partition that fails either
+check makes the whole dispatch group fall back to the host packer,
+counted in ``devcols_fallbacks``, never silently approximated.
+
+Tenancy stays a host-side concept (the serve layer's id column never
+ships — same discipline as PR 6); the ring registry is simply KEYED by
+tenant, so tenants never share residency.
+
+Knobs: ``TW_DEVCOLS`` (kill switch — 0 restores the PR 7 host packer
+verbatim), ``TW_DEVCOLS_RING`` (per-ring capacity, power of two).
+See docs/PERF.md "Device-resident span columns".
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from traceweaver_tpu.obs.registry import get_registry as _get_registry
+from traceweaver_tpu.runtime import knobs as _knobs
+from traceweaver_tpu.runtime.bucketing import pow2_bucket
+from traceweaver_tpu.spans import SpanArray
+
+# a window origin can sit this far (µs) from the ring epoch before the
+# int32 relative representation overflows; past it the ring re-epochs
+# (full re-append, counted) — ~35 minutes of stream time per epoch
+_INT32_SPAN = (1 << 31) - 1
+
+_OBS_RING_FILL = _get_registry().gauge(
+    "tw_devcols_ring_fill",
+    "device-resident column ring occupancy (live entries / capacity)",
+    labels=("ring",))
+_OBS_RING_EVENTS = _get_registry().counter(
+    "tw_devcols_events_total",
+    "column-ring lifecycle events (appends/re-epochs/evictions/"
+    "ineligible batches)",
+    labels=("kind",))
+
+
+def devcols_enabled() -> bool:
+    """``TW_DEVCOLS=0`` kills the device-resident assembly path,
+    restoring the PR 7 host columnar packer verbatim (the kill switch
+    and the golden-parity reference). Read at call time, same
+    discipline as every other knob."""
+    return _knobs.get_bool("TW_DEVCOLS")
+
+
+def ring_capacity() -> int:
+    """Per-ring slot capacity (``TW_DEVCOLS_RING``), power-of-two
+    bucketed so the append/assemble programs compile against a bounded
+    shape lattice."""
+    return pow2_bucket(_knobs.get_int("TW_DEVCOLS_RING"))
+
+
+# ---------------------------------------------------------------------------
+# Device programs
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, donate_argnums=(0,))
+def ring_append(buf, update, start):
+    """In-place circular append: write ``update`` rows at slot ``start``
+    (donated buffer — HBM-resident across dispatches, never re-shipped).
+    ``start`` is a traced scalar, so every append position shares one
+    compiled program per (capacity, padded-length) shape pair; the host
+    mirror never lets a write cross the wrap boundary (it skips to slot
+    0 instead, marking the gap evicted), so one contiguous
+    ``dynamic_update_slice`` suffices."""
+    return jax.lax.dynamic_update_slice(buf, update, (start, 0))
+
+
+@jax.jit
+def assemble_windows(in_buf, out_buf, in_idx, out_idx,
+                     origin_in, origin_out):
+    """Window-tensor assembly as on-device gathers from resident rings.
+
+    ``in_buf``/``out_buf`` are ``[cap, 3]`` int32 ring buffers (rel
+    start, rel end, endpoint id); ``in_idx`` ``[b, W]`` and ``out_idx``
+    ``[b, E, M]`` are ring-slot index arrays (−1 = invalid/padded slot),
+    computed host-side from the same searchsorted candidate ranges the
+    host packer uses; ``origin_in``/``origin_out`` ``[b]`` are each
+    window's origin rebased to the respective ring's epoch. Returns the
+    six window tensors of :func:`..algorithms.weaver_tpu.pack_problem`
+    — bit-identical to the host fill for integral-µs timestamps (the
+    int32 difference is the exact integer the host's float64 difference
+    rounds from, and int32→float32 uses the same round-to-nearest-even).
+    """
+    iv = in_idx >= 0
+    g = in_buf[jnp.clip(in_idx, 0, in_buf.shape[0] - 1)]        # [b, W, 3]
+    rel_in = origin_in[:, None]
+    in_start = jnp.where(iv, (g[..., 0] - rel_in).astype(jnp.float32), 0.0)
+    in_end = jnp.where(iv, (g[..., 1] - rel_in).astype(jnp.float32), 0.0)
+    ov = out_idx >= 0
+    h = out_buf[jnp.clip(out_idx, 0, out_buf.shape[0] - 1)]     # [b, E, M, 3]
+    rel_out = origin_out[:, None, None]
+    out_start = jnp.where(ov, (h[..., 0] - rel_out).astype(jnp.float32), 0.0)
+    out_end = jnp.where(ov, (h[..., 1] - rel_out).astype(jnp.float32), 0.0)
+    return in_start, in_end, iv, out_start, out_end, ov
+
+
+def assemble_resident(ring_in: "ColumnRing", ring_out: "ColumnRing",
+                      in_idx, out_idx, origin_in, origin_out):
+    """:func:`assemble_windows` against the rings' CURRENT buffers,
+    serialized with appends: ``ring_append`` donates the buffer, so a
+    resolve racing an assembler could hand the jit a deleted array —
+    the buffer read and the gather enqueue must happen under the ring
+    locks (in before out everywhere; ``resolve`` never nests them, so
+    the order cannot deadlock). Once enqueued, a later donation is
+    safe: the runtime sequences the in-place write after pending
+    readers."""
+    with ring_in._lock:
+        with ring_out._lock:
+            return assemble_windows(ring_in.buf, ring_out.buf,
+                                    in_idx, out_idx,
+                                    origin_in, origin_out)
+
+
+def fetch_resident(handle, ledger=None):
+    """THE ledgered host materialization of ring-resident device data
+    (ring buffers, assembled window tensors). Anything resident exists
+    to NOT cross the tunnel; a host copy is a real D2H transfer and must
+    be billed (``d2h_bytes_resident``) — twlint TW009 flags bare
+    ``np.asarray`` over resident values outside this helper."""
+    out = np.asarray(handle)
+    if ledger is not None:
+        ledger("d2h_bytes_resident", float(out.nbytes))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side ring mirror
+# ---------------------------------------------------------------------------
+
+class ColumnRing:
+    """One partition's device-resident column ring + its host mirror.
+
+    The device side is ``buf`` (``[cap, 3]`` int32, donated through
+    :func:`ring_append` so it is updated in place). The host side keeps
+    what correctness needs and the device cannot answer without a
+    fetch: the id → sequence map, the float64 start/end mirror (so a
+    RESOLVED id is re-appended when a different corpus reuses the same
+    span id with different times — ids are only unique per corpus), and
+    the eviction horizon (padded appends clobber slots ahead of the
+    write head; those sequences are dead and re-append on next use).
+
+    ``resolve`` is the only write path and is lock-serialized: the
+    supervisor's bisect rung re-packs on flow workers concurrent with
+    the pipeline's pack thread.
+    """
+
+    __slots__ = ("key", "cap", "buf", "epoch", "next_seq", "evict_seq",
+                 "slot_of", "host_start", "host_end", "appended_rows",
+                 "appended_bytes", "_ep_table", "_lock")
+
+    def __init__(self, key: str, cap: Optional[int] = None) -> None:
+        self.key = key
+        self.cap = cap or ring_capacity()
+        self.buf = jnp.zeros((self.cap, 3), dtype=jnp.int32)
+        self.epoch: Optional[float] = None
+        self.next_seq = 0           # total rows ever appended
+        self.evict_seq = 0          # sequences below this are dead
+        self.slot_of: Dict[Tuple[str, str], int] = {}
+        self.host_start = np.zeros(self.cap, dtype=np.float64)
+        self.host_end = np.zeros(self.cap, dtype=np.float64)
+        self.appended_rows = 0
+        self.appended_bytes = 0
+        self._ep_table: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- eligibility ------------------------------------------------------
+    @staticmethod
+    def _integral(col: np.ndarray) -> bool:
+        return bool(np.all(np.isfinite(col)) and np.all(col == np.floor(col)))
+
+    def _eligible(self, cols: SpanArray) -> bool:
+        if len(cols) == 0:
+            return True
+        if not (self._integral(cols.start) and self._integral(cols.end)):
+            return False
+        if self.epoch is not None:
+            lo = float(min(cols.start[0], np.min(cols.start)))
+            hi = float(np.max(cols.end))
+            if not (0 <= lo - self.epoch and hi - self.epoch < _INT32_SPAN):
+                # stream ran past the int32 window: re-epoch (all
+                # resident entries die; the next resolve re-appends)
+                self._reset(epoch=float(np.min(cols.start)))
+                _OBS_RING_EVENTS.inc(kind="re_epoch")
+        return True
+
+    def _reset(self, epoch: Optional[float]) -> None:
+        self.epoch = epoch
+        self.evict_seq = self.next_seq
+        self.slot_of.clear()
+
+    # -- the one write/read path ------------------------------------------
+    def resolve(self, cols: SpanArray, endpoint: Optional[str] = None,
+                ledger=None, scope=None) -> Optional[np.ndarray]:
+        """Map a sorted partition's spans to live ring slots, appending
+        whatever is not already resident. Returns int32 ``[n]`` slot
+        indices, or None when the partition cannot ride the resident
+        path (non-integral timestamps, or more live spans than the ring
+        holds) — the caller then falls back to the host packer, counted.
+
+        ``scope`` namespaces the id → slot map (the fleet passes
+        ``(tenant, service)``): the arena is shared, but span ids are
+        only unique per corpus, and two scopes reusing an id with
+        different times must not evict each other's residency on every
+        resolve (the value check would force a re-append ping-pong).
+        """
+        with self._lock:
+            return self._resolve_locked(cols, endpoint, ledger, scope)
+
+    def _resolve_locked(self, cols, endpoint, ledger, scope):
+        n = len(cols)
+        if not self._eligible(cols):
+            _OBS_RING_EVENTS.inc(kind="ineligible")
+            return None
+        if n == 0:
+            return np.zeros(0, dtype=np.int32)
+        if self.epoch is None:
+            self.epoch = float(np.min(cols.start))
+
+        seqs = np.fromiter(
+            (self.slot_of.get((scope, i), -1) for i in cols.ids),
+            dtype=np.int64, count=n)
+        # value check: same id, different times = a different corpus
+        # reusing the id space — those rows re-append, never alias
+        live = seqs >= self.evict_seq
+        slots = (seqs % self.cap).astype(np.int64)
+        match = live.copy()
+        if match.any():
+            m = match.nonzero()[0]
+            ok = ((self.host_start[slots[m]] == cols.start[m])
+                  & (self.host_end[slots[m]] == cols.end[m]))
+            match[m] = ok
+        missing = ~match
+
+        # eviction fixpoint: appending L_pad rows (padded, possibly
+        # skipping to slot 0 at the wrap) advances the eviction horizon,
+        # which can strand more previously-live rows of THIS batch;
+        # those must join the append before the write size is final
+        for _ in range(64):
+            l_pad = pow2_bucket(max(1, int(missing.sum()))) \
+                if missing.any() else 0
+            if l_pad > self.cap:
+                _OBS_RING_EVENTS.inc(kind="ineligible")
+                return None
+            start_slot = self.next_seq % self.cap
+            skip = (self.cap - start_slot) if start_slot + l_pad > self.cap \
+                else 0
+            horizon = self.next_seq + skip + l_pad - self.cap
+            grew = match & (seqs < horizon)
+            if not grew.any():
+                break
+            match &= ~grew
+            missing |= grew
+        else:  # pragma: no cover — fixpoint is bounded by cap doublings
+            return None
+        if not missing.any():
+            self._observe()
+            return slots.astype(np.int32)
+
+        # build + write the padded update block (one contiguous
+        # dynamic_update_slice; the wrap skips to slot 0 with the gap
+        # marked evicted — padding rows land on already-dead slots)
+        mi = missing.nonzero()[0]
+        n_new = int(mi.size)
+        l_pad = pow2_bucket(n_new)
+        if (self.next_seq % self.cap) + l_pad > self.cap:
+            gap = self.cap - (self.next_seq % self.cap)
+            self.next_seq += gap
+            _OBS_RING_EVENTS.inc(float(gap), kind="wrap_gap")
+        base = self.next_seq
+        start_slot = base % self.cap
+        ep_id = -1
+        if endpoint is not None:
+            ep_id = self._ep_table.setdefault(endpoint, len(self._ep_table))
+        update = np.zeros((l_pad, 3), dtype=np.int32)
+        update[:n_new, 0] = (cols.start[mi] - self.epoch).astype(np.int64)
+        update[:n_new, 1] = (cols.end[mi] - self.epoch).astype(np.int64)
+        update[:n_new, 2] = ep_id
+        self.buf = ring_append(self.buf, update, start_slot)
+        self.evict_seq = max(self.evict_seq, base + l_pad - self.cap)
+        new_seqs = base + np.arange(n_new, dtype=np.int64)
+        new_slots = (new_seqs % self.cap)
+        self.host_start[new_slots] = cols.start[mi]
+        self.host_end[new_slots] = cols.end[mi]
+        for j, seq in zip(mi, new_seqs):
+            self.slot_of[(scope, cols.ids[j])] = int(seq)
+        self.next_seq = base + n_new
+        seqs[mi] = new_seqs
+        slots = (seqs % self.cap).astype(np.int64)
+        self.appended_rows += n_new
+        self.appended_bytes += update.nbytes
+        _OBS_RING_EVENTS.inc(float(n_new), kind="appended_rows")
+        if ledger is not None:
+            ledger("h2d_bytes_ring", float(update.nbytes))
+        if len(self.slot_of) > 4 * self.cap:
+            # dict hygiene: drop mappings to evicted sequences
+            self.slot_of = {k: s for k, s in self.slot_of.items()
+                            if s >= self.evict_seq}
+        self._observe()
+        return slots.astype(np.int32)
+
+    def rel32(self, values: np.ndarray) -> np.ndarray:
+        """Host-side rebase of absolute µs values to the ring epoch
+        (int32) — the window-origin representation the assembly program
+        subtracts on device."""
+        return (values - self.epoch).astype(np.int64).astype(np.int32)
+
+    @property
+    def live(self) -> int:
+        return min(self.next_seq - self.evict_seq, self.cap)
+
+    def _observe(self) -> None:
+        _OBS_RING_FILL.set(self.live / self.cap, ring=self.key)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+class DeviceColumnStore:
+    """Process-level registry of the resident column rings.
+
+    The rings are GLOBAL per-partition arenas (one "in", one "out"):
+    tenancy and service separation live entirely in the host-side index
+    arrays — a window only ever gathers the slots its own resolve
+    returned, so tenants cannot read each other's columns even though
+    they share the HBM arena (the same way they share HBM at all). One
+    arena per partition kind is what lets a whole dispatch group — any
+    mix of tenants and services — assemble in ONE jitted gather: per-
+    item device programs would mint an eager-op shape variant per
+    admission composition and the steady state would never stop
+    compiling. Cross-tenant id collisions are safe by the ring's value
+    check (same id + same times share a slot, which is correct; same id
+    + different times re-appends). The cost is shared eviction pressure,
+    bounded by ``TW_DEVCOLS_RING`` and visible in the ring gauges."""
+
+    def __init__(self) -> None:
+        self._rings: Dict[str, ColumnRing] = {}
+        self._lock = threading.Lock()
+
+    def ring(self, tenant: Optional[str], svc: str, part: str) -> ColumnRing:
+        with self._lock:
+            ring = self._rings.get(part)
+            if ring is None:
+                ring = self._rings[part] = ColumnRing(part)
+            return ring
+
+    def rings(self) -> List[ColumnRing]:
+        with self._lock:
+            return list(self._rings.values())
+
+    def clear(self) -> None:
+        """Drop every ring (tests; also frees the device buffers)."""
+        with self._lock:
+            self._rings.clear()
+
+
+_STORE = DeviceColumnStore()
+
+
+def get_store() -> DeviceColumnStore:
+    return _STORE

@@ -177,6 +177,11 @@ def build_stream_parser() -> argparse.ArgumentParser:
     p.add_argument("--watchdog_s", type=float, default=None,
                    help="micro-batch solve watchdog timeout (seconds); "
                         "a timed-out batch retries, then dead-letters")
+    p.add_argument("--slo_p99_ms", type=float, default=None,
+                   help="seal→emit p99 latency SLO (ms): solve a "
+                        "below-threshold backlog anyway once a sealed "
+                        "window ages past half the budget (continuous-"
+                        "batching admission; default off)")
     p.add_argument("--solve_retries", type=int, default=1,
                    help="micro-batch retry budget past the first attempt")
     p.add_argument("--strict", action="store_true",
@@ -227,6 +232,7 @@ def stream_main(argv) -> int:
         deadletter_path=args.deadletter,
         solve_watchdog_s=args.watchdog_s,
         solve_retries=args.solve_retries,
+        slo_p99_ms=args.slo_p99_ms,
     )
     sink = TraceSink(args.out) if args.out else None
     if args.resume:
@@ -322,6 +328,18 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--strict", action="store_true",
                    help="malformed span records -> HTTP 400 instead of "
                         "the skip-and-count dead-letter default")
+    p.add_argument("--continuous", dest="continuous", action="store_true",
+                   default=knobs.get_bool("TW_SERVE_CONTINUOUS"),
+                   help="continuous-batching dispatch: event-driven "
+                        "admission with a seal→emit SLO instead of the "
+                        "fixed threshold pump (default TW_SERVE_CONTINUOUS, "
+                        "on; docs/PERF.md)")
+    p.add_argument("--no-continuous", dest="continuous",
+                   action="store_false",
+                   help="restore the fixed threshold pump")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="per-tenant seal→emit p99 SLO in ms "
+                        "(default TW_SERVE_SLO_P99_MS)")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -340,7 +358,13 @@ def serve_main(argv) -> int:
         verbose=not args.quiet,
         state_dir=args.state_dir,
         max_tenants=args.max_tenants,
+        continuous=args.continuous,
+        slo_p99_ms=args.slo_p99_ms,
     )
+    if args.continuous and not args.quiet:
+        print("[serve] continuous batching: event-driven admission, "
+              "seal→emit p99 SLO %.0f ms (--no-continuous restores the "
+              "fixed pump)" % (cfg.slo_p99_ms,))
     if args.resume:
         if not (args.state_dir and os.path.isdir(args.state_dir)):
             print(f"--resume: no state dir at {args.state_dir!r}",
@@ -416,7 +440,14 @@ def main(argv=None) -> int:
             enable_persistent_compilation_cache,
         )
 
-        enable_persistent_compilation_cache()
+        cache_dir = enable_persistent_compilation_cache()
+        if cache_dir:
+            # serving-grade cold start (ROADMAP item 2, first slice): a
+            # rolling restart reloads its programs from this cache
+            # instead of recompiling; hit rate is on GET /metrics
+            # (tw_xla_compile_cache_hit_ratio)
+            print(f"[serve] persistent XLA compile cache: {cache_dir} "
+                  "(TW_JAX_CACHE_DIR; hit rate on /metrics)")
         return serve_main(argv[1:])
     if argv and argv[0] == "stream":
         # online mode rides its own subcommand; the bare flag surface
@@ -429,7 +460,11 @@ def main(argv=None) -> int:
             enable_persistent_compilation_cache,
         )
 
-        enable_persistent_compilation_cache()
+        cache_dir = enable_persistent_compilation_cache()
+        if cache_dir:
+            print(f"[stream] persistent XLA compile cache: {cache_dir} "
+                  "(TW_JAX_CACHE_DIR; hit rate on the --metrics-port "
+                  "scrape)")
         return stream_main(argv[1:])
     # Backend selection. The sandbox's sitecustomize force-selects the
     # remote "axon" TPU backend whose init can stall for minutes; the env

@@ -52,11 +52,25 @@ def install_compile_counters() -> None:
     from traceweaver_tpu.obs.registry import get_registry
 
     def _collect():
-        return [("tw_xla_compile_events_total", "counter",
+        fams = [("tw_xla_compile_events_total", "counter",
                  "XLA backend compiles + persistent-cache hits/misses "
                  "(runtime/jax_cache.py counters)",
                  [({"kind": k}, float(v))
                   for k, v in sorted(_COUNTERS.items())])]
+        # compile-cache hit RATE, computed at scrape time from the same
+        # counters (ROADMAP item 2 serving cold start: a warm-cache
+        # rolling restart should scrape ~1.0 here; ~0.0 means the
+        # deployment re-pays every compile on every restart)
+        hits = _COUNTERS["persistent_cache_hits"]
+        misses = _COUNTERS["persistent_cache_misses"]
+        if hits + misses:
+            fams.append((
+                "tw_xla_compile_cache_hit_ratio", "gauge",
+                "persistent compile-cache hit rate this process "
+                "(hits / (hits + misses); absent before the first "
+                "cache-eligible compile)",
+                [({}, hits / (hits + misses))]))
+        return fams
 
     get_registry().register_collector("jax_cache", _collect)
 

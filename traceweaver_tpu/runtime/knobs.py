@@ -69,6 +69,14 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("TW_COLUMNAR", "bool", True,
        help="0 kills the columnar host pack path (object-walk packing, "
             "the bit-identical pre-columnar flow)"),
+    _k("TW_DEVCOLS", "bool", True,
+       help="0 kills the device-resident span-column path (fleet window "
+            "tensors assembled on device from HBM rings; 0 restores the "
+            "host columnar packer verbatim — ops/devcols.py)"),
+    _k("TW_DEVCOLS_RING", "int", 1 << 15, lo=1 << 10, hi=1 << 22,
+       help="device column-ring capacity in spans per (tenant, service, "
+            "partition) ring (pow2-bucketed; partitions that outgrow it "
+            "fall back to the host packer, counted)"),
     _k("TW_SCORE_GEMM", "bool", False,
        help="1 routes eligible mixture evaluations through the "
             "quadratic-feature GEMM form (ops/scores.py; measured slower "
@@ -126,7 +134,17 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
             "SIGTERM before the process exits anyway"),
     _k("TW_SERVE_PUMP_WINDOWS", "int", 8, lo=1,
        help="auto-pump threshold: solve once this many sealed windows "
-            "are queued across tenants (flush forces it)"),
+            "are queued across tenants (flush forces it); under "
+            "continuous batching, the admission batch-fill target"),
+    _k("TW_SERVE_CONTINUOUS", "bool", True,
+       help="serve CLI dispatch mode: 1 (default) runs the "
+            "continuous-batching scheduler (event-driven admission, "
+            "SLO-aware); 0 restores the fixed threshold pump "
+            "(serve/continuous.py)"),
+    _k("TW_SERVE_SLO_P99_MS", "float", 2000.0, lo=1.0,
+       help="per-tenant seal→emit latency SLO (p99, milliseconds): the "
+            "continuous-batching scheduler admits SLO-at-risk windows "
+            "ahead of batch-fill efficiency"),
     # --- observability (traceweaver_tpu/obs, docs/OBSERVABILITY.md) ------
     _k("TW_PROFILE", "bool", False,
        help="jax.profiler trace annotations around fleet stages + device "

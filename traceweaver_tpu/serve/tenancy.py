@@ -83,6 +83,33 @@ _OBS_PUMP = _get_registry().counter(
     labels=("key",))
 
 
+def _merge_stats(dst: Dict, src: Dict) -> None:
+    """Fold a local fleet-ledger dict into the shared one (the
+    lock-free dispatch phase accumulates locally; this runs under the
+    service lock so a concurrent ``stats()`` scrape never iterates a
+    dict the solver is mutating). Counter keys add, ``record_max``
+    gauges take the max, ordered event lists extend, per-tenant buckets
+    merge. The obs-registry mirror already saw every update live
+    (``_Stats`` mirrors at write time), so this only moves the legacy
+    dict view."""
+    _GAUGES = ("pipeline_depth", "fleet_group_cost_max")
+    for k, v in src.items():
+        if isinstance(v, list):
+            dst.setdefault(k, []).extend(v)
+        elif isinstance(v, dict):
+            d = dst.setdefault(k, {})
+            for kk, vv in v.items():
+                # twlint: disable=TW007 — ledger MERGE of already-
+                # mirrored _Stats buckets, not a fresh counter
+                d[kk] = d.get(kk, 0.0) + vv
+        elif k in _GAUGES:
+            dst[k] = max(dst.get(k, 0.0), v)
+        else:
+            # twlint: disable=TW007 — ledger MERGE of already-mirrored
+            # _Stats counters, not a fresh counter
+            dst[k] = dst.get(k, 0.0) + v
+
+
 class TenancyError(ValueError):
     """A tenancy-layer refusal (bad tenant id, tenant cap reached) — the
     HTTP layer maps these to 4xx responses instead of 500s."""
@@ -113,6 +140,13 @@ class ServeConfig:
     ring_size: Optional[int] = None
     drain_timeout_s: Optional[float] = None
     pump_windows: Optional[int] = None
+    # continuous batching (serve/continuous.py): event-driven admission
+    # on a dispatcher thread instead of the ingest-inline threshold
+    # pump. False here (library default — direct constructors keep the
+    # pinned pump semantics); the serve CLI defaults it ON via
+    # TW_SERVE_CONTINUOUS. slo_p99_ms None -> TW_SERVE_SLO_P99_MS.
+    continuous: bool = False
+    slo_p99_ms: Optional[float] = None
 
     def __post_init__(self) -> None:
         if self.max_tenants is None:
@@ -127,6 +161,8 @@ class ServeConfig:
             self.drain_timeout_s = knobs.get_float("TW_SERVE_DRAIN_S")
         if self.pump_windows is None:
             self.pump_windows = knobs.get_int("TW_SERVE_PUMP_WINDOWS")
+        if self.slo_p99_ms is None:
+            self.slo_p99_ms = knobs.get_float("TW_SERVE_SLO_P99_MS")
 
 
 class Tenant:
@@ -180,6 +216,11 @@ class Tenant:
         # per-tenant fleet ledger for isolated solves (the shared solve
         # ledgers on the manager, attributed via the tenant id column)
         self.fleet_stats: Dict[str, float] = {}
+        # windows taken off the queues by the continuous dispatcher and
+        # currently solving OUTSIDE the service lock: retention pruning
+        # must not advance past them (their spans are still being
+        # decoded/stitched)
+        self.in_flight: List = []
 
     # -- ingestion --------------------------------------------------------
     def ingest_payload(self, payload: dict) -> Dict[str, int]:
@@ -244,9 +285,11 @@ class Tenant:
 
     def _prune(self) -> None:
         # same retention rule as the stream run loop: two windows behind
-        # the watermark, never past the oldest backlog window
+        # the watermark, never past the oldest backlog window — nor past
+        # a window the continuous dispatcher is solving right now
         svc = self.svc
-        backlog = list(svc.scheduler.pending) + list(svc.scheduler.spill)
+        backlog = (list(svc.scheduler.pending) + list(svc.scheduler.spill)
+                   + list(self.in_flight))
         oldest = min((b.start_us for b in backlog),
                      default=svc.watermark.value)
         horizon = min(svc.watermark.value - 2 * svc.cfg.window_us,
@@ -394,6 +437,7 @@ class Tenant:
             deadletter_spans=int(svc.stats.get("deadletter_spans", 0)),
             low_confidence_traces=int(
                 svc.stats.get("low_confidence_traces", 0)),
+            seal_emit_p99_ms=round(svc.seal_emit_p99_ms() or 0.0, 2),
             quarantined_windows=int(
                 self.counters.get("quarantined_windows", 0)),
             ring_traces=len(self.ring),
@@ -438,6 +482,16 @@ class TenantService:
         self.stats_counters: Dict[str, float] = dict(
             shared_solves=0, tenant_batches=0, isolated_solves=0,
             pumped_windows=0, drain_timeouts=0)
+        # continuous batching (serve/continuous.py): a dispatcher thread
+        # owns the solve loop; ingest only seals + kicks. The fixed
+        # threshold pump stays the library default (and the drained
+        # fallback): cfg.continuous opts in.
+        self.dispatcher = None
+        if self.cfg.continuous:
+            from traceweaver_tpu.serve.continuous import ContinuousDispatcher
+
+            self.dispatcher = ContinuousDispatcher(
+                self, slo_ms=self.cfg.slo_p99_ms).start()
 
     def _bump(self, key: str, n: float = 1) -> None:
         """The pump ledger's single write path (callers hold the
@@ -463,19 +517,40 @@ class TenantService:
             return t
 
     def ingest(self, tenant_id: str, payload: dict) -> Dict[str, int]:
-        """Ingest one payload for one tenant, auto-pumping once enough
-        sealed windows are queued across tenants (so concurrent tenants'
-        windows accumulate into SHARED dispatches instead of each POST
-        solving alone)."""
+        """Ingest one payload for one tenant. Under continuous batching
+        the POST only seals and KICKS the dispatcher (solve cadence is
+        the admission scheduler's, decoupled from ingest); the classic
+        mode auto-pumps inline once enough sealed windows are queued
+        across tenants (so concurrent tenants' windows accumulate into
+        SHARED dispatches instead of each POST solving alone)."""
         with self._lock:
             summary = self.tenant(tenant_id).ingest_payload(payload)
-            if self.total_backlog() >= self.cfg.pump_windows:
-                summary["pumped_windows"] = self.pump()
-            return summary
+            if self.dispatcher is None:
+                if self.total_backlog() >= self.cfg.pump_windows:
+                    summary["pumped_windows"] = self.pump()
+        if self.dispatcher is not None:
+            self.dispatcher.kick()
+        return summary
 
     def total_backlog(self) -> int:
         with self._lock:
             return sum(t.backlog for t in self.tenants.values())
+
+    def reset_latency_window(self) -> None:
+        """Start a fresh seal→emit latency measurement window on every
+        tenant (the rolling p99 otherwise reflects cold-start compile
+        stalls long after they stop mattering — grade the SLO over the
+        steady state, the way the bench leg does)."""
+        with self._lock:
+            for t in self.tenants.values():
+                t.svc.seal_emit_lat_s.clear()
+
+    def in_flight_windows(self) -> int:
+        """Windows the continuous dispatcher took off the queues and is
+        solving right now (0 in pump mode — drain/quiesce loops must
+        wait for backlog AND in-flight)."""
+        with self._lock:
+            return sum(len(t.in_flight) for t in self.tenants.values())
 
     # -- the shared pump --------------------------------------------------
     def pump(self) -> int:
@@ -504,10 +579,68 @@ class TenantService:
             self._bump("pumped_windows", n)
             return n
 
-    def _solve_shared(self, batches: List[Tuple[Tenant, List]]) -> int:
-        from traceweaver_tpu.algorithms.fleet import solve_fleet
+    def solve_admitted(self, plan: List[Tuple[Tenant, List]]) -> int:
+        """Solve an admission-scheduler batch (``[(tenant, [bufs])]`` —
+        serve/continuous.py picked WHICH windows; this takes them off
+        the owning tenants' queues and rides them through the same
+        shared/isolated dispatch split as :meth:`pump`). Windows a
+        concurrent flush already drained are skipped (the take is
+        identity-matched), so admission races resolve to at-most-once
+        solving.
 
+        Unlike the pump, the shared DISPATCH runs OUTSIDE the service
+        lock: ingest proceeds while the device executes — the
+        throughput half of continuous batching. The taken windows are
+        marked in-flight on their tenants (so retention pruning cannot
+        advance past them mid-solve), prepare/consume stay under the
+        lock, and the fleet ledger accumulates into a local dict merged
+        under the lock afterwards (a concurrent stats() scrape must
+        never iterate a dict the solver is growing). Fault-spec'd
+        tenants' isolated solves keep the lock — storms are rare and
+        already pay for isolation. Returns windows solved."""
+        with self._lock:
+            shared: List[Tuple[Tenant, List]] = []
+            isolated: List[Tuple[Tenant, List]] = []
+            for t, bufs in plan:
+                taken = t.svc.scheduler.take(bufs)
+                if taken:
+                    (isolated if t.fault_spec else shared).append((t, taken))
+            for t, bufs in shared:
+                t.in_flight.extend(bufs)
+            prepared, items = self._prepare_shared(shared)
+        quarantined: List[int] = []
+        confidences: Optional[List] = (
+            [None] * len(items) if _quality.conf_enabled() else None)
+        local_stats: Dict[str, float] = {}
         t0 = time.perf_counter()
+        outs = self._dispatch_shared(items, quarantined, confidences,
+                                     stats=local_stats)
+        solve_s = time.perf_counter() - t0
+        with self._lock:
+            _merge_stats(self.fleet_stats, local_stats)
+            n = 0
+            if shared:
+                n = self._consume_shared(prepared, len(items), len(shared),
+                                         outs, quarantined, confidences,
+                                         solve_s)
+            for t, _ in shared:
+                t.in_flight.clear()
+            for t, bufs in isolated:
+                n += self._solve_isolated(t, bufs)
+            for tid in sorted(self.tenants):
+                t = self.tenants[tid]
+                if t.ckpt_path and \
+                        t.svc._since_checkpoint >= self.cfg.checkpoint_every:
+                    t.checkpoint()
+            self._bump("pumped_windows", n)
+            self._bump("continuous_dispatches")
+            return n
+
+    # -- the shared solve, in three phases so solve_admitted can drop
+    # -- the lock around the dispatch (pump() composes them locked) -------
+    def _prepare_shared(self, batches: List[Tuple[Tenant, List]]):
+        """Fleet-item construction for a shared solve (caller holds the
+        lock — reads tenant pipeline state)."""
         prepared = []
         items: List = []
         for t, bufs in batches:
@@ -516,21 +649,36 @@ class TenantService:
             lo = len(items)
             items.extend(t_items)
             prepared.append((t, bufs, per_buf, t_owners, lo, len(items)))
-        quarantined: List[int] = []
-        outs: List = []
-        confidences: Optional[List] = (
-            [None] * len(items) if _quality.conf_enabled() else None)
-        if items:
-            outs = solve_fleet(items, stats=self.fleet_stats,
-                               precision=self.precision,
-                               quarantined=quarantined,
-                               confidences=confidences)
-        solve_s = time.perf_counter() - t0
+        return prepared, items
+
+    def _dispatch_shared(self, items: List, quarantined: List,
+                         confidences: Optional[List],
+                         stats: Optional[Dict] = None) -> List:
+        """The device phase — needs NO service lock (``stats`` defaults
+        to the shared ledger for locked callers; lock-free callers pass
+        a local dict and merge after)."""
+        from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+        if not items:
+            return []
+        return solve_fleet(items,
+                           stats=(self.fleet_stats if stats is None
+                                  else stats),
+                           precision=self.precision,
+                           quarantined=quarantined,
+                           confidences=confidences)
+
+    def _consume_shared(self, prepared, n_items: int, n_batches: int,
+                        outs, quarantined: List,
+                        confidences: Optional[List],
+                        solve_s: float) -> int:
+        """Decode/emit phase (caller holds the lock — mutates tenant
+        pipeline state, rings, sinks)."""
         self._bump("shared_solves")
-        self._bump("tenant_batches", len(batches))
+        self._bump("tenant_batches", n_batches)
         n = 0
         for t, bufs, per_buf, t_owners, lo, hi in prepared:
-            share = solve_s * (hi - lo) / max(1, len(items))
+            share = solve_s * (hi - lo) / max(1, n_items)
             t.svc._bump("solve_s", share)
             results = t.svc.consume_batch_results(
                 bufs, per_buf, t_owners, outs[lo:hi],
@@ -540,6 +688,17 @@ class TenantService:
             t.emit_results(results)
             n += len(bufs)
         return n
+
+    def _solve_shared(self, batches: List[Tuple[Tenant, List]]) -> int:
+        t0 = time.perf_counter()
+        prepared, items = self._prepare_shared(batches)
+        quarantined: List[int] = []
+        confidences: Optional[List] = (
+            [None] * len(items) if _quality.conf_enabled() else None)
+        outs = self._dispatch_shared(items, quarantined, confidences)
+        solve_s = time.perf_counter() - t0
+        return self._consume_shared(prepared, len(items), len(batches),
+                                    outs, quarantined, confidences, solve_s)
 
     def _solve_isolated(self, t: Tenant, bufs: List) -> int:
         """One fault-spec'd tenant's batch in its own dispatch, under its
@@ -571,15 +730,23 @@ class TenantService:
 
     # -- flush / drain / resume -------------------------------------------
     def flush(self, tenant_id: Optional[str] = None) -> Dict[str, int]:
-        """Seal every open window (one tenant, or all) and pump — the
-        deterministic "solve what you have now" hook tests and the drain
-        path use."""
+        """Seal every open window (one tenant, or all) and solve the
+        backlog — the deterministic "solve what you have now" hook tests
+        and the drain path use. Under continuous batching the backlog
+        drains through the dispatcher's admission-sized chunks (one
+        giant catch-all pump would dispatch batch shapes outside the
+        steady-state lattice); pump mode solves it in one pump as
+        before."""
         with self._lock:
             targets = ([self.tenant(tenant_id, create=False)]
                        if tenant_id else list(self.tenants.values()))
             sealed = sum(t.flush() for t in targets)
-            solved = self.pump()
-            return dict(sealed_windows=sealed, solved_windows=solved)
+        if self.dispatcher is not None:
+            solved = self.dispatcher.drain_backlog()
+        else:
+            with self._lock:
+                solved = self.pump()
+        return dict(sealed_windows=sealed, solved_windows=solved)
 
     def checkpoint_all(self,
                        timeout_s: Optional[float] = None) -> Dict[str, int]:
@@ -604,11 +771,14 @@ class TenantService:
                     timed_out=timed_out)
 
     def drain(self) -> Dict[str, int]:
-        """Graceful drain (the SIGTERM path): checkpoint every tenant
-        within the drain budget, then close sinks. Open windows ride the
+        """Graceful drain (the SIGTERM path): stop the continuous
+        dispatcher (no new admissions), checkpoint every tenant within
+        the drain budget, then close sinks. Open windows ride the
         checkpoints — a restart resumes every tenant with zero lost
         windows (tests/test_stream.py pins byte-identical per-tenant
         resume)."""
+        if self.dispatcher is not None:
+            self.dispatcher.stop()
         with self._lock:
             out = self.checkpoint_all()
             for t in self.tenants.values():
@@ -681,8 +851,8 @@ class TenantService:
         "backlog", "solved_windows", "shed_spilled",
         "shed_dropped_windows", "shed_dropped_spans", "late_rerouted",
         "late_dropped", "deadletter_windows", "deadletter_spans",
-        "low_confidence_traces", "quarantined_windows", "ring_traces",
-        "ring_evicted")
+        "low_confidence_traces", "seal_emit_p99_ms",
+        "quarantined_windows", "ring_traces", "ring_evicted")
 
     def metrics_families(self) -> List:
         """Collector-style families for ``GET /metrics``
@@ -747,7 +917,12 @@ class TenantService:
                         self.stats_counters["isolated_solves"]),
                     pumped_windows=int(
                         self.stats_counters["pumped_windows"]),
+                    continuous_dispatches=int(
+                        self.stats_counters.get(
+                            "continuous_dispatches", 0)),
                 ),
+                continuous=(self.dispatcher.stats()
+                            if self.dispatcher is not None else None),
                 fleet=fleet,
                 tenants={tid: t.stats()
                          for tid, t in sorted(self.tenants.items())},

@@ -130,6 +130,29 @@ class MicroBatchScheduler:
         self.pending.clear()
         return batch
 
+    def ready(self) -> List[WindowBuffer]:
+        """Sealed windows awaiting solve, oldest first (pending then
+        spill) — the continuous-batching scheduler's admission view: it
+        PICKS windows (SLO-at-risk first, then batch-fill by size
+        class) instead of draining whole queues."""
+        return list(self.pending) + list(self.spill)
+
+    def take(self, bufs: List[WindowBuffer]) -> List[WindowBuffer]:
+        """Remove exactly the given buffers from the queues (identity
+        match) and return them in the given admission order — the
+        consume half of :meth:`ready`. Buffers no longer queued (e.g.
+        drained by a concurrent flush) are skipped, so admission races
+        resolve to at-most-once solving."""
+        chosen = {id(b): k for k, b in enumerate(bufs)}
+        taken: List[WindowBuffer] = []
+        for q in (self.pending, self.spill):
+            kept = [b for b in q if id(b) not in chosen]
+            taken.extend(b for b in q if id(b) in chosen)
+            q.clear()
+            q.extend(kept)
+        taken.sort(key=lambda b: chosen[id(b)])
+        return taken
+
     # -- consumer side ----------------------------------------------------
     def _solve_once(self, batch: List[WindowBuffer]) -> List:
         """One solve attempt, under the watchdog when configured. The

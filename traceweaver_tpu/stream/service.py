@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -56,6 +57,11 @@ _OBS_STREAM = _OBS.counter(
 _OBS_SOLVE_S = _OBS.histogram(
     "tw_solve_seconds",
     "micro-batch solve wall time (stream + serve pump dispatches)")
+_OBS_SEAL_EMIT_S = _OBS.histogram(
+    "tw_seal_emit_seconds",
+    "per-window seal→emit latency (the quantity the continuous-batching "
+    "SLO TW_SERVE_SLO_P99_MS bounds at p99)",
+    labels=("tenant",))
 
 
 @dataclass
@@ -75,6 +81,12 @@ class StreamConfig:
     checkpoint_path: Optional[str] = None
     checkpoint_every: int = 8      # emitted windows between checkpoints
     verbose: bool = True
+    # seal→emit latency SLO (ms, p99). None = pure batch-fill pacing
+    # (the historical behavior). When set, the run loop admits a
+    # backlog below solve_min_batch anyway once a sealed window's age
+    # crosses half the budget — the single-tenant form of the serve
+    # layer's continuous-batching admission (serve/continuous.py).
+    slo_p99_ms: Optional[float] = None
     # robustness (docs/ROBUSTNESS.md): dead-letter sidecar for poison
     # windows (default: <sink>.deadletter.jsonl when a sink is set),
     # micro-batch watchdog timeout + bounded retry
@@ -198,6 +210,11 @@ class StreamingReconstructor:
         # whole path is inert under TW_CONFIDENCE=0
         self.drift = _quality.ConfidenceDrift() \
             if _quality.conf_enabled() else None
+        # seal→emit latencies of recent emitted windows (seconds; the
+        # live p99 the continuous-batching SLO is graded against —
+        # bounded so a long-lived tenant tracks RECENT latency, not its
+        # whole history)
+        self.seal_emit_lat_s = deque(maxlen=512)
         # score-path precision (TW_PRECISION, read at service start) —
         # labels every micro-batch/window line and rides the checkpoint
         # so a resume under a DIFFERENT precision is visible, not silent
@@ -609,6 +626,13 @@ class StreamingReconstructor:
                 rec["tw.confidence"] = conf
             self.sink.write_line(json.dumps(rec, sort_keys=True))
         self.emitted_windows += 1
+        sealed_wall = getattr(buf, "sealed_wall", 0.0)
+        if sealed_wall:
+            # the SLO quantity: wall time from seal to emission (queue
+            # wait + admission + solve + decode), per tenant
+            lat = max(0.0, time.monotonic() - sealed_wall)
+            self.seal_emit_lat_s.append(lat)
+            _OBS_SEAL_EMIT_S.observe(lat, tenant=self._conf_tenant())
         tr = _selftrace.active()
         if tr is not None:
             tr.finish(self._trace_key(buf.k))
@@ -637,6 +661,31 @@ class StreamingReconstructor:
     def _bump(self, key: str, n: float = 1) -> None:
         _OBS_STREAM.inc(n, key=key)
         self.stats[key] = self.stats.get(key, 0) + n
+
+    def seal_emit_p99_ms(self) -> Optional[float]:
+        """p99 of the recent seal→emit latencies (ms; None before the
+        first emission) — the number the continuous-batching SLO
+        (``TW_SERVE_SLO_P99_MS``) is graded against."""
+        if not self.seal_emit_lat_s:
+            return None
+        return float(np.percentile(
+            np.asarray(self.seal_emit_lat_s, dtype=np.float64), 99)) * 1e3
+
+    def _slo_pressure(self) -> bool:
+        """Is any sealed window's age past half the seal→emit SLO
+        budget? The single-tenant admission rule: a quiet stream must
+        not hold a sealed window hostage to batch fill
+        (``StreamConfig.slo_p99_ms``; inert when unset)."""
+        if not self.cfg.slo_p99_ms:
+            return False
+        ready = self.scheduler.ready()
+        if not ready:
+            return False
+        now = time.monotonic()
+        budget_s = self.cfg.slo_p99_ms / 2e3
+        return any(
+            now - (getattr(b, "sealed_wall", 0.0) or now) >= budget_s
+            for b in ready)
 
     # -- self-tracing hooks (obs/selftrace.py; all no-ops when no tracer
     # is installed — one global read per call) ---------------------------
@@ -783,6 +832,12 @@ class StreamingReconstructor:
                 state["conf_drift"])
         svc.stats = state["stats"]
         svc.fleet_stats = state["fleet_stats"]
+        # checkpointed seal stamps are time.monotonic() values from the
+        # DEAD process — meaningless here; re-stamp at resume so the
+        # SLO admission doesn't read the restart gap as queue age
+        now = time.monotonic()
+        for buf in list(state["pending"]) + list(state["spill"]):
+            buf.sealed_wall = now
         svc.scheduler.pending.extend(state["pending"])
         svc.scheduler.spill.extend(state["spill"])
         counters = state["scheduler_counters"]
@@ -834,7 +889,8 @@ class StreamingReconstructor:
             self._trace_seal(sealed)
             for buf in sealed:
                 self.scheduler.offer(buf)
-            if self.scheduler.backlog >= c.solve_min_batch:
+            if self.scheduler.backlog >= c.solve_min_batch \
+                    or self._slo_pressure():
                 for res in self.scheduler.pump():
                     self._emit(res)
             if sealed and c.prune:
@@ -918,7 +974,20 @@ class StreamingReconstructor:
                     self.fleet_stats.get("d2h_bytes_fetched", 0.0)),
                 d2h_bytes_flags=float(
                     self.fleet_stats.get("d2h_bytes_flags", 0.0)),
+                # H2D split (docs/PERF.md "Device-resident span
+                # columns"): shipped host tensors vs resident-ring
+                # appends vs gather index arrays — a TW_DEVCOLS run
+                # must show ring+index traffic, never a silent zero
+                h2d_bytes_shipped=float(
+                    self.fleet_stats.get("h2d_bytes_shipped", 0.0)),
+                h2d_bytes_ring=float(
+                    self.fleet_stats.get("h2d_bytes_ring", 0.0)),
+                h2d_bytes_index=float(
+                    self.fleet_stats.get("h2d_bytes_index", 0.0)),
+                devcols_fallbacks=int(
+                    self.fleet_stats.get("devcols_fallbacks", 0)),
             ),
+            seal_emit_p99_ms=self.seal_emit_p99_ms(),
         )
         if final and self.grader is not None:
             out["accuracy"] = self.grader.finish()

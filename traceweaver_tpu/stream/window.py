@@ -26,6 +26,7 @@ spans up to that late still land in their own window.
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Set, Tuple
 
@@ -48,6 +49,12 @@ class WindowBuffer:
     roots: List[Span] = field(default_factory=list)
     # stamped at seal time by the engine: watermark delay when sealed
     seal_delay_us: float = 0.0
+    # wall clock (time.monotonic) at seal time — the start of the
+    # seal→emit latency the continuous-batching scheduler trades
+    # against batch-fill efficiency (TW_SERVE_SLO_P99_MS); 0.0 on
+    # buffers restored from pre-SLO checkpoints (their latency is
+    # unknowable and is not counted)
+    sealed_wall: float = 0.0
 
     def add(self, span: Span, owned: bool) -> None:
         self.spans.append(span)
@@ -141,11 +148,13 @@ class WindowingEngine:
         window now sealed, in window order."""
         self.sealed_frontier_us = max(self.sealed_frontier_us, watermark_us)
         sealed = []
+        now = time.monotonic()
         for k in sorted(self.open):
             if self._is_sealed(k):
                 buf = self.open.pop(k)
                 buf.seal_delay_us = max(
                     0.0, self.sealed_frontier_us - buf.end_us)
+                buf.sealed_wall = now
                 sealed.append(buf)
         return sealed
 
@@ -153,6 +162,8 @@ class WindowingEngine:
         """End of stream: seal every remaining window in order."""
         self.sealed_frontier_us = float("inf")
         out = [self.open.pop(k) for k in sorted(self.open)]
+        now = time.monotonic()
         for buf in out:
             buf.seal_delay_us = 0.0
+            buf.sealed_wall = now
         return out
