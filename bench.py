@@ -962,6 +962,234 @@ def run_continuous_leg(n_tenants: int) -> dict:
     return report
 
 
+def adapt_fields(shift_at: int, slo: dict, ctrl: dict,
+                 adapted: dict) -> dict:
+    """Chaos-adapt leg ledgers -> report fields (unit-tested like
+    chaos_fields/serve_fields, tests/test_bench.py).
+
+    ``ctrl``/``adapted`` summarize one replay each of the SAME shifted
+    corpus (``TW_ADAPT=0`` control vs ``TW_ADAPT=1``): window-accuracy
+    means ``pre`` (before the shift) and ``tail`` (the post-adaptation
+    tail), the drift/adaptation ledgers, and the final PSI. The
+    headline triple: the adapted leg's tail must return to within 1
+    accuracy POINT of its own pre-shift ledger, the control leg's tail
+    must stay degraded (>= 10 points under pre — proving the
+    controller, not noise, recovered it), and the drift gauge must
+    re-arm (final PSI back under the alert threshold)."""
+    pre = adapted.get("pre")
+    tail = adapted.get("tail")
+    ctrl_tail = ctrl.get("tail")
+    pts = lambda a, b: (round((a - b) * 100.0, 2)  # noqa: E731
+                        if a is not None and b is not None else None)
+    return {
+        "adapt_shift_window": int(shift_at),
+        "adapt_windows": int(adapted.get("windows", 0)),
+        "adapt_pre_acc": pre,
+        "adapt_tail_acc": tail,
+        "adapt_tail_acc_control": ctrl_tail,
+        "adapt_recovery_gap_pts": pts(pre, tail),
+        "adapt_control_degradation_pts": pts(ctrl.get("pre"), ctrl_tail),
+        "adapt_recovered_within_1pt": (
+            bool(pts(pre, tail) is not None and pts(pre, tail) <= 1.0)),
+        "adapt_control_stays_degraded": (
+            bool(pts(ctrl.get("pre"), ctrl_tail) is not None
+                 and pts(ctrl.get("pre"), ctrl_tail) >= 10.0)),
+        "adapt_drift_alerts": int(adapted.get("drift_alerts", 0)),
+        "adapt_drift_alerts_control": int(ctrl.get("drift_alerts", 0)),
+        "adapt_refits": int(adapted.get("refits", 0)),
+        "adapt_refits_control": int(ctrl.get("refits", 0)),
+        "adapt_fallbacks": int(adapted.get("fallbacks", 0)),
+        "adapt_final_psi": adapted.get("final_psi"),
+        "adapt_psi_threshold": float(slo.get("psi_threshold", 0.25)),
+        "adapt_gauge_rearmed": (
+            bool(adapted.get("final_psi") is not None
+                 and adapted["final_psi"]
+                 <= slo.get("psi_threshold", 0.25))),
+        "adapt_steady_compiles": int(adapted.get("steady_compiles", 0)),
+        "adapt_actions": adapted.get("actions"),
+    }
+
+
+def _adapt_burst_events(n_bursts: int, shift_at: int, n_req: int = 8,
+                        gap_us: float = 800.0, pre_delay: float = 150.0,
+                        post_delay: float = 950.0, seed: int = 7):
+    """The chaos-adapt corpus: bursty frontend->search traffic whose
+    call latency SWAPS distributions mid-stream (the injected workload
+    shift). Geometry chosen so the shift poisons the warm-start
+    feedback loop: post-shift delay ≈ one inter-arrival gap + the old
+    delay, so under the STALE priors every call matches its
+    neighbor's request perfectly (slot aliasing), the per-burst
+    cache-hit request donates the skip that makes the wrong matching
+    total, and the aliased assignment's delay samples re-teach the
+    stale prior — a self-consistent wrong equilibrium that never
+    heals on its own (the control leg proves it). A cold
+    order-statistics refit sees the true shifted delay and breaks the
+    loop (adapt/refit.py)."""
+    import numpy as np
+
+    from traceweaver_tpu.spans import Span
+    from traceweaver_tpu.stream.sources import SpanEvent
+
+    rng = np.random.default_rng(seed)
+    procs = {"p1": "frontend", "p2": "search"}
+    events = []
+    for b in range(n_bursts):
+        base = b * 1e6 + 1000.0
+        delay = pre_delay if b < shift_at else post_delay
+        for i in range(n_req):
+            t = base + i * gap_us
+            tid = f"b{b:03d}r{i:02d}"
+            d = delay + float(rng.integers(-20, 21))
+            spans = [Span(tid, "root", t, 2600.0, "req", [], "p1",
+                          "server")]
+            if i < n_req - 1:  # the burst's last request is a cache hit
+                spans += [
+                    Span(tid, "c", t + d, 150.0, "call",
+                         [(tid, "root")], "p1", "client"),
+                    Span(tid, "s", t + d + 10, 100.0, "search",
+                         [(tid, "c")], "p2", "server"),
+                ]
+            for sp in spans:
+                events.append(SpanEvent(
+                    span=sp, event_us=float(sp.start_mus),
+                    arrival_us=float(sp.start_mus), trace_id=tid,
+                    processes=procs))
+    events.sort(key=lambda e: (e.arrival_us, e.trace_id, e.span.sid))
+    return events, n_req
+
+
+def run_adapt_leg(n_bursts: int) -> dict:
+    """bench.py --chaos-adapt N: the drift→adapt recovery leg.
+
+    Replays the shifted corpus twice through the single-tenant stream
+    service — once with ``TW_ADAPT=0`` (control) and once with
+    ``TW_ADAPT=1`` — and grades every emitted window's frontend→search
+    assignment against the generator's ground truth (a call belongs to
+    its own trace's request; the cache-hit request takes the skip).
+    Asserts the full recovery story from the ledgers: the PSI drift
+    alert fires, an out-of-band refit lands, the adapted tail returns
+    to within 1 point of the pre-shift accuracy, the drift gauge
+    re-arms — and the control replay of the IDENTICAL corpus stays
+    degraded, so the recovery is the controller's doing, not noise."""
+    import jax
+
+    if _knobs.get("TW_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("TW_RETRY_BACKOFF_S", "0")
+    # the leg's drift window: small enough that the reference freezes
+    # and the rolling window matures inside the replay (the default 256
+    # is sized for production streams)
+    os.environ.setdefault("TW_CONF_DRIFT_WINDOW", "64")
+    shift_at = max(4, n_bursts // 2)
+    tail_n = max(6, n_bursts // 6)
+
+    def one_run(adapt_on: bool) -> dict:
+        import numpy as np
+
+        from traceweaver_tpu.runtime.jax_cache import (
+            compile_counters,
+            counters_delta,
+        )
+        from traceweaver_tpu.stream.service import (
+            StreamConfig,
+            StreamingReconstructor,
+            TraceSink,
+        )
+        from traceweaver_tpu.stream.sources import IterableSource
+
+        os.environ["TW_ADAPT"] = "1" if adapt_on else "0"
+        events, n_req = _adapt_burst_events(n_bursts, shift_at)
+        sink_path = os.path.join(
+            tempfile.mkdtemp(prefix="tw_adapt_"), "out.jsonl")
+        cfg = StreamConfig(window_us=1e6, overlap_us=0.0,
+                           ooo_bound_us=1e3, checkpoint_every=10_000,
+                           verbose=False)
+        svc = StreamingReconstructor(IterableSource(events), cfg,
+                                     sink=TraceSink(sink_path))
+        compiles0 = compile_counters()
+        summary = svc.run()
+        compiles = counters_delta(compiles0)["backend_compiles"]
+
+        skip_sid = "r%02d" % (n_req - 1)
+        accs = {}
+        with open(sink_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                rows = rec.get("services", {}).get(
+                    "frontend", {}).get("search", [])
+                if not rows:
+                    continue
+                ok = 0
+                for in_id, out_id in rows:
+                    is_real = (isinstance(out_id, list)
+                               and str(out_id[0]).startswith("b"))
+                    if in_id[0].endswith(skip_sid):
+                        ok += not is_real       # truth: skip (cache hit)
+                    else:
+                        ok += is_real and out_id[0] == in_id[0]
+                accs[rec["window"]] = ok / len(rows)
+
+        pre = [accs[k] for k in sorted(accs) if k < shift_at]
+        tail = [accs[k] for k in sorted(accs)[-tail_n:]]
+        return dict(
+            windows=len(accs),
+            pre=round(float(np.mean(pre)), 4) if pre else None,
+            tail=round(float(np.mean(tail)), 4) if tail else None,
+            drift_alerts=summary["confidence"]["drift_alerts"],
+            refits=summary["adapt"].get("refits_done", 0),
+            fallbacks=summary["adapt"].get("fallbacks", 0),
+            actions={k: summary["adapt"][k]
+                     for k in ("refits_scheduled", "refits_done",
+                               "refits_failed", "fallbacks", "restores",
+                               "recoveries")}
+            if summary["adapt"].get("enabled") else None,
+            final_psi=(round(svc.drift.last_psi("frontend"), 4)
+                       if svc.drift and svc.drift.last_psi("frontend")
+                       is not None else None),
+            # compiles AFTER the adaptation landed must be zero: the
+            # refit is the hot path's own warm program (measured over
+            # the whole run minus the cold-start classes is noisy on a
+            # short replay, so report the raw count for the record)
+            steady_compiles=compiles,
+        )
+
+    log(f"chaos-adapt leg: {n_bursts} windows, shift at {shift_at}; "
+        "control replay (TW_ADAPT=0)")
+    # twlint: disable=TW001 — raw env save/restore around the leg's two
+    # replays (each replay SETS TW_ADAPT), not a knob read
+    prev = os.environ.get("TW_ADAPT")
+    try:
+        ctrl = one_run(False)
+        log("chaos-adapt leg: control pre=%s tail=%s alerts=%d; "
+            "adapted replay (TW_ADAPT=1)"
+            % (ctrl["pre"], ctrl["tail"], ctrl["drift_alerts"]))
+        adapted = one_run(True)
+    finally:
+        if prev is None:
+            os.environ.pop("TW_ADAPT", None)
+        else:
+            os.environ["TW_ADAPT"] = prev
+    report = adapt_fields(
+        shift_at,
+        dict(psi_threshold=_knobs.get_float("TW_CONF_DRIFT_PSI")),
+        ctrl, adapted)
+    report["mode"] = "chaos_adapt"
+    log("chaos-adapt leg: adapted pre=%s tail=%s (gap %s pts, "
+        "within-1pt=%s) vs control tail=%s (degraded=%s); alerts=%d "
+        "refits=%d gauge_rearmed=%s"
+        % (adapted["pre"], adapted["tail"],
+           report["adapt_recovery_gap_pts"],
+           report["adapt_recovered_within_1pt"], ctrl["tail"],
+           report["adapt_control_stays_degraded"],
+           report["adapt_drift_alerts"], report["adapt_refits"],
+           report["adapt_gauge_rearmed"]))
+    if not (report["adapt_recovered_within_1pt"]
+            and report["adapt_control_stays_degraded"]):
+        log("chaos-adapt leg: WARNING — recovery story incomplete "
+            "(see adapt_* fields)")
+    return report
+
+
 def confidence_fields(conf_maps) -> dict:
     """Per-span confidence ledger -> report fields (unit-tested like
     chaos_fields/serve_fields, tests/test_bench.py).
@@ -1943,6 +2171,17 @@ if __name__ == "__main__":
                          "sustained spans/s, per-tenant seal→emit p99 "
                          "vs TW_SERVE_SLO_P99_MS, and the steady-state "
                          "compile count (must be 0)")
+    ap.add_argument("--chaos-adapt", type=int, nargs="?", const=60,
+                    default=None, metavar="N",
+                    help="standalone drift→adapt recovery leg: replay "
+                         "an N-window synthetic corpus whose call-"
+                         "latency distribution swaps mid-stream, once "
+                         "under TW_ADAPT=0 (control) and once under "
+                         "TW_ADAPT=1; asserts the PSI alert fires, an "
+                         "out-of-band refit lands, post-adapt accuracy "
+                         "returns to within 1 pt of the pre-shift "
+                         "ledger, the drift gauge re-arms, and the "
+                         "control replay stays degraded")
     ap.add_argument("--scorecard", type=int, nargs="?", const=48,
                     default=None, metavar="N",
                     help="standalone per-regime scorecard leg: all five "
@@ -1974,6 +2213,14 @@ if __name__ == "__main__":
     if args.continuous:
         continuous_report = run_continuous_leg(args.continuous)
         line = json.dumps(continuous_report)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        sys.exit(0)
+    if args.chaos_adapt:
+        adapt_report = run_adapt_leg(args.chaos_adapt)
+        line = json.dumps(adapt_report)
         if args.out:
             with open(args.out, "w") as f:
                 f.write(line + "\n")

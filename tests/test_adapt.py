@@ -1,0 +1,383 @@
+"""Drift→adapt online-adaptation tests (tier-1, CPU — ISSUE 12).
+
+Contracts covered (docs/ROBUSTNESS.md "The adaptation ladder"):
+
+- controller ladder walk: excursion → refit scheduled (once — cooldown
+  hysteresis) → probation → recovery, or probation expiry → wide-prior
+  fallback → cooldown-spaced retry / restore; every actuation lands in
+  the metrics registry AND the TW_EVENTS sink (no silent transitions);
+- ``TW_ADAPT=0`` (default) is fully inert: no controller on the stream
+  service, summaries say so, and nothing actuates;
+- the out-of-band refit executes against retained window material,
+  installs fresh carried statistics, and is at-most-once per schedule
+  within a process;
+- the chaos-adapt recovery story end to end on the bench corpus: the
+  injected latency swap degrades the control replay permanently, the
+  adapted replay recovers to within 1 point of its pre-shift accuracy,
+  and the drift gauge re-arms;
+- checkpoint round-trip of drift-watcher + controller state UNDER THE
+  FAULT INJECTOR: kill mid-probation at ``TW_FAULTS=checkpoint:0.2``,
+  resume, no duplicate refit, no lost fallback;
+- SLO-breach telemetry: one counted + evented excursion when the
+  seal→emit p99 crosses the budget.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from traceweaver_tpu.adapt import AdaptationController, adapt_enabled
+from traceweaver_tpu.obs import events as obs_events
+
+pytestmark = pytest.mark.adapt
+
+
+def _ctrl(**kw):
+    base = dict(psi_threshold=0.25, low_rate=0.5, probation=2,
+                cooldown_s=1000.0)
+    base.update(kw)
+    return AdaptationController(**base)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# knobs + inertness
+# ---------------------------------------------------------------------------
+
+def test_adapt_knobs_registered_and_default_off():
+    from traceweaver_tpu.runtime import knobs
+
+    for name in ("TW_ADAPT", "TW_ADAPT_COOLDOWN_S", "TW_ADAPT_PROBATION",
+                 "TW_ADAPT_LOW_RATE"):
+        assert name in knobs.REGISTRY
+    assert knobs.get_bool("TW_ADAPT") is False
+    assert adapt_enabled() is False
+
+
+def test_stream_service_inert_without_tw_adapt(monkeypatch):
+    monkeypatch.delenv("TW_ADAPT", raising=False)
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+    )
+
+    svc = StreamingReconstructor(None, StreamConfig(verbose=False))
+    assert svc.adapt is None
+    assert svc.maybe_adapt() == 0
+    assert svc._summary(final=False)["adapt"] == dict(enabled=False)
+
+
+# ---------------------------------------------------------------------------
+# controller ladder (unit, injected clock)
+# ---------------------------------------------------------------------------
+
+def test_ladder_refit_probation_recovery_and_cooldown():
+    clock = _Clock()
+    c = _ctrl(clock=clock)
+    # excursion by PSI schedules a refit, once
+    assert c.observe("k", psi=0.6, low_rate=0.0) == "refit_pending"
+    assert c.observe("k", psi=0.6, low_rate=0.0) == "refit_pending"
+    assert c.pending_refits() == ["k"]
+    assert c.begin_refit("k") and not c.begin_refit("k")  # at-most-once
+    c.refit_done("k", ok=True)
+    # still in excursion through probation window 1 of 2
+    assert c.observe("k", psi=0.6) == "probation"
+    # recovery inside probation re-arms with a cooldown
+    assert c.observe("k", psi=0.05) == "healthy"
+    assert c.recoveries == 1 and c.refits_done == 1
+    # a fresh excursion inside the cooldown is held (hysteresis)
+    assert c.observe("k", psi=0.9) == "healthy"
+    assert c.pending_refits() == []
+    # ... and fires again once the cooldown elapses
+    clock.t += 2000.0
+    assert c.observe("k", psi=0.9) == "refit_pending"
+
+
+def test_ladder_probation_expiry_falls_back_and_restores():
+    clock = _Clock()
+    c = _ctrl(clock=clock)
+    c.observe("k", psi=0.6)
+    c.begin_refit("k")
+    c.refit_done("k", ok=True)
+    assert not c.fallback_active("k")
+    # excursion persists through the whole probation window: fallback
+    assert c.observe("k", low_rate=0.9) == "probation"
+    assert c.observe("k", low_rate=0.9) == "fallback"
+    assert c.fallback_active("k") and c.fallbacks == 1
+    # wide-prior override while fallen back; reversible on recovery
+    assert c.warm_dists("k", {"edge": 1}) == {}
+    assert c.observe("k", psi=0.05, low_rate=0.0) == "healthy"
+    assert not c.fallback_active("k") and c.restores == 1
+    assert c.warm_dists("k", {"edge": 1}) == {"edge": 1}
+
+
+def test_fallback_retry_is_cooldown_spaced_and_sticky():
+    clock = _Clock()
+    c = _ctrl(clock=clock, cooldown_s=100.0)
+    c.observe("k", psi=0.6)
+    c.begin_refit("k")
+    c.refit_done("k", ok=False)   # refit died: straight to fallback
+    assert c.fallback_active("k") and c.refits_failed == 1
+    # still in excursion before the retry period: no new refit
+    assert c.observe("k", psi=0.6) == "fallback"
+    assert c.pending_refits() == []
+    clock.t += 101.0
+    assert c.observe("k", psi=0.6) == "refit_pending"
+    # wide priors STAY in force through the retry refit
+    assert c.fallback_active("k")
+    assert c.warm_dists("k", {"edge": 1}) == {}
+    c.begin_refit("k")
+    c.refit_done("k", ok=True)    # landing lifts the fallback
+    assert not c.fallback_active("k")
+
+
+def test_every_actuation_is_evented_and_counted(tmp_path):
+    from traceweaver_tpu.obs.registry import get_registry
+
+    log = obs_events.EventLog(str(tmp_path / "events.jsonl"))
+    prev = obs_events.install(log)
+    try:
+        c = _ctrl(probation=1)
+        c.observe("svcA", psi=0.9)
+        c.begin_refit("svcA")
+        c.refit_done("svcA", ok=True)
+        c.observe("svcA", low_rate=1.0)     # probation expiry → fallback
+        c.observe("svcA", psi=0.0, low_rate=0.0)  # restore
+    finally:
+        obs_events.install(prev)
+    recs = [json.loads(line)
+            for line in open(log.path) if line.strip()]
+    adapt_events = [r["event"] for r in recs if r["kind"] == "adapt"]
+    assert adapt_events == ["refit", "refit_done", "fallback", "restore"]
+    assert all(r["key"] == "svcA" for r in recs if r["kind"] == "adapt")
+    # the metrics registry saw the same actuations, labelled per rung
+    snap = get_registry().snapshot()
+    series = [k for k in snap
+              if k.startswith("tw_adapt_actions_total{")
+              and 'service="svcA"' in k]
+    assert series
+    for rung in ("refit", "refit_done", "fallback", "restore"):
+        assert any('rung="%s"' % rung in k for k in series), (rung, series)
+
+
+def test_controller_state_roundtrip_restamps_clocks():
+    clock = _Clock()
+    c = _ctrl(clock=clock, cooldown_s=50.0)
+    c.observe("a", psi=0.9)              # refit_pending
+    c.begin_refit("a")                   # refitting: saves as pending
+    c.observe("b", psi=0.9)
+    c.begin_refit("b")
+    c.refit_done("b", ok=True)           # probation
+    c.observe("f", psi=0.9)
+    c.begin_refit("f")
+    c.refit_done("f", ok=False)          # fallback, retry in 50 s
+    clock.t += 20.0
+    clock2 = _Clock()
+    c2 = AdaptationController.from_state(c.state(), clock=clock2)
+    rungs = c2.summary()["rungs"]
+    assert rungs == {"a": "refit_pending", "b": "probation",
+                     "f": "fallback"}
+    assert c2.fallback_active("f") and not c2.fallback_active("b")
+    # remaining retry duration survived the re-stamp: 30 s left
+    assert c2.observe("f", psi=0.9) == "fallback"
+    clock2.t += 31.0
+    assert c2.observe("f", psi=0.9) == "refit_pending"
+    assert c2.summary()["generations"] == {"b": 1}
+
+
+# ---------------------------------------------------------------------------
+# stream integration: the chaos-adapt recovery story
+# ---------------------------------------------------------------------------
+
+def _run_leg(monkeypatch, n_bursts=44):
+    import bench
+
+    monkeypatch.setenv("TW_CONF_DRIFT_WINDOW", "64")
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    monkeypatch.setenv("TW_BACKEND", "cpu")
+    return bench.run_adapt_leg(n_bursts)
+
+
+def test_chaos_adapt_recovery_story(monkeypatch):
+    """The acceptance pin (small corpus; the artifact runs N=60): the
+    PSI alert fires, a refit lands, the adapted tail returns to within
+    1 pt of the pre-shift ledger, the gauge re-arms — and the control
+    replay of the IDENTICAL corpus stays degraded, so the controller
+    (not noise) recovered it."""
+    report = _run_leg(monkeypatch, n_bursts=60)
+    assert report["adapt_drift_alerts"] >= 1
+    assert report["adapt_refits"] >= 1
+    assert report["adapt_refits_control"] == 0
+    assert report["adapt_recovered_within_1pt"], report
+    assert report["adapt_control_stays_degraded"], report
+    assert report["adapt_gauge_rearmed"], report
+
+
+def test_refit_installs_fresh_statistics_and_is_out_of_band(monkeypatch):
+    """Unit form of the refit rung: schedule a refit on a healthy
+    stream via a forced excursion and assert the executor re-fits the
+    retained window (carried statistics replaced, evented) without a
+    pump in sight — and that an already-begun refit cannot run twice."""
+    monkeypatch.setenv("TW_ADAPT", "1")
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    import bench
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+    )
+    from traceweaver_tpu.stream.sources import IterableSource
+
+    events, _ = bench._adapt_burst_events(8, shift_at=99)
+    cfg = StreamConfig(window_us=1e6, overlap_us=0.0, ooo_bound_us=1e3,
+                       checkpoint_every=10_000, verbose=False)
+    svc = StreamingReconstructor(IterableSource(events), cfg)
+    svc.run()
+    assert svc.adapt is not None
+    assert "frontend" in svc.adapt_material
+    before = svc.carried.get("frontend")
+    assert before is not None
+    svc.adapt.observe("frontend", psi=9.9, low_rate=1.0)
+    assert svc.maybe_adapt() == 1
+    assert svc.stats.get("adapt_refits") == 1
+    after = svc.carried.get("frontend")
+    assert after is not None and after is not before
+    assert svc.adapt.summary()["rungs"]["frontend"] == "probation"
+    # the schedule was consumed: nothing pending, nothing re-runs
+    assert svc.maybe_adapt() == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip under the fault injector
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_probation_resume_no_duplicate_refit_no_lost_fallback(
+        monkeypatch, tmp_path):
+    """The ISSUE's checkpoint contract: kill mid-probation under
+    ``TW_FAULTS=checkpoint:0.2`` (some checkpoint writes fail, counted,
+    last good generation survives), resume, and assert the resumed
+    controller (a) does NOT re-run the completed refit and (b) still
+    holds an active fallback taken before the kill."""
+    monkeypatch.setenv("TW_ADAPT", "1")
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    import bench
+    from traceweaver_tpu.runtime import faults
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+    )
+    from traceweaver_tpu.stream.sources import IterableSource
+
+    ckpt = str(tmp_path / "ckpt.pkl")
+    events, _ = bench._adapt_burst_events(8, shift_at=99)
+    cfg = StreamConfig(window_us=1e6, overlap_us=0.0, ooo_bound_us=1e3,
+                       checkpoint_path=ckpt, checkpoint_every=10_000,
+                       verbose=False)
+    svc = StreamingReconstructor(IterableSource(events), cfg)
+    svc.run()
+    # walk svcA (= frontend) to MID-PROBATION and a second key into
+    # FALLBACK, then checkpoint under injected checkpoint faults
+    svc.adapt.observe("frontend", psi=9.9)
+    assert svc.maybe_adapt() == 1                       # refit lands
+    assert svc.adapt.summary()["rungs"]["frontend"] == "probation"
+    svc.adapt.observe("ghost", psi=9.9)
+    svc.adapt.begin_refit("ghost")
+    svc.adapt.refit_done("ghost", ok=False)             # fallback
+    refits_before = svc.adapt.refits_done
+    monkeypatch.setenv("TW_FAULTS", "checkpoint:0.2")
+    monkeypatch.setenv("TW_FAULTS_SEED", "3")
+    faults.reset()
+    try:
+        for _ in range(6):   # p=0.2: failures counted, a write lands
+            svc._checkpoint()
+        assert os.path.exists(ckpt)
+    finally:
+        # KILL under faults; the restarted process has a fresh env
+        monkeypatch.delenv("TW_FAULTS", raising=False)
+        faults.reset()
+    resumed = StreamingReconstructor.resume(ckpt, IterableSource(events))
+    rungs = resumed.adapt.summary()["rungs"]
+    assert rungs["frontend"] == "probation"     # refit NOT re-pending
+    assert rungs["ghost"] == "fallback"         # fallback NOT lost
+    assert resumed.adapt.fallback_active("ghost")
+    assert resumed.adapt.warm_dists("ghost", {"e": 1}) == {}
+    assert resumed.adapt.refits_done == refits_before
+    # no duplicate refit: nothing pending, the executor is a no-op
+    assert resumed.adapt.pending_refits() == []
+    assert resumed.maybe_adapt() == 0
+    # the drift watcher rode the same checkpoint
+    assert resumed.drift is not None
+    assert resumed.drift.state()["ref"].keys() \
+        == svc.drift.state()["ref"].keys()
+
+
+# ---------------------------------------------------------------------------
+# SLO-breach telemetry
+# ---------------------------------------------------------------------------
+
+def test_slo_breach_counted_and_evented_once_per_excursion(
+        monkeypatch, tmp_path):
+    import bench
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+    )
+    from traceweaver_tpu.stream.sources import IterableSource
+
+    log = obs_events.EventLog(str(tmp_path / "events.jsonl"))
+    prev = obs_events.install(log)
+    try:
+        events, _ = bench._adapt_burst_events(6, shift_at=99)
+        # an SLO budget no real solve can meet: every window breaches,
+        # but the excursion is armed ONCE until the p99 recovers
+        cfg = StreamConfig(window_us=1e6, overlap_us=0.0,
+                           ooo_bound_us=1e3, checkpoint_every=10_000,
+                           verbose=False, slo_p99_ms=1e-3)
+        svc = StreamingReconstructor(IterableSource(events), cfg)
+        summary = svc.run()
+    finally:
+        obs_events.install(prev)
+    assert summary["slo_breaches"] == 1
+    recs = [json.loads(line) for line in open(log.path) if line.strip()]
+    breaches = [r for r in recs if r["kind"] == "slo_breach"]
+    assert len(breaches) == 1
+    assert breaches[0]["event"] == "excursion"
+    assert breaches[0]["p99_ms"] > breaches[0]["slo_ms"]
+    # the per-tenant counter landed in the registry
+    from traceweaver_tpu.obs.registry import get_registry
+
+    snap = get_registry().snapshot()
+    assert any(k.startswith("tw_slo_breach_total") for k in snap)
+
+
+def test_adapt_fields_ledger():
+    """adapt_fields verdicts, unit-tested like chaos_fields."""
+    import bench
+
+    ctrl = dict(pre=1.0, tail=0.0, drift_alerts=2, refits=0, fallbacks=0)
+    adapted = dict(windows=60, pre=1.0, tail=0.995, drift_alerts=2,
+                   refits=1, fallbacks=0, final_psi=0.13,
+                   steady_compiles=0, actions={"refits_done": 1})
+    f = bench.adapt_fields(30, dict(psi_threshold=0.25), ctrl, adapted)
+    assert f["adapt_recovery_gap_pts"] == 0.5
+    assert f["adapt_recovered_within_1pt"] is True
+    assert f["adapt_control_degradation_pts"] == 100.0
+    assert f["adapt_control_stays_degraded"] is True
+    assert f["adapt_gauge_rearmed"] is True
+    # a failed recovery reads as failed
+    bad = dict(adapted, tail=0.5, final_psi=0.9)
+    f2 = bench.adapt_fields(30, dict(psi_threshold=0.25), ctrl, bad)
+    assert f2["adapt_recovered_within_1pt"] is False
+    assert f2["adapt_gauge_rearmed"] is False

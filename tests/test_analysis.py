@@ -708,6 +708,73 @@ def test_fleet_budget_env_takes_effect_between_two_solves(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# TW010 — adaptation actuation discipline
+# ---------------------------------------------------------------------------
+
+ADAPT = "traceweaver_tpu/adapt/refit.py"
+
+
+def test_tw010_bare_actuation_in_adapt_flagged():
+    findings, _ = lint("""
+        def sneak_refit(svc, material):
+            outs = solve_fleet([material])
+            dists = refit_from_assignments({}, {}, None, outs[0][0], {})
+            svc.carried.update("svc", dists)
+    """, path=ADAPT)
+    assert rules_of(findings).count("TW010") == 2  # both primitives
+
+
+def test_tw010_ledgered_actuation_clean():
+    findings, _ = lint("""
+        def execute(svc, ctrl, key, material):
+            outs = solve_fleet([material])
+            dists = refit_from_assignments({}, {}, None, outs[0][0], {})
+            ctrl.refit_done(key, ok=bool(dists))
+            return dists
+
+        def act_direct(self, key):
+            solve_fleet([])
+            self._act("refit", key)
+    """, path=ADAPT)
+    assert [f for f in findings if f.rule == "TW010"] == []
+
+
+def test_tw010_private_controller_access_outside_adapt_flagged():
+    findings, _ = lint("""
+        def pump(self):
+            self.adapt._keys.clear()
+            svc.adapt._act("refit", "k")
+    """, path="traceweaver_tpu/stream/service.py")
+    # only the CALL is an actuation; the attribute read alone is not
+    assert rules_of(findings).count("TW010") == 1
+
+
+def test_tw010_public_api_and_unrelated_modules_clean():
+    findings, _ = lint("""
+        def pump(self):
+            self.adapt.observe("k", psi=0.5, low_rate=0.0)
+            for key in self.adapt.pending_refits():
+                self.adapt.refit_done(key, ok=True)
+            warm = self.adapt.warm_dists("k", None)
+    """, path="traceweaver_tpu/stream/service.py")
+    assert [f for f in findings if f.rule == "TW010"] == []
+    # solve_fleet outside adapt/ is the ordinary hot path, not an
+    # adaptation actuation
+    findings, _ = lint("""
+        def pump(self):
+            return solve_fleet(self.items)
+    """, path="traceweaver_tpu/serve/tenancy.py")
+    assert [f for f in findings if f.rule == "TW010"] == []
+    # suppression works like every rule
+    findings, suppressed = lint("""
+        def f(svc):
+            # twlint: disable=TW010 — test fixture
+            return solve_fleet([])
+    """, path=ADAPT)
+    assert findings == [] and suppressed == 1
+
+
+# ---------------------------------------------------------------------------
 # CLI plumbing + the tier-1 repo gate
 # ---------------------------------------------------------------------------
 

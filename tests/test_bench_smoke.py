@@ -352,3 +352,51 @@ def test_pipelined_fleet_runs_and_second_solve_is_compile_free():
         f"static-arg leak is multiplying program variants: {delta}")
     for a, b in zip(out1, out2):
         assert a[0] == b[0] and a[1] == b[1] and a[2:] == b[2:]
+
+
+@pytest.mark.adapt
+def test_adapt_smoke_inert_off_and_compile_free_steady_state(
+        monkeypatch, tmp_path):
+    """Tier-1 chaos-adapt smoke (ISSUE 12 acceptance pins): a stable
+    stream under TW_ADAPT=1 must (a) actuate NOTHING (steady state — no
+    refits, no fallbacks), (b) emit BYTE-IDENTICAL sink records to the
+    TW_ADAPT=0 run of the same corpus (the controller only observes),
+    and (c) cost zero backend compiles beyond the TW_ADAPT=0 run's own
+    programs — adaptation arms no new program variants. The full
+    drift→refit→recovery chaos story runs in tests/test_adapt.py."""
+    import bench
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+        TraceSink,
+    )
+    from traceweaver_tpu.stream.sources import IterableSource
+
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+
+    def run(flag, name):
+        monkeypatch.setenv("TW_ADAPT", flag)
+        events, _ = bench._adapt_burst_events(8, shift_at=99)
+        sink = TraceSink(str(tmp_path / name))
+        cfg = StreamConfig(window_us=1e6, overlap_us=0.0,
+                           ooo_bound_us=1e3, checkpoint_every=10_000,
+                           verbose=False)
+        svc = StreamingReconstructor(IterableSource(events), cfg,
+                                     sink=sink)
+        summary = svc.run()
+        sink.close()
+        return (tmp_path / name).read_bytes(), summary
+
+    bytes_off, sum_off = run("0", "off.jsonl")
+    assert sum_off["adapt"] == dict(enabled=False)
+
+    before = compile_counters()
+    bytes_on, sum_on = run("1", "on.jsonl")
+    delta = counters_delta(before)
+    assert bytes_on == bytes_off, (
+        "TW_ADAPT=1 steady state changed emitted records")
+    assert delta["backend_compiles"] == 0, (
+        f"enabled adaptation steady state minted new programs: {delta}")
+    adapt = sum_on["adapt"]
+    assert adapt["enabled"] and adapt["refits_scheduled"] == 0
+    assert adapt["fallbacks"] == 0 and adapt["active_fallbacks"] == []

@@ -331,3 +331,56 @@ def test_stream_slo_pressure_unit():
     svc2 = StreamingReconstructor(None, cfg_off)
     svc2.scheduler.offer(_buf(2, 4, sealed_ago_s=500.0))
     assert svc2._slo_pressure() is False       # knob unset: inert
+
+
+# ---------------------------------------------------------------------------
+# crash containment: the dispatcher thread must degrade, never wedge
+# ---------------------------------------------------------------------------
+
+def test_dispatcher_crash_degrades_to_fixed_pump(tmp_path, warm_programs):
+    """An uncaught exception on the ContinuousDispatcher thread used to
+    die silently with serve still accepting spans (every tenant's
+    seal→emit path wedged). Now: the crash is counted + evented, the
+    degraded gauge flips, the service falls back to the FIXED pump, and
+    tenants keep emitting."""
+    import json as _json
+
+    from traceweaver_tpu.obs import events as obs_events
+    from traceweaver_tpu.obs.registry import get_registry
+
+    log = obs_events.EventLog(str(tmp_path / "events.jsonl"))
+    prev_log = obs_events.install(log)
+    svc = TenantService(_cfg(continuous=True, slo_p99_ms=50.0,
+                             pump_windows=1))
+    real_solve = svc.solve_admitted
+    svc.solve_admitted = lambda plan: (_ for _ in ()).throw(
+        RuntimeError("boom: deliberate dispatcher crash"))
+    try:
+        _feed(svc, n_tenants=2, chunks=2, traces=2)
+        deadline = time.time() + 30
+        while svc.dispatcher is not None and time.time() < deadline:
+            svc.dispatcher.kick()
+            time.sleep(0.02)
+        assert svc.dispatcher is None, "dispatcher crash not contained"
+        st = svc.stats()
+        assert st["dispatcher_degraded"] is True
+        assert st["dispatch"]["dispatcher_crashes"] == 1
+        snap = get_registry().snapshot()
+        assert snap.get("tw_serve_dispatcher_degraded") == 1.0
+        # the solve path heals once the poison is gone: ingest now pumps
+        # inline (fixed-pump mode) and the stranded windows emit
+        svc.solve_admitted = real_solve
+        _feed(svc, n_tenants=2, chunks=2, traces=2)
+        svc.flush()
+        emitted = sum(t["emitted_windows"]
+                      for t in svc.stats()["tenants"].values())
+        assert emitted > 0, "seal→emit path stayed wedged after degrade"
+    finally:
+        obs_events.install(prev_log)
+        svc.drain()
+    recs = [_json.loads(line) for line in open(log.path) if line.strip()]
+    degraded = [r for r in recs
+                if r["kind"] == "serve"
+                and r["event"] == "dispatcher_degraded"]
+    assert len(degraded) == 1
+    assert "boom" in degraded[0]["error"]

@@ -349,3 +349,82 @@ def test_devcols_rides_only_the_columnar_path(monkeypatch):
     monkeypatch.setenv("TW_COLUMNAR", "1")
     host, _ = _solve(monkeypatch, "0", _items(2))
     assert dev == host
+
+
+# ---------------------------------------------------------------------------
+# ring-invalidate-and-rebuild rung (TW_FAULTS=devcols, ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_ring_rebuild_preserves_live_slots_bit_identical():
+    """rebuild() reconstructs the device buffer from the host mirror
+    with slot assignments preserved — in-flight index arrays computed
+    against the old map must gather identical columns afterwards."""
+    ring = devcols.ColumnRing("t", cap=64)
+    a = _cols([100, 200, 300, 400, 500])
+    slots = ring.resolve(a, endpoint="EP0")
+    before = devcols.fetch_resident(ring.buf)
+    shipped = ring.rebuild()
+    after = devcols.fetch_resident(ring.buf)
+    assert shipped == after.nbytes and ring.rebuilds == 1
+    np.testing.assert_array_equal(before[slots], after[slots])
+    # the mapping survived: a re-resolve appends nothing
+    rows_before = ring.appended_rows
+    s2 = ring.resolve(a, endpoint="EP0")
+    np.testing.assert_array_equal(s2, slots)
+    assert ring.appended_rows == rows_before
+
+
+def test_devcols_fault_at_resolve_rebuilds_and_solves_identical(
+        monkeypatch):
+    """A ring-append-site fault (TW_FAULTS=devcols) walks the
+    ring-invalidate-and-rebuild rung: counted (devcols_ring_rebuilds),
+    ledgered on the fault ladder, billed to h2d_bytes_ring, and the
+    solve output is identical to the unfaulted run."""
+    from traceweaver_tpu.runtime import faults
+
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    faults.reset()
+    clean, _ = _solve(monkeypatch, "1", _items(2))
+    devcols.get_store().clear()
+    monkeypatch.setenv("TW_DEVCOLS", "1")
+    stats = {}
+    with faults.override("devcols:1.0:max=1", seed=0):
+        out = solve_fleet(_items(2), stats=stats)
+    faults.reset()
+    key = [(r[0], r[1], r[2], r[3], r[4], r[5]) for r in out]
+    assert key == clean
+    assert stats.get("devcols_ring_rebuilds", 0) >= 2  # both rings
+    assert "ring-rebuild" in stats.get("fault_ladder", [])
+    assert stats.get("faults_injected_devcols", 0) == 1
+    assert stats.get("h2d_bytes_ring", 0) > 0
+
+
+def test_devcols_fault_at_assembly_enters_ladder_with_rebuild(
+        monkeypatch):
+    """A resident-assembly-site fault surfaces from the dispatch
+    attempt, enters the supervisor ladder, rebuilds the rings, and the
+    retry recovers with identical output — a poisoned ring never
+    reaches a later dispatch."""
+    from traceweaver_tpu.runtime import faults
+
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    # serial flow: the draw order (resolve sites first, then the
+    # assembly site) is deterministic without pipeline interleaving
+    monkeypatch.setenv("TW_PIPELINE", "0")
+    faults.reset()
+    clean, _ = _solve(monkeypatch, "1", _items(2))
+    devcols.get_store().clear()
+    stats = {}
+    # draws: per-item resolve checks consume the first draws at p=0;
+    # use max=N at p=1.0 so BOTH a resolve-site and an assembly-site
+    # injection fire across the group's checks
+    with faults.override("devcols:1.0:max=3", seed=0):
+        out = solve_fleet(_items(2), stats=stats)
+    faults.reset()
+    key = [(r[0], r[1], r[2], r[3], r[4], r[5]) for r in out]
+    assert key == clean
+    assert stats.get("faults_injected_devcols", 0) == 3
+    assert stats.get("fault_retries", 0) >= 1       # the ladder engaged
+    ladder = stats.get("fault_ladder", [])
+    assert ladder.count("ring-rebuild") >= 2
+    assert all(r is not None for r in out)

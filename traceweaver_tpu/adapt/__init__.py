@@ -1,0 +1,42 @@
+"""Online adaptation: the drift→adapt control loop (``TW_ADAPT``).
+
+PR 10 built the sensors — per-trace confidence, PSI drift gauges, a
+per-regime calibration scorecard — but nothing *acted* on them: a
+workload shift raised an alert while reconstruction quality silently
+degraded. This package closes the loop. A per-service (per tenant, on
+the serve path) :class:`~traceweaver_tpu.adapt.controller
+.AdaptationController` consumes the drift watcher's PSI excursions and
+the per-window low-confidence rate and walks a degradation-style
+**adaptation ladder**:
+
+1. **refit** — schedule an out-of-band warm-start GMM refit for the
+   drifting service (:mod:`traceweaver_tpu.adapt.refit`): the retained
+   last window re-solves COLD (two-pass EM — the standalone refit
+   dispatch the fleet already owns) and the fresh per-edge statistics
+   replace the stale carried warm state, off the hot pump so SLO
+   dispatches keep flowing;
+2. **fallback** — if confidence does not recover within a probation
+   window, the service's score model falls back to the robust
+   wide-prior configuration (every edge scores under the near-flat
+   Gaussian — no confident-and-wrong assignments from poisoned
+   priors); counted, evented, reversible;
+3. **re-arm** — recovery (and every fallback retry) passes through a
+   hysteresis cooldown (``TW_ADAPT_COOLDOWN_S``) so flapping drift
+   cannot thrash refits.
+
+Every actuation routes through the controller's evented ledger
+(``tw_adapt_actions_total{service,rung}`` + one structured record per
+action in the ``TW_EVENTS`` sink — twlint TW010 mechanizes this), and
+the controller's state (probation timers, active fallbacks, refit
+generations) rides the CRC stream/serve checkpoints so a kill/resume
+mid-adaptation neither repeats a completed refit nor loses an active
+fallback. ``TW_ADAPT=0`` (the default) is fully inert: the sensors
+still alert, nothing actuates, and the dispatched programs stay
+byte-identical. See docs/ROBUSTNESS.md "The adaptation ladder".
+"""
+
+from traceweaver_tpu.adapt import refit  # noqa: F401
+from traceweaver_tpu.adapt.controller import (  # noqa: F401
+    AdaptationController,
+    adapt_enabled,
+)

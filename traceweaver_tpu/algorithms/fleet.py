@@ -862,6 +862,19 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
     # the supervisor engaged (tw-retry/tw-bisect/... stage services)
     rung_keys = sorted({p[1].trace_key for p in spec.group
                         if p[1].trace_key is not None})
+
+    def _maybe_rebuild(e: BaseException) -> None:
+        # ring-invalidate-and-rebuild rung: a devcols-site fault means
+        # the resident arenas can no longer be trusted, and unlike the
+        # transient faults the retry/bisect rungs were built for, a
+        # poisoned ring would corrupt every later dispatch that gathers
+        # from it — rebuild from the host mirrors BEFORE retrying
+        dc = pg.get("devcols_items")
+        if dc and _is_devcols_fault(e):
+            _rebuild_rings([r for it in dc
+                            for r in (it["ring_in"], it["ring_out"])], st)
+
+    _maybe_rebuild(err)
     for attempt in range(retry_max):
         if backoff > 0:
             time.sleep(backoff * (2 ** attempt))
@@ -877,6 +890,7 @@ def _degrade_group(err, solver, pg, spec, results, st, hypers_common, mesh,
             if not _faults.is_transient_fault(e):
                 raise
             err = e
+            _maybe_rebuild(err)
 
     if len(spec.group) > 1:
         # bisect: isolate the offender instead of failing the class
@@ -1071,6 +1085,33 @@ def _solve_groups_pipelined(specs, solver, results, st, hypers_common,
         flow_pool.shutdown(wait=True)
 
 
+def _rebuild_rings(rings, st: _Stats) -> None:
+    """The supervisor's ring-invalidate-and-rebuild rung: each faulted
+    ring's device buffer is reconstructed from its host mirror (slot
+    assignments preserved, so in-flight index arrays stay valid —
+    :meth:`traceweaver_tpu.ops.devcols.ColumnRing.rebuild`), the
+    re-shipped arena is billed to ``h2d_bytes_ring`` (a rebuild must
+    never look free), and the rung lands in the ladder event list, the
+    labelled ladder counter, and the ``TW_EVENTS`` sink like every
+    other supervisor rung."""
+    seen = {}
+    for ring in rings:
+        seen[id(ring)] = ring
+    for ring in seen.values():
+        st.add("h2d_bytes_ring", float(ring.rebuild()))
+    if seen:
+        st.add("devcols_ring_rebuilds", float(len(seen)))
+        st.note("fault_ladder", "ring-rebuild")
+
+
+def _is_devcols_fault(err: BaseException) -> bool:
+    """Did this failure originate at the injector's ``devcols`` site?
+    Only those faults implicate ring contents — a dispatch/fetch fault
+    walks the plain ladder without re-shipping arenas (and without
+    perturbing the pinned ladder ledgers of non-devcols chaos runs)."""
+    return isinstance(err, _faults.FaultError) and "'devcols'" in str(err)
+
+
 def _resolve_group_devcols(group, st: _Stats):
     """Resolve every item of a dispatch group onto its device-resident
     column rings (``TW_DEVCOLS``): per item, the in partition and each
@@ -1093,6 +1134,17 @@ def _resolve_group_devcols(group, st: _Stats):
         ring_in = store.ring(item.tenant, item.svc, "in")
         ring_out = store.ring(item.tenant, item.svc, "out")
         scope = (item.tenant, item.svc)
+        try:
+            # fault site "devcols", ring-append flavor: a failed append
+            # leaves the donated device buffer in an unknown state, and
+            # a poisoned ring would corrupt every LATER dispatch that
+            # gathers from it — so the recovery is not a bare retry but
+            # the ring-invalidate-and-rebuild rung (host mirror → fresh
+            # device buffer, slots preserved), counted and evented,
+            # before the resolve proceeds
+            _fault_check("devcols", st)
+        except _faults.FaultError:
+            _rebuild_rings((ring_in, ring_out), st)
         in_slots = ring_in.resolve(in_cols, ledger=st.add, scope=scope)
         if in_slots is None:
             return None
@@ -1508,6 +1560,13 @@ def _make_assembler(dc_items: List[Dict], batch: Dict, st: _Stats):
     origin_in, origin_out = cat("origin_in"), cat("origin_out")
 
     def assemble(active: Optional[np.ndarray], pad: int) -> Tuple:
+        # fault site "devcols", resident-assembly flavor: raised here it
+        # surfaces from the dispatch attempt and enters the supervisor
+        # ladder, whose first move for a devcols fault is the
+        # ring-invalidate-and-rebuild rung (_degrade_group) — every
+        # retry then re-gathers from a rebuilt, trusted arena
+        _fault_check("devcols", st)
+
         def rows(arr, fill):
             a = arr if active is None else arr[active]
             if pad:

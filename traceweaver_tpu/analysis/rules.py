@@ -23,6 +23,12 @@ Rule ids are stable (baseline entries and suppressions reference them):
   without an explicit f32 accumulator
 - TW007 metric discipline    — counters in fleet/stream/serve grow only
   through the obs-mirrored accumulators
+- TW008 channel layout       — packed-block channel indices come from
+  packed_layout.py only
+- TW009 devcols residency    — ring-resident columns materialize on host
+  only through the ledgered fetch
+- TW010 adapt ledger         — adaptation actuations route through the
+  controller's evented ledger; no silent rung transitions
 """
 
 from __future__ import annotations
@@ -1014,8 +1020,114 @@ class DevcolsResidency(HostSyncHazard):
         return super()._is_device_call(node)
 
 
+# ---------------------------------------------------------------------------
+# TW010 — adaptation actuation discipline
+# ---------------------------------------------------------------------------
+
+class AdaptLedgerDiscipline:
+    """Adaptation actuations route through the evented ledger.
+
+    The drift→adapt controller (``traceweaver_tpu/adapt``, PR 12)
+    closes a CONTROL loop over production traffic: a refit replaces a
+    service's carried score statistics, a fallback swaps its score
+    model for wide priors. An unledgered actuation is a silent state
+    transition — the operator sees reconstruction quality change with
+    no ``tw_adapt_actions_total`` increment and no ``TW_EVENTS`` record
+    explaining why, which is exactly the debugging hole the PR 10
+    sensors were built to close. Two checks:
+
+    - inside ``traceweaver_tpu/adapt/``: a function that calls an
+      actuation primitive (``solve_fleet`` — the out-of-band refit
+      dispatch — or ``refit_from_assignments`` — the statistics
+      install) must also call the evented ledger (``_act`` directly or
+      ``refit_done``, whose body is ledgered) in the same function; a
+      bare refit path cannot land unannounced;
+    - everywhere else: underscore-private controller internals must not
+      be called through an ``.adapt`` receiver — consumers (stream
+      pump, serve dispatcher) drive the controller only through its
+      public, evented API (``observe``/``pending_refits``/
+      ``begin_refit``/``refit_done``/``warm_dists``), so no consumer
+      can flip a rung without the ledger seeing it.
+
+    Narrow by design: ``stream/service.py``'s per-window
+    ``refit_from_assignments`` (the ordinary warm-state refresh) is not
+    an adaptation actuation and is untouched — the primitive check
+    applies only inside ``adapt/``.
+    """
+
+    id = "TW010"
+    title = "adaptation actuation outside the evented ledger"
+
+    ADAPT_DIR = "traceweaver_tpu/adapt/"
+    #: the actuation primitives (refit dispatch + statistics install)
+    ACTUATIONS = {"solve_fleet", "refit_from_assignments"}
+    #: the evented ledger entry points (refit_done's body calls _act)
+    LEDGER = {"_act", "refit_done"}
+
+    @staticmethod
+    def _call_name(node: ast.Call) -> str:
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr
+        if isinstance(node.func, ast.Name):
+            return node.func.id
+        return ""
+
+    def _check_adapt(self, mod: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+
+        def top_functions(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    yield child
+                elif isinstance(child, ast.ClassDef):
+                    yield from top_functions(child)
+
+        for fn in top_functions(mod.tree):
+            actuations = []
+            ledgered = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = self._call_name(node)
+                if name in self.ACTUATIONS:
+                    actuations.append(node)
+                elif name in self.LEDGER:
+                    ledgered = True
+            if actuations and not ledgered:
+                for node in actuations:
+                    findings.append(mod.finding(
+                        self.id, node,
+                        "adaptation actuation primitive outside a "
+                        "ledgered function — every refit/fallback path "
+                        "in adapt/ must land in the evented ledger "
+                        "(_act / refit_done): no silent state "
+                        "transitions (docs/ROBUSTNESS.md)"))
+        return findings
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if self.ADAPT_DIR in mod.path:
+            return self._check_adapt(mod)
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr.startswith("_")
+                    and isinstance(node.func.value, ast.Attribute)
+                    and node.func.value.attr == "adapt"):
+                continue
+            findings.append(mod.finding(
+                self.id, node,
+                "private adaptation-controller internal called outside "
+                "adapt/ — consumers drive the controller through its "
+                "public evented API only (observe/pending_refits/"
+                "begin_refit/refit_done/warm_dists), so every rung "
+                "transition reaches the ledger"))
+        return findings
+
+
 #: registration order == reporting order for same-line findings
 RULE_CLASSES = [KnobDiscipline, ImportTimeFreeze, HostSyncHazard,
                 RecompileDiscipline, LockDiscipline, PrecisionDiscipline,
                 MetricDiscipline, ChannelLayoutDiscipline,
-                DevcolsResidency]
+                DevcolsResidency, AdaptLedgerDiscipline]

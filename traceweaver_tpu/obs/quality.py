@@ -369,6 +369,18 @@ class ConfidenceDrift:
             return None
         return psi(self._ref[key], _bin_counts(cur))
 
+    def mature(self, key: str) -> bool:
+        """Is the rolling current window for ``key`` fully populated?
+        Right after the reference freezes, the rolling distribution is
+        estimated from a handful of values and its PSI is sampling
+        noise, not drift — the GAUGE still exports it (an operator can
+        weigh it), but a CONSUMER that acts on excursions (the
+        adaptation controller) must wait for a full window or it will
+        actuate on noise and burn its hysteresis cooldown before any
+        real shift arrives."""
+        return (key in self._ref
+                and len(self._cur.get(key, ())) >= self.window)
+
     # -- checkpoint plumbing (stream/serve state rides pickles) ----------
     def state(self) -> Dict:
         return dict(window=self.window, threshold=self.threshold,

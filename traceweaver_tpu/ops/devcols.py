@@ -197,8 +197,9 @@ class ColumnRing:
     """
 
     __slots__ = ("key", "cap", "buf", "epoch", "next_seq", "evict_seq",
-                 "slot_of", "host_start", "host_end", "appended_rows",
-                 "appended_bytes", "_ep_table", "_lock")
+                 "slot_of", "host_start", "host_end", "host_ep",
+                 "appended_rows", "appended_bytes", "rebuilds",
+                 "_ep_table", "_lock")
 
     def __init__(self, key: str, cap: Optional[int] = None) -> None:
         self.key = key
@@ -210,8 +211,13 @@ class ColumnRing:
         self.slot_of: Dict[Tuple[str, str], int] = {}
         self.host_start = np.zeros(self.cap, dtype=np.float64)
         self.host_end = np.zeros(self.cap, dtype=np.float64)
+        # endpoint-id mirror: with start/end it makes the host mirror a
+        # COMPLETE copy of every live slot, which is what lets a
+        # poisoned device buffer be rebuilt in place (rebuild())
+        self.host_ep = np.full(self.cap, -1, dtype=np.int32)
         self.appended_rows = 0
         self.appended_bytes = 0
+        self.rebuilds = 0
         self._ep_table: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -327,12 +333,15 @@ class ColumnRing:
         update[:n_new, 0] = (cols.start[mi] - self.epoch).astype(np.int64)
         update[:n_new, 1] = (cols.end[mi] - self.epoch).astype(np.int64)
         update[:n_new, 2] = ep_id
+        # twlint: disable=TW005 — caller holds self._lock (resolve() is
+        # the only entry point into _resolve_locked)
         self.buf = ring_append(self.buf, update, start_slot)
         self.evict_seq = max(self.evict_seq, base + l_pad - self.cap)
         new_seqs = base + np.arange(n_new, dtype=np.int64)
         new_slots = (new_seqs % self.cap)
         self.host_start[new_slots] = cols.start[mi]
         self.host_end[new_slots] = cols.end[mi]
+        self.host_ep[new_slots] = ep_id
         for j, seq in zip(mi, new_seqs):
             self.slot_of[(scope, cols.ids[j])] = int(seq)
         self.next_seq = base + n_new
@@ -349,6 +358,46 @@ class ColumnRing:
                             if s >= self.evict_seq}
         self._observe()
         return slots.astype(np.int32)
+
+    def rebuild(self) -> int:
+        """Invalidate-and-rebuild: reconstruct the DEVICE buffer from
+        the host mirror, slot assignments preserved.
+
+        The recovery rung for a faulted ring (``TW_FAULTS=devcols:...``
+        or a real append/assembly failure): the device buffer's
+        contents are no longer trusted — and unlike the transient
+        faults the supervisor's retry/bisect ladder was built for, a
+        poisoned ring would corrupt EVERY later dispatch that gathers
+        from it, so retrying around it is not enough. The host mirror
+        (start/end/endpoint per slot — the "host columns" the ring was
+        appended from) is the durable truth: a fresh ``[cap, 3]`` int32
+        buffer is built from it and placed on device in one shot.
+
+        Slot preservation is the load-bearing property: in-flight
+        dispatch groups hold index arrays computed against the OLD slot
+        map, and a rebuild that re-assigned slots would silently gather
+        the wrong spans. Rebuilding in place keeps every live slot's
+        contents bit-identical to what incremental appends produced
+        (dead slots carry don't-care values no gather reads).
+
+        Returns the bytes shipped H2D (the caller bills
+        ``h2d_bytes_ring`` — a rebuild re-ships the whole arena and
+        must never look free in the ledger)."""
+        with self._lock:
+            vals = np.zeros((self.cap, 3), dtype=np.int32)
+            if self.epoch is not None:
+                # int64 intermediate, int32 wrap: live slots are in
+                # range by the eligibility check; dead slots may wrap
+                # (deterministically) and are never gathered
+                vals[:, 0] = (self.host_start - self.epoch) \
+                    .astype(np.int64).astype(np.int32)
+                vals[:, 1] = (self.host_end - self.epoch) \
+                    .astype(np.int64).astype(np.int32)
+                vals[:, 2] = self.host_ep
+            self.buf = jnp.asarray(vals)
+            self.rebuilds += 1
+            _OBS_RING_EVENTS.inc(kind="rebuild")
+            return int(vals.nbytes)
 
     def rel32(self, values: np.ndarray) -> np.ndarray:
         """Host-side rebase of absolute µs values to the ring epoch

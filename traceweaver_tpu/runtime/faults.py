@@ -27,7 +27,13 @@ Sites (anything else raises — the ops/precision.py raise-on-typo rule):
 - ``host``       — the per-service host-fallback solve (the ladder's
   last compute rung; injecting here is how tests force quarantine);
 - ``checkpoint`` — checkpoint save/load I/O (``stream/checkpoint.py``);
-- ``source``     — span-source reads (``stream/service.py`` run loop).
+- ``source``     — span-source reads (``stream/service.py`` run loop);
+- ``devcols``    — device-resident column-ring operations (ring append
+  at group resolve + resident window assembly, ``ops/devcols.py``);
+  unlike the transient sites above, a faulted ring would poison every
+  LATER dispatch that gathers from it, so the supervisor answers with
+  the ring-invalidate-and-rebuild rung (``devcols_ring_rebuilds``)
+  before retrying.
 
 Determinism: one seeded RNG shared across sites, so a given
 ``(spec, seed)`` produces one fixed draw sequence. Under the pipelined
@@ -47,7 +53,7 @@ from contextlib import contextmanager
 from typing import Dict, Optional
 
 #: every legal injection site, in ladder order of first appearance
-SITES = ("dispatch", "fetch", "host", "checkpoint", "source")
+SITES = ("dispatch", "fetch", "host", "checkpoint", "source", "devcols")
 
 
 class FaultError(RuntimeError):

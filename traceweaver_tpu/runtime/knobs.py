@@ -145,6 +145,26 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        help="per-tenant seal→emit latency SLO (p99, milliseconds): the "
             "continuous-batching scheduler admits SLO-at-risk windows "
             "ahead of batch-fill efficiency"),
+    # --- online adaptation (traceweaver_tpu/adapt, docs/ROBUSTNESS.md) ---
+    _k("TW_ADAPT", "bool", False,
+       help="1 arms the drift→adapt controller: PSI/low-confidence "
+            "excursions walk the adaptation ladder (out-of-band warm-"
+            "start refit → wide-prior fallback → cooldown re-arm). 0 "
+            "(default) is fully inert — the drift watcher still alerts, "
+            "nothing actuates"),
+    _k("TW_ADAPT_COOLDOWN_S", "float", 60.0, lo=0.0,
+       help="hysteresis cooldown after a completed adaptation (and the "
+            "fallback rung's retry period): a key cannot re-trigger the "
+            "ladder inside it, so flapping drift cannot thrash refits"),
+    _k("TW_ADAPT_PROBATION", "int", 6, lo=1,
+       help="probation window (emitted windows per service) after a "
+            "refit lands: recover inside it and the key re-arms; stay "
+            "in excursion past it and the score model falls back to "
+            "the robust wide-prior configuration"),
+    _k("TW_ADAPT_LOW_RATE", "float", 0.5, lo=0.0, hi=1.0,
+       help="low-confidence-rate excursion threshold: a window whose "
+            "fraction of spans at or under TW_CONF_LOW exceeds this "
+            "counts as an excursion for the adaptation ladder"),
     # --- observability (traceweaver_tpu/obs, docs/OBSERVABILITY.md) ------
     _k("TW_PROFILE", "bool", False,
        help="jax.profiler trace annotations around fleet stages + device "

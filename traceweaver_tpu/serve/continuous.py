@@ -89,6 +89,7 @@ class ContinuousDispatcher:
         self.solve_ewma_s = 0.05
         self.dispatches = 0
         self.urgent_dispatches = 0
+        self.crashed = False
         # rolling window-size histogram: pow2 class -> recent count
         # (bounded deque of classes; the distribution the adaptive
         # bucket pick reads)
@@ -296,6 +297,20 @@ class ContinuousDispatcher:
 
     # -- the loop ---------------------------------------------------------
     def _loop(self) -> None:
+        # CRASH CONTAINMENT (docs/ROBUSTNESS.md): an uncaught exception
+        # here used to die silently with serve still accepting spans —
+        # every tenant's sealed windows queued forever while POSTs kept
+        # returning 200. Any escape now lands in the service's
+        # dispatcher-death handler: counted, evented, the degraded
+        # gauge flips on /metrics, and serve falls back to the fixed
+        # inline pump so the seal→emit path keeps moving.
+        try:
+            self._run()
+        except Exception as e:  # noqa: BLE001 — containment, not logic
+            self.crashed = True
+            self.service._on_dispatcher_death(e)
+
+    def _run(self) -> None:
         while True:
             with self._cond:
                 if self._stop:
@@ -317,6 +332,10 @@ class ContinuousDispatcher:
                         + self._EWMA * solve_s)
                     self.dispatches += 1
                     _OBS_BATCH_FILL.observe(float(n))
+                # drift-adaptation tick: refits the retired solve's
+                # emissions scheduled run NOW, as their own dispatches,
+                # before the next admission — off the hot batch
+                self.service.run_adaptations()
                 continue
             with self._cond:
                 if not self._stop:

@@ -52,6 +52,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from traceweaver_tpu.ingest.jaeger import FIX_ROOT_OPS, parse_trace_payload
+from traceweaver_tpu.obs import events as _events
 from traceweaver_tpu.obs import quality as _quality
 from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.ops.precision import precision_from_env
@@ -81,6 +82,11 @@ _OBS_PUMP = _get_registry().counter(
     "tw_serve_pump_total",
     "tenancy pump ledger mirror (shared/isolated solves, windows, ...)",
     labels=("key",))
+_OBS_DISPATCHER_DEGRADED = _get_registry().gauge(
+    "tw_serve_dispatcher_degraded",
+    "1 while the continuous dispatcher thread has crashed and serve is "
+    "degraded to the fixed inline pump (0 = dispatcher healthy / pump "
+    "mode by configuration)")
 
 
 def _merge_stats(dst: Dict, src: Dict) -> None:
@@ -187,6 +193,12 @@ class Tenant:
             max_pending=cfg.max_pending, spill_max=cfg.spill_max,
             solve_min_batch=1, warm_start=cfg.warm_start,
             grade=False, prune=True,
+            # the serve SLO rides the tenant's stream config so the
+            # per-tenant seal→emit p99 carries breach telemetry
+            # (tw_slo_breach_total{tenant} + one event per excursion);
+            # tenants are externally pumped, so this never changes the
+            # solve cadence — telemetry only
+            slo_p99_ms=cfg.slo_p99_ms,
             # the TENANT owns checkpointing (its checkpoint wraps the
             # service state with ring/counter bookkeeping), so the inner
             # service's own cadence is disabled
@@ -438,6 +450,9 @@ class Tenant:
             low_confidence_traces=int(
                 svc.stats.get("low_confidence_traces", 0)),
             seal_emit_p99_ms=round(svc.seal_emit_p99_ms() or 0.0, 2),
+            slo_breaches=int(svc.stats.get("slo_breaches", 0)),
+            adapt_refits=int(svc.stats.get("adapt_refits", 0)),
+            adapt=(svc.adapt.summary() if svc.adapt is not None else None),
             quarantined_windows=int(
                 self.counters.get("quarantined_windows", 0)),
             ring_traces=len(self.ring),
@@ -487,11 +502,16 @@ class TenantService:
         # threshold pump stays the library default (and the drained
         # fallback): cfg.continuous opts in.
         self.dispatcher = None
+        # crash containment (docs/ROBUSTNESS.md): an uncaught exception
+        # on the dispatcher thread degrades serve to the fixed pump
+        # instead of silently wedging every tenant's seal→emit path
+        self.dispatcher_degraded = False
         if self.cfg.continuous:
             from traceweaver_tpu.serve.continuous import ContinuousDispatcher
 
             self.dispatcher = ContinuousDispatcher(
                 self, slo_ms=self.cfg.slo_p99_ms).start()
+            _OBS_DISPATCHER_DEGRADED.set(0.0)
 
     def _bump(self, key: str, n: float = 1) -> None:
         """The pump ledger's single write path (callers hold the
@@ -552,6 +572,54 @@ class TenantService:
         with self._lock:
             return sum(len(t.in_flight) for t in self.tenants.values())
 
+    def _on_dispatcher_death(self, exc: BaseException) -> None:
+        """Crash containment for the continuous dispatcher thread.
+
+        Before this, an uncaught exception in the admission loop died
+        silently with serve still accepting spans: every tenant's
+        sealed windows queued forever (the seal→emit path wedged) while
+        POSTs kept returning 200. Now the dying thread lands here: the
+        crash is counted and evented, the degraded gauge flips on
+        ``/metrics``, and the service falls back to the FIXED pump —
+        ``self.dispatcher = None`` routes every subsequent ingest
+        through the inline threshold pump and flush/drain through the
+        pump path, so tenants keep emitting (at pre-continuous cadence)
+        instead of wedging. The backlog the dispatcher stranded is
+        pumped immediately."""
+        with self._lock:
+            self.dispatcher = None
+            self.dispatcher_degraded = True
+            self._bump("dispatcher_crashes")
+            _OBS_DISPATCHER_DEGRADED.set(1.0)
+            _events.emit("serve", "dispatcher_degraded",
+                         error="%s: %s" % (type(exc).__name__, exc))
+        try:
+            with self._lock:
+                self.pump()
+        except Exception as drain_exc:  # noqa: BLE001 — best-effort drain
+            # the stranded backlog stays queued; the next ingest's
+            # inline pump retries it (counted, never silent)
+            with self._lock:
+                self._bump("dispatcher_drain_errors")
+            _events.emit("serve", "dispatcher_drain_error",
+                         error="%s: %s" % (type(drain_exc).__name__,
+                                           drain_exc))
+
+    def run_adaptations(self) -> int:
+        """Execute every tenant's pending drift-adaptation refits
+        (adapt/, ``TW_ADAPT``). Out-of-band by construction: each refit
+        is its own single-item ``solve_fleet`` call, never merged into
+        the admission/pump dispatch — the continuous dispatcher calls
+        this AFTER a solve round retires, so SLO dispatches keep
+        flowing. Returns refits that landed."""
+        with self._lock:
+            n = 0
+            for tid in sorted(self.tenants):
+                n += self.tenants[tid].svc.maybe_adapt()
+            if n:
+                self._bump("adapt_refits", n)
+            return n
+
     # -- the shared pump --------------------------------------------------
     def pump(self) -> int:
         """Solve every queued micro-batch: healthy tenants merged into
@@ -577,7 +645,10 @@ class TenantService:
                         t.svc._since_checkpoint >= self.cfg.checkpoint_every:
                     t.checkpoint()
             self._bump("pumped_windows", n)
-            return n
+        # adaptation refits run after the pump retires (idempotent —
+        # pending_refits drains), never inside the shared dispatch
+        self.run_adaptations()
+        return n
 
     def solve_admitted(self, plan: List[Tuple[Tenant, List]]) -> int:
         """Solve an admission-scheduler batch (``[(tenant, [bufs])]`` —
@@ -743,6 +814,7 @@ class TenantService:
             sealed = sum(t.flush() for t in targets)
         if self.dispatcher is not None:
             solved = self.dispatcher.drain_backlog()
+            self.run_adaptations()
         else:
             with self._lock:
                 solved = self.pump()
@@ -851,8 +923,9 @@ class TenantService:
         "backlog", "solved_windows", "shed_spilled",
         "shed_dropped_windows", "shed_dropped_spans", "late_rerouted",
         "late_dropped", "deadletter_windows", "deadletter_spans",
-        "low_confidence_traces", "seal_emit_p99_ms",
-        "quarantined_windows", "ring_traces", "ring_evicted")
+        "low_confidence_traces", "seal_emit_p99_ms", "slo_breaches",
+        "adapt_refits", "quarantined_windows", "ring_traces",
+        "ring_evicted")
 
     def metrics_families(self) -> List:
         """Collector-style families for ``GET /metrics``
@@ -920,7 +993,12 @@ class TenantService:
                     continuous_dispatches=int(
                         self.stats_counters.get(
                             "continuous_dispatches", 0)),
+                    adapt_refits=int(
+                        self.stats_counters.get("adapt_refits", 0)),
+                    dispatcher_crashes=int(
+                        self.stats_counters.get("dispatcher_crashes", 0)),
                 ),
+                dispatcher_degraded=self.dispatcher_degraded,
                 continuous=(self.dispatcher.stats()
                             if self.dispatcher is not None else None),
                 fleet=fleet,

@@ -29,6 +29,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from traceweaver_tpu import adapt as _adapt
+from traceweaver_tpu.obs import events as _events
 from traceweaver_tpu.obs import quality as _quality
 from traceweaver_tpu.obs import selftrace as _selftrace
 from traceweaver_tpu.obs.registry import get_registry as _get_registry
@@ -61,6 +63,12 @@ _OBS_SEAL_EMIT_S = _OBS.histogram(
     "tw_seal_emit_seconds",
     "per-window seal→emit latency (the quantity the continuous-batching "
     "SLO TW_SERVE_SLO_P99_MS bounds at p99)",
+    labels=("tenant",))
+_OBS_SLO_BREACH = _OBS.counter(
+    "tw_slo_breach_total",
+    "seal→emit p99 excursions past TW_SERVE_SLO_P99_MS, one per "
+    "excursion (re-armed when the p99 falls back under the SLO) — the "
+    "pressure signal the admission scheduler failed to absorb",
     labels=("tenant",))
 
 
@@ -210,6 +218,22 @@ class StreamingReconstructor:
         # whole path is inert under TW_CONFIDENCE=0
         self.drift = _quality.ConfidenceDrift() \
             if _quality.conf_enabled() else None
+        # drift→adapt controller (traceweaver_tpu/adapt, TW_ADAPT,
+        # docs/ROBUSTNESS.md "The adaptation ladder"): consumes the
+        # drift watcher's excursions and actuates the refit/fallback
+        # ladder. None (TW_ADAPT=0, the default) is fully inert; it
+        # also requires the quality sensors (no signal, no control).
+        self.adapt = (_adapt.AdaptationController()
+                      if self.drift is not None
+                      and _adapt.adapt_enabled() else None)
+        # per-service refit material: the most recently SOLVED window
+        # problem, retained so an out-of-band refit has a post-shift
+        # window to re-fit from (one window per service — bounded;
+        # regenerates after a resume, so it never rides checkpoints)
+        self.adapt_material: Dict[str, _WindowProblem] = {}
+        # SLO-breach excursion arming (one event per excursion,
+        # re-armed when the p99 falls back under the budget)
+        self._slo_breached = False
         # seal→emit latencies of recent emitted windows (seconds; the
         # live p99 the continuous-batching SLO is graded against —
         # bounded so a long-lived tenant tracks RECENT latency, not its
@@ -318,6 +342,14 @@ class StreamingReconstructor:
             for wp in probs:
                 warm = (self.carried.get(wp.service)
                         if self.cfg.warm_start else None)
+                if self.adapt is not None:
+                    # adaptation fallback rung: a service on wide-prior
+                    # fallback scores every edge under the packer's
+                    # near-flat Gaussian instead of its (possibly
+                    # poisoned) carried statistics — reversible, and
+                    # single-pass like any warm solve (adapt/)
+                    warm = self.adapt.warm_dists(
+                        self.trace_prefix + wp.service, warm)
                 items.append(FleetItem(
                     wp.service, {wp.in_ep: wp.in_spans}, wp.out_parts,
                     wp.truth, wp.dag, store=self.live, warm_dists=warm,
@@ -415,6 +447,11 @@ class StreamingReconstructor:
                     # is dead-lettered, not emitted, and poisoned data
                     # must not warm later windows
                     continue
+                if self.adapt is not None:
+                    # retain the freshest solved window as refit
+                    # material (the out-of-band refit re-solves it COLD
+                    # when this service's drift excursion fires)
+                    self.adapt_material[wp.service] = wp
                 if self.cfg.warm_start:
                     self.carried.update(wp.service, timing.refit_from_assignments(
                         {wp.in_ep: wp.in_spans}, wp.out_parts, wp.dag,
@@ -586,9 +623,24 @@ class StreamingReconstructor:
         if n_low:
             self._bump("low_confidence_traces", n_low)
         if self.drift is not None:
+            low = _quality.low_threshold()
             for svc, recs in sorted(res.confidence.items()):
-                self.drift.update(self.trace_prefix + svc,
-                                  [r["conf"] for r in recs.values()])
+                vals = [r["conf"] for r in recs.values()]
+                key = self.trace_prefix + svc
+                stat = self.drift.update(key, vals)
+                if self.adapt is not None and vals:
+                    # sensor → decision: the controller sees the drift
+                    # statistic the gauge exports — but only once the
+                    # rolling window is MATURE (a freshly-frozen
+                    # reference compares against a handful of rolling
+                    # values; acting on that sampling noise would burn
+                    # the hysteresis cooldown before any real shift) —
+                    # plus this window's low-confidence rate, and walks
+                    # the adaptation ladder (every actuation evented)
+                    self.adapt.observe(
+                        key,
+                        psi=stat if self.drift.mature(key) else None,
+                        low_rate=sum(v <= low for v in vals) / len(vals))
 
     def _emit(self, res: WindowResult) -> None:
         if res.poisoned:
@@ -633,6 +685,7 @@ class StreamingReconstructor:
             lat = max(0.0, time.monotonic() - sealed_wall)
             self.seal_emit_lat_s.append(lat)
             _OBS_SEAL_EMIT_S.observe(lat, tenant=self._conf_tenant())
+            self._observe_slo()
         tr = _selftrace.active()
         if tr is not None:
             tr.finish(self._trace_key(buf.k))
@@ -657,6 +710,44 @@ class StreamingReconstructor:
                    self.scheduler.shed_spilled
                    + self.scheduler.shed_dropped_windows,
                    self.scheduler.backlog, rate))
+
+    def _observe_slo(self) -> None:
+        """SLO-breach telemetry: ONE counted + evented excursion when
+        the rolling seal→emit p99 crosses the configured SLO budget,
+        re-armed when it falls back under — the pressure signal the
+        scheduler failed to absorb, visible to operators and the
+        adaptation controller alike. Inert with no SLO configured (the
+        historical single-tenant stream default)."""
+        slo = self.cfg.slo_p99_ms
+        if not slo:
+            return
+        p99 = self.seal_emit_p99_ms()
+        if p99 is None:
+            return
+        if p99 > slo and not self._slo_breached:
+            self._slo_breached = True
+            tenant = self._conf_tenant()
+            self._bump("slo_breaches")
+            _OBS_SLO_BREACH.inc(1.0, tenant=tenant)
+            _events.emit("slo_breach", "excursion", tenant=tenant,
+                         p99_ms=round(p99, 2), slo_ms=slo)
+        elif p99 <= slo:
+            self._slo_breached = False
+
+    def maybe_adapt(self) -> int:
+        """Execute pending out-of-band adaptation refits (the ladder's
+        first rung, :mod:`traceweaver_tpu.adapt.refit`). Called off the
+        hot pump — the stream run loop's tail, the serve dispatcher's
+        post-solve tick — so the refit's two-pass dispatch never rides
+        an SLO admission batch. Returns refits that landed."""
+        if self.adapt is None:
+            return 0
+        n = 0
+        for key in self.adapt.pending_refits():
+            if _adapt.refit.execute_refit(self, key):
+                n += 1
+                self._bump("adapt_refits")
+        return n
 
     def _bump(self, key: str, n: float = 1) -> None:
         _OBS_STREAM.inc(n, key=key)
@@ -737,6 +828,7 @@ class StreamingReconstructor:
             carried=self.carried,
             grader=self.grader,
             conf_drift=self.drift.state() if self.drift else None,
+            adapt=self.adapt.state() if self.adapt else None,
             stats=self.stats,
             fleet_stats=self.fleet_stats,
             pending=list(self.scheduler.pending),
@@ -830,6 +922,15 @@ class StreamingReconstructor:
         if state.get("conf_drift") and svc.drift is not None:
             svc.drift = _quality.ConfidenceDrift.from_state(
                 state["conf_drift"])
+        # controller state survives kill/resume: probation timers,
+        # active fallbacks, refit generations (cooldowns re-stamped as
+        # remaining durations — monotonic instants die with the
+        # process). Pre-adapt checkpoints (no key) keep the fresh
+        # controller; a checkpoint written under TW_ADAPT=1 resumed
+        # under TW_ADAPT=0 stays inert by the constructor gate.
+        if state.get("adapt") and svc.adapt is not None:
+            svc.adapt = _adapt.AdaptationController.from_state(
+                state["adapt"])
         svc.stats = state["stats"]
         svc.fleet_stats = state["fleet_stats"]
         # checkpointed seal stamps are time.monotonic() values from the
@@ -893,6 +994,10 @@ class StreamingReconstructor:
                     or self._slo_pressure():
                 for res in self.scheduler.pump():
                     self._emit(res)
+                # adaptation refits run OFF the pump, between pumps:
+                # the hot micro-batch dispatch never carries the
+                # out-of-band two-pass refit load
+                self.maybe_adapt()
             if sealed and c.prune:
                 # retention horizon: two windows behind the watermark,
                 # never ahead of the oldest window still waiting in the
@@ -922,6 +1027,7 @@ class StreamingReconstructor:
             self.scheduler.offer(buf)
         for res in self.scheduler.pump():
             self._emit(res)
+        self.maybe_adapt()
         self._checkpoint()
         return self._summary(final=True)
 
@@ -965,6 +1071,9 @@ class StreamingReconstructor:
                 low_traces=int(self.stats.get("low_confidence_traces", 0)),
                 drift_alerts=self.drift.alerts if self.drift else 0,
             ),
+            adapt=(self.adapt.summary() if self.adapt is not None
+                   else dict(enabled=False)),
+            slo_breaches=int(self.stats.get("slo_breaches", 0)),
             stats=dict(self.stats),
             fleet=dict(self.fleet_stats),
             pipeline=dict(
