@@ -73,6 +73,7 @@ import subprocess
 import sys
 import tempfile
 import time
+from typing import Optional
 
 from traceweaver_tpu.runtime import knobs as _knobs
 
@@ -1190,6 +1191,271 @@ def run_adapt_leg(n_bursts: int) -> dict:
     return report
 
 
+def capture_fields(clean: dict, skewed: dict, lossy: dict,
+                   injected_skew_us: float) -> dict:
+    """Capture-leg ledgers -> report fields (unit-tested like
+    chaos_fields/serve_fields, tests/test_bench.py).
+
+    ``clean``/``skewed``/``lossy`` summarize one replay each of the SAME
+    recorded capture workload through the collector ingress + windowed
+    solve: clean (churn only — the workload carries an fd reuse), under
+    an injected per-source clock skew (``skew`` fault site), and under
+    injected chunk loss (``capture`` fault site). The headline verdicts:
+    skew must be *corrected* (accuracy within 1 pt of clean, the fitted
+    offset within 20% of the injection), churn must be *tolerated*
+    (re-keys counted, clean accuracy intact), and loss must *degrade
+    gracefully* — loss counted, confidence discounted below clean, no
+    crash, never silent."""
+    def pts(a, b):
+        return (round(a - b, 2)
+                if a is not None and b is not None else None)
+
+    detected = skewed.get("skew_detected_us")
+    skew_ok = (clean.get("acc") is not None
+               and skewed.get("acc") is not None
+               and abs(clean["acc"] - skewed["acc"]) <= 1.0
+               and detected is not None and injected_skew_us > 0
+               and abs(abs(detected) - injected_skew_us)
+               <= 0.2 * injected_skew_us)
+    loss_counted = sum(lossy.get("loss", {}).values()) > 0
+    conf_discounted = (
+        lossy.get("conf_discount") is not None
+        and lossy["conf_discount"] < 1.0
+        and lossy.get("conf_mean") is not None
+        and clean.get("conf_mean") is not None
+        and lossy["conf_mean"] < clean["conf_mean"])
+    no_crash = all(leg.get("completed") for leg in (clean, skewed, lossy))
+    return {
+        "capture_spans_clean": int(clean.get("spans", 0)),
+        "capture_acc_clean": clean.get("acc"),
+        "capture_acc_skew": skewed.get("acc"),
+        "capture_acc_lossy": lossy.get("acc"),
+        "capture_skew_injected_us": float(injected_skew_us),
+        "capture_skew_detected_us": detected,
+        "capture_skew_acc_delta_pts": pts(clean.get("acc"),
+                                          skewed.get("acc")),
+        "capture_skew_corrected_ok": bool(skew_ok),
+        "capture_rekeyed_streams": int(clean.get("rekeyed", 0)),
+        "capture_churn_tolerated": bool(clean.get("rekeyed", 0) > 0
+                                        and clean.get("acc") is not None),
+        "capture_loss_counters": dict(lossy.get("loss", {})),
+        "capture_loss_rate": lossy.get("loss_rate"),
+        "capture_loss_counted": bool(loss_counted),
+        "capture_conf_mean_clean": clean.get("conf_mean"),
+        "capture_conf_mean_lossy": lossy.get("conf_mean"),
+        "capture_conf_discount": lossy.get("conf_discount"),
+        "capture_conf_discounted": bool(conf_discounted),
+        "capture_no_crash": bool(no_crash),
+        "capture_graceful": bool(no_crash and loss_counted
+                                 and conf_discounted),
+    }
+
+
+def _capture_workload(n_traces: int, churn_at: Optional[int] = None):
+    """The capture-leg corpus: per-source ``strace -f -ttt`` logs of an
+    uninstrumented frontend→search workload — the frontend's capture
+    sees the client requests (fd 7) and its downstream calls (fd 9);
+    the search host's capture (its own clock) sees the server side
+    (fd 5). Tracing headers carry the ground-truth join (grading only —
+    the solver reconstructs from timing). ``churn_at`` reconnects the
+    frontend's inbound connection mid-capture WITHOUT a close syscall:
+    the ingress must re-key on the fresh preface or the two connections'
+    bytes concatenate into garbage."""
+    from traceweaver_tpu.collector.hpack import Encoder
+    from traceweaver_tpu.collector.http2 import (
+        FLAG_END_HEADERS,
+        FLAG_END_STREAM,
+        HEADERS,
+        PREFACE,
+        SETTINGS,
+    )
+
+    def frame(ftype, flags, stream_id, payload):
+        return (len(payload).to_bytes(3, "big") + bytes([ftype, flags])
+                + stream_id.to_bytes(4, "big") + payload)
+
+    def req(enc, stream_id, path, authority, key):
+        block = enc.encode([
+            (":method", "POST"), (":scheme", "http"), (":path", path),
+            (":authority", authority),
+            ("uber-trace-id", f"{key}:1:0:1"),
+        ])
+        return frame(HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                     stream_id, block)
+
+    def resp(enc, stream_id):
+        return frame(HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                     stream_id, enc.encode([(":status", "200")]))
+
+    def esc(data):
+        out = []
+        for i, b in enumerate(data):
+            if b == 0x22:
+                out.append('\\"')
+            elif b == 0x5C:
+                out.append("\\\\")
+            elif 0x20 <= b < 0x7F:
+                out.append(chr(b))
+            else:
+                nxt = data[i + 1] if i + 1 < len(data) else None
+                out.append(("\\%03o" if nxt is not None
+                            and 0x30 <= nxt <= 0x37 else "\\%o") % b)
+        return "".join(out)
+
+    def line(pid, ts, op, fd, data):
+        return (f'{pid} {ts:.6f} {op}({fd}, "{esc(data)}", {len(data)}) '
+                f'= {len(data)}')
+
+    if churn_at is None:
+        churn_at = max(2, n_traces // 2)
+    fe, se = [], []
+    enc = {k: Encoder() for k in ("c_in", "fe_out", "fe_resp",
+                                  "dn_resp", "se_in", "se_resp")}
+    base = 1_722_000_000.0
+    fe.append(line(10, base, "read", 7, PREFACE + frame(SETTINGS, 0, 0,
+                                                        b"")))
+    fe.append(line(10, base, "write", 9, PREFACE + frame(SETTINGS, 0, 0,
+                                                         b"")))
+    se.append(line(20, base, "read", 5, PREFACE + frame(SETTINGS, 0, 0,
+                                                        b"")))
+    gen = 0
+    sid_in = 0
+    for i in range(n_traces):
+        if i == churn_at:
+            # reconnect without close: fresh preface + fresh HPACK
+            # contexts on fd 7, mid-capture
+            gen, sid_in = 1, 0
+            enc["c_in"], enc["fe_resp"] = Encoder(), Encoder()
+            fe.append(line(10, base + 0.5 + i * 0.01, "read", 7,
+                           PREFACE + frame(SETTINGS, 0, 0, b"")))
+        key = f"t{i:04d}"
+        sid_in += 2
+        sid_dn = 2 * i + 1
+        # jittered service delay so the solver sees a real distribution
+        d = 0.002 + (i % 5) * 0.0004
+        t0 = base + 0.5 + i * 0.01
+        t1 = t0 + 0.001
+        t2 = t1 + 0.0002
+        t3 = t2 + d
+        t4 = t3 + 0.0003
+        t5 = t4 + 0.0005
+        fe.append(line(10, t0, "read", 7,
+                       req(enc["c_in"], sid_in - 1, "/hotels", "frontend",
+                           key)))
+        fe.append(line(10, t1, "write", 9,
+                       req(enc["fe_out"], sid_dn, "/search", "search",
+                           key)))
+        se.append(line(20, t2, "read", 5,
+                       req(enc["se_in"], sid_dn, "/search", "search",
+                           key)))
+        se.append(line(20, t3, "write", 5, resp(enc["se_resp"], sid_dn)))
+        fe.append(line(10, t4, "read", 9, resp(enc["dn_resp"], sid_dn)))
+        fe.append(line(10, t5, "write", 7, resp(enc["fe_resp"],
+                                                sid_in - 1)))
+    return {"frontend": "\n".join(fe), "search": "\n".join(se)}
+
+
+def run_capture_leg(n_traces: int) -> dict:
+    """bench.py --capture N: the capture-to-trace chaos leg.
+
+    Replays the recorded uninstrumented workload through the collector
+    ingress (CollectorSource -> skew correction -> windowed solve ->
+    emitted traces) three times — clean (with mid-capture connection
+    churn), under an injected per-source clock skew, and under injected
+    capture loss — and gates on the hardening story: skew corrected
+    (accuracy holds, offset detected), churn tolerated (re-keys
+    counted), loss degrading gracefully (counted, confidence
+    discounted, zero crashes, no silent wrong traces)."""
+    import jax
+
+    if _knobs.get("TW_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("TW_RETRY_BACKOFF_S", "0")
+    from traceweaver_tpu.runtime import faults as faults_mod
+
+    injected_us = _knobs.get_float("TW_SKEW_CHAOS_US")
+
+    def one_run(name: str, spec: Optional[str]) -> dict:
+        from traceweaver_tpu.collector.source import CollectorSource
+        from traceweaver_tpu.stream.service import (
+            StreamConfig,
+            StreamingReconstructor,
+            TraceSink,
+        )
+
+        logs = _capture_workload(n_traces)
+        faults_mod.reset()
+        try:
+            if spec:
+                with faults_mod.override(spec, seed=1):
+                    src = CollectorSource(logs)
+            else:
+                src = CollectorSource(logs)
+            sink_path = os.path.join(
+                tempfile.mkdtemp(prefix="tw_capture_"), "out.jsonl")
+            cfg = StreamConfig(window_us=0.2e6, overlap_us=0.05e6,
+                               ooo_bound_us=0.02e6,
+                               checkpoint_every=10_000, verbose=False)
+            svc = StreamingReconstructor(src, cfg,
+                                         sink=TraceSink(sink_path))
+            summary = svc.run()
+        except Exception as e:  # noqa: BLE001 — the no-crash gate
+            log(f"capture leg {name}: CRASHED {type(e).__name__}: {e}")
+            return dict(completed=False, error=f"{type(e).__name__}: {e}")
+        quality = summary.get("capture", {})
+        confs, discount = [], None
+        with open(sink_path) as f:
+            for raw in f:
+                rec = json.loads(raw)
+                tw = rec.get("tw.confidence") or {}
+                for tconf in (tw.get("traces") or {}).values():
+                    if tconf is not None:
+                        confs.append(tconf["conf"])
+                cap = tw.get("capture")
+                if cap is not None:
+                    discount = cap["discount"]
+        skews = [v for v in quality.get("skew_us", {}).values() if v]
+        acc = summary.get("accuracy", {}).get("e2e")
+        return dict(
+            completed=True,
+            spans=int(summary["stats"].get("spans_emitted", 0)),
+            acc=round(acc, 2) if acc is not None else None,
+            loss=quality.get("loss", {}),
+            loss_rate=quality.get("loss_rate"),
+            rekeyed=quality.get("rekeyed_streams", 0),
+            skew_detected_us=(max(skews, key=abs) if skews else None),
+            conf_mean=(round(sum(confs) / len(confs), 4)
+                       if confs else None),
+            conf_discount=discount,
+        )
+
+    log(f"capture leg: {n_traces} traces; clean replay (churn only)")
+    clean = one_run("clean", None)
+    log("capture leg: clean acc=%s rekeyed=%s; skew replay "
+        "(skew:1.0:max=1, %.0fus)"
+        % (clean.get("acc"), clean.get("rekeyed"), injected_us))
+    skewed = one_run("skew", "skew:1.0:max=1")
+    log("capture leg: skew acc=%s detected=%s; lossy replay "
+        "(capture:0.04)" % (skewed.get("acc"),
+                            skewed.get("skew_detected_us")))
+    lossy = one_run("lossy", "capture:0.04")
+    faults_mod.reset()
+    report = capture_fields(clean, skewed, lossy, injected_us)
+    report["mode"] = "capture"
+    log("capture leg: clean=%s skew=%s (corrected=%s) lossy=%s "
+        "loss=%s discount=%s graceful=%s"
+        % (report["capture_acc_clean"], report["capture_acc_skew"],
+           report["capture_skew_corrected_ok"],
+           report["capture_acc_lossy"],
+           sum(report["capture_loss_counters"].values()),
+           report["capture_conf_discount"], report["capture_graceful"]))
+    if not report["capture_graceful"] or not report[
+            "capture_skew_corrected_ok"]:
+        log("capture leg: WARNING — hardening story incomplete "
+            "(see capture_* fields)")
+    return report
+
+
 def confidence_fields(conf_maps) -> dict:
     """Per-span confidence ledger -> report fields (unit-tested like
     chaos_fields/serve_fields, tests/test_bench.py).
@@ -2182,6 +2448,16 @@ if __name__ == "__main__":
                          "returns to within 1 pt of the pre-shift "
                          "ledger, the drift gauge re-arms, and the "
                          "control replay stays degraded")
+    ap.add_argument("--capture", type=int, nargs="?", const=40,
+                    default=None, metavar="N",
+                    help="standalone capture-to-trace chaos leg: replay "
+                         "an N-trace recorded strace workload through "
+                         "the collector ingress (skew correction, "
+                         "partial-capture policy, churn re-keying) and "
+                         "the windowed solve, clean vs injected "
+                         "skew/loss; gates on skew corrected, churn "
+                         "tolerated, and loss degrading gracefully "
+                         "(counted, confidence discounted, no crash)")
     ap.add_argument("--scorecard", type=int, nargs="?", const=48,
                     default=None, metavar="N",
                     help="standalone per-regime scorecard leg: all five "
@@ -2221,6 +2497,14 @@ if __name__ == "__main__":
     if args.chaos_adapt:
         adapt_report = run_adapt_leg(args.chaos_adapt)
         line = json.dumps(adapt_report)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        sys.exit(0)
+    if args.capture:
+        capture_report = run_capture_leg(args.capture)
+        line = json.dumps(capture_report)
         if args.out:
             with open(args.out, "w") as f:
                 f.write(line + "\n")

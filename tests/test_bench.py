@@ -300,6 +300,58 @@ def test_continuous_fields_slo_and_throughput_verdicts(bench):
     assert empty["continuous_p99_within_slo"] is None
 
 
+@pytest.mark.collector
+def test_capture_fields_hardening_verdicts(bench):
+    """The --capture leg's report builder: clean/skew/lossy run
+    summaries -> the capture_* field set, with the three headline
+    verdicts (skew corrected, churn tolerated, loss degrading
+    gracefully) and the no-crash gate."""
+    clean = dict(completed=True, spans=120, acc=100.0, loss={},
+                 loss_rate=0.0, rekeyed=1, conf_mean=1.0)
+    skewed = dict(completed=True, acc=99.5, skew_detected_us=-251000.0)
+    lossy = dict(completed=True, acc=70.0,
+                 loss={"dropped_chunk": 40, "half_open": 6},
+                 loss_rate=0.25, conf_mean=0.75, conf_discount=0.75)
+    out = bench.capture_fields(clean, skewed, lossy, 250000.0)
+    assert out["capture_acc_clean"] == 100.0
+    assert out["capture_skew_acc_delta_pts"] == 0.5
+    assert out["capture_skew_detected_us"] == -251000.0
+    assert out["capture_skew_corrected_ok"] is True
+    assert out["capture_rekeyed_streams"] == 1
+    assert out["capture_churn_tolerated"] is True
+    assert out["capture_loss_counters"] == {"dropped_chunk": 40,
+                                            "half_open": 6}
+    assert out["capture_loss_counted"] is True
+    assert out["capture_conf_discounted"] is True
+    assert out["capture_no_crash"] is True
+    assert out["capture_graceful"] is True
+
+    # a skew fit off by >20% of the injection flips the correction flag
+    bad_fit = bench.capture_fields(
+        clean, dict(skewed, skew_detected_us=-100000.0), lossy, 250000.0)
+    assert bad_fit["capture_skew_corrected_ok"] is False
+    # a skew-leg accuracy collapse flips it too (fit alone isn't enough)
+    bad_acc = bench.capture_fields(
+        clean, dict(skewed, acc=40.0), lossy, 250000.0)
+    assert bad_acc["capture_skew_corrected_ok"] is False
+    # undiscounted confidence under loss = silent wrong traces -> not
+    # graceful
+    silent = bench.capture_fields(
+        clean, skewed, dict(lossy, conf_discount=1.0, conf_mean=1.0),
+        250000.0)
+    assert silent["capture_conf_discounted"] is False
+    assert silent["capture_graceful"] is False
+    # a crashed leg fails the no-crash gate, never hides
+    crashed = bench.capture_fields(
+        clean, skewed, dict(completed=False, error="Boom"), 250000.0)
+    assert crashed["capture_no_crash"] is False
+    assert crashed["capture_graceful"] is False
+    # empty summaries degrade to None/False, not exceptions
+    empty = bench.capture_fields({}, {}, {}, 0.0)
+    assert empty["capture_acc_clean"] is None
+    assert empty["capture_skew_corrected_ok"] is False
+
+
 def test_ingest_fields_ledger_and_ratio(bench):
     """The --ingest-only leg's report builder: pack timings under both
     TW_COLUMNAR settings -> the pack_* field set (spans/s, s/window, and

@@ -354,6 +354,100 @@ def test_pipelined_fleet_runs_and_second_solve_is_compile_free():
         assert a[0] == b[0] and a[1] == b[1] and a[2:] == b[2:]
 
 
+@pytest.mark.collector
+def test_capture_smoke_strace_to_traces_roundtrip(tmp_path):
+    """Tier-1 capture smoke (ISSUE 13 acceptance pin): a recorded
+    strace fixture flows source -> skew correction -> windowed solve ->
+    emitted traces end to end under JAX_PLATFORMS=cpu — every trace
+    stitched (root + call + callee), grading exact on the clean
+    capture, zero capture loss, and the mid-capture reconnect re-keyed
+    rather than corrupting the byte streams."""
+    import json
+
+    import bench
+    from traceweaver_tpu.collector.source import CollectorSource
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+        TraceSink,
+    )
+
+    src = CollectorSource(bench._capture_workload(12))
+    sink_path = tmp_path / "captured.jsonl"
+    cfg = StreamConfig(window_us=0.2e6, overlap_us=0.05e6,
+                       ooo_bound_us=0.02e6, verbose=False,
+                       checkpoint_every=10_000)
+    svc = StreamingReconstructor(src, cfg, sink=TraceSink(str(sink_path)))
+    summary = svc.run()
+    assert summary["accuracy"]["e2e"] == 100.0
+    cap = summary["capture"]
+    assert cap["loss"] == {} and cap["loss_rate"] == 0.0
+    assert cap["rekeyed_streams"] == 1  # the workload's fd reuse
+    traces = {}
+    for raw in sink_path.read_text().splitlines():
+        rec = json.loads(raw)
+        traces.update(rec["traces"])
+    assert len(traces) == 12
+    assert all(len(ids) == 3 for ids in traces.values())
+
+
+@pytest.mark.collector
+def test_capture_chaos_smoke_loss_counted_confidence_discounted(
+        tmp_path, monkeypatch):
+    """Tier-1 capture-chaos smoke: injected skew + chunk loss through
+    the full capture path must complete with NO crash, counted
+    capture_loss, a fitted skew offset on the ledger, and emitted
+    traces whose confidence is discounted by the observed loss rate —
+    degradation is graceful and visible, never silent."""
+    import json
+
+    import bench
+    from traceweaver_tpu.collector.source import CollectorSource
+    from traceweaver_tpu.runtime import faults
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+        TraceSink,
+    )
+
+    monkeypatch.setenv("TW_SKEW_CHAOS_US", "200000")
+    faults.reset()
+    try:
+        # loss capped at 4 chunks: unbounded chunk carnage can kill the
+        # cross-source exchanges the skew fit needs (the bench leg
+        # separates the two stimuli; this smoke wants both on one run)
+        with faults.override("skew:1.0:max=1,capture:0.05:max=4", seed=3):
+            src = CollectorSource(bench._capture_workload(12))
+    finally:
+        faults.reset()
+    quality = src.capture_quality()
+    assert sum(quality["loss"].values()) > 0, "chaos never engaged"
+    assert quality["loss_rate"] > 0
+    assert max(abs(v) for v in quality["skew_us"].values()) == \
+        pytest.approx(200000, rel=0.05)
+
+    sink_path = tmp_path / "captured.jsonl"
+    cfg = StreamConfig(window_us=0.2e6, overlap_us=0.05e6,
+                       ooo_bound_us=0.02e6, verbose=False,
+                       checkpoint_every=10_000)
+    svc = StreamingReconstructor(src, cfg, sink=TraceSink(str(sink_path)))
+    summary = svc.run()  # the no-crash gate
+    assert summary["capture"]["loss_rate"] == quality["loss_rate"]
+    discount = 1.0 - quality["loss_rate"]
+    saw = 0
+    for raw in sink_path.read_text().splitlines():
+        rec = json.loads(raw)
+        tw = rec.get("tw.confidence")
+        if not tw:
+            continue
+        assert tw["capture"]["discount"] == pytest.approx(discount)
+        for tconf in tw["traces"].values():
+            if tconf is not None:
+                assert tconf["conf"] <= discount + 1e-9
+                saw += 1
+    assert saw, "no emitted trace carried discounted confidence"
+
+
 @pytest.mark.adapt
 def test_adapt_smoke_inert_off_and_compile_free_steady_state(
         monkeypatch, tmp_path):

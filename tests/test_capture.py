@@ -1,0 +1,423 @@
+"""Capture ingress: CollectorSource, skew estimation/correction,
+partial-capture policies, churn re-keying, orphan bounds, the
+``collector:`` source spec, serve capture ingestion, and the
+capture_loss/clock_skew event-kind surface (docs/COLLECTOR.md)."""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from traceweaver_tpu.collector.skew import SkewEstimator  # noqa: E402
+from traceweaver_tpu.collector.source import (  # noqa: E402
+    CaptureCounters,
+    CaptureIngest,
+    CollectorSource,
+    iter_live,
+)
+from traceweaver_tpu.runtime import faults, knobs  # noqa: E402
+
+
+@pytest.fixture()
+def bench():
+    sys.path.insert(0, REPO)
+    import bench as bench_mod
+
+    return importlib.reload(bench_mod)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# skew estimation
+# ---------------------------------------------------------------------------
+
+def test_skew_estimator_recovers_chain_offsets():
+    """A→B→C exchange chain: per-edge NTP estimates accumulate into
+    absolute offsets anchored at the caller-only reference, robust (via
+    the median) to one corrupt exchange."""
+    est = SkewEstimator(min_pairs=3, max_us=10e6)
+    # B's clock runs 100ms ahead of A; C's 40ms behind B
+    for i in range(5):
+        t0 = 1000.0 + i * 1e4
+        est.observe_pair("a", "b", t0, t0 + 100_000 + 200, t0 + 100_000
+                         + 1200, t0 + 1800)
+        est.observe_pair("b", "c", t0, t0 - 40_000 + 150, t0 - 40_000
+                         + 900, t0 + 1300)
+    # one wildly corrupt pair must not drag the median
+    est.observe_pair("a", "b", 0.0, 9e6, 9e6, 100.0)
+    offs = est.fit()
+    assert est.reference() == "a"
+    assert abs(offs["a"]) == 0.0
+    assert abs(offs["b"] - 100_000) < 1_000
+    assert abs(offs["c"] - 60_000) < 2_000
+    assert est.correct("b", 100_000.0) == pytest.approx(
+        100_000.0 - offs["b"])
+
+
+def test_skew_estimator_clamps_insane_offsets():
+    est = SkewEstimator(min_pairs=1, max_us=1_000.0)
+    est.observe_pair("a", "b", 0.0, 5_000_000.0, 5_000_100.0, 200.0)
+    offs = est.fit()
+    assert offs["b"] == 1_000.0
+    assert est.clamped == 1
+
+
+def test_skew_min_pairs_gate():
+    est = SkewEstimator(min_pairs=4, max_us=10e6)
+    for _ in range(3):
+        est.observe_pair("a", "b", 0.0, 50_000.0, 51_000.0, 2_000.0)
+    assert not est.ready()
+    est.observe_pair("a", "b", 0.0, 50_000.0, 51_000.0, 2_000.0)
+    assert est.ready()
+
+
+# ---------------------------------------------------------------------------
+# CollectorSource synthesis
+# ---------------------------------------------------------------------------
+
+def test_collector_source_synthesizes_linked_spans(bench):
+    src = CollectorSource(bench._capture_workload(6, churn_at=3))
+    events = list(src.events())
+    assert len(events) == len(src) == 18  # 2 servers + 1 client per trace
+    by_kind = {}
+    spans = {}
+    for ev in events:
+        by_kind.setdefault(ev.span.span_kind, []).append(ev.span)
+        spans[ev.span.sid] = ev.span
+        # capture-derived spans carry the raw capture stamp
+        assert ev.capture_us is not None
+    assert len(by_kind["server"]) == 12 and len(by_kind["client"]) == 6
+    # cross-source join: every search-side server span references the
+    # frontend's client span (no stub was synthesized)
+    search_servers = [s for s in by_kind["server"]
+                      if s.process_id == "search"]
+    assert len(search_servers) == 6
+    for s in search_servers:
+        assert len(s.references) == 1
+        parent = spans[s.references[0][1]]
+        assert parent.span_kind == "client"
+        assert parent.process_id == "frontend"
+        # containment: the client interval covers the server interval
+        assert parent.start_mus <= s.start_mus
+        assert s.end_mus <= parent.end_mus
+    # arrival order is completion order and non-decreasing
+    arrivals = [ev.arrival_us for ev in events]
+    assert arrivals == sorted(arrivals)
+    # clean capture: no loss, the mid-capture reconnect was re-keyed
+    q = src.capture_quality()
+    assert q["loss"] == {} and q["rekeyed_streams"] == 1
+
+
+def test_uncaptured_callee_synthesizes_stub(bench):
+    logs = bench._capture_workload(3, churn_at=99)
+    del logs["search"]  # callee host not captured
+    src = CollectorSource(logs)
+    stubs = [ev.span for ev in src.events()
+             if ev.span.process_id.startswith("ext:")]
+    assert len(stubs) == 3
+    for s in stubs:
+        assert s.span_kind == "server" and len(s.references) == 1
+    # the stub's process resolves to the authority-derived service
+    ev = next(ev for ev in src.events()
+              if ev.span.process_id.startswith("ext:"))
+    assert ev.processes[ev.span.process_id] == "search"
+
+
+def test_injected_skew_is_detected_and_corrected(bench, monkeypatch):
+    """The 'skew' chaos site offsets one source's raw clock; the fit
+    must detect it (gauge-visible offset ≈ injection) and correction
+    must restore parent⊇child containment on solver event time."""
+    monkeypatch.setenv("TW_SKEW_CHAOS_US", "300000")
+    with faults.override("skew:1.0:max=1", seed=0):
+        src = CollectorSource(bench._capture_workload(8, churn_at=99))
+    offs = src.capture_quality()["skew_us"]
+    assert max(abs(v) for v in offs.values()) == pytest.approx(
+        300000, rel=0.05)
+    spans = {ev.span.sid: ev.span for ev in src.events()}
+    for s in spans.values():
+        if s.span_kind == "server" and s.references:
+            parent = spans[s.references[0][1]]
+            assert parent.start_mus <= s.start_mus
+            assert s.end_mus <= parent.end_mus
+    # raw capture stamps keep the uncorrected clock: for the skewed
+    # source they differ from event time by the fitted offset
+    skewed = [ev for ev in src.events()
+              if abs(offs.get(ev.span.process_id, 0.0)) > 1]
+    assert skewed
+    for ev in skewed:
+        assert abs((ev.capture_us - ev.event_us)
+                   - offs[ev.span.process_id]) < 1e-6
+
+
+def test_capture_fault_site_drops_chunks_counted(bench):
+    with faults.override("capture:1.0:max=2", seed=5):
+        src = CollectorSource(bench._capture_workload(5, churn_at=99))
+    q = src.capture_quality()
+    assert q["loss"].get("dropped_chunk", 0) >= 2
+    # the injector stayed a state perturbation: spans still flowed
+    assert q["delivered_spans"] > 0
+
+
+# ---------------------------------------------------------------------------
+# partial capture + orphan bounds
+# ---------------------------------------------------------------------------
+
+def _truncated_logs(bench, n=4, drop_lines=1):
+    logs = bench._capture_workload(n, churn_at=99)
+    lines = logs["search"].splitlines()
+    logs["search"] = "\n".join(lines[:-drop_lines])
+    return logs
+
+
+def test_partial_policy_synthetic_closes_out_half_open(bench,
+                                                       monkeypatch):
+    monkeypatch.setenv("TW_COLLECTOR_PARTIAL", "synthetic")
+    src = CollectorSource(_truncated_logs(bench))
+    q = src.capture_quality()
+    assert q["loss"].get("half_open", 0) == 1
+    assert "half_open_dropped" not in q["loss"]
+    assert q["synthetic_spans"] == 1
+    assert q["loss_rate"] > 0
+    # the synthetic closeout still became a span event
+    search_servers = [ev for ev in src.events()
+                      if ev.span.process_id == "search"]
+    assert len(search_servers) == 4
+
+
+def test_partial_policy_deadletter_drops_half_open(bench, monkeypatch):
+    monkeypatch.setenv("TW_COLLECTOR_PARTIAL", "deadletter")
+    src = CollectorSource(_truncated_logs(bench))
+    q = src.capture_quality()
+    assert q["loss"].get("half_open", 0) == 1
+    assert q["loss"].get("half_open_dropped", 0) == 1
+    assert q["synthetic_spans"] == 0
+    search_servers = [ev for ev in src.events()
+                      if ev.span.process_id == "search"]
+    assert len(search_servers) == 3
+
+
+def test_orphan_buffer_bound_evicts_oldest(monkeypatch):
+    """More open exchanges than TW_COLLECTOR_ORPHANS: the oldest is
+    evicted and counted; the capture never grows unbounded state."""
+    from traceweaver_tpu.collector.hpack import Encoder
+    from traceweaver_tpu.collector.http2 import (
+        FLAG_END_HEADERS,
+        PREFACE,
+        SETTINGS,
+    )
+
+    monkeypatch.setenv("TW_COLLECTOR_ORPHANS", "2")
+
+    def frame(ftype, flags, stream_id, payload):
+        return (len(payload).to_bytes(3, "big") + bytes([ftype, flags])
+                + stream_id.to_bytes(4, "big") + payload)
+
+    enc = Encoder()
+    counters = CaptureCounters()
+    ing = CaptureIngest("svc", counters)
+    blob = PREFACE + frame(SETTINGS, 0, 0, b"")
+    for sid in (1, 3, 5, 7):
+        blob += frame(0x1, FLAG_END_HEADERS, sid, enc.encode([
+            (":method", "GET"), (":path", "/x"), (":authority", "y")]))
+    ing._on_payload((4, 0), "in", blob, 1000.0)
+    assert counters.loss["svc"].get("orphan_evicted", 0) == 2
+    ing.finish()
+    # the surviving two closed out as half-open at end of capture
+    assert counters.loss["svc"].get("half_open", 0) == 2
+
+
+# ---------------------------------------------------------------------------
+# source spec + live mode
+# ---------------------------------------------------------------------------
+
+def test_parse_source_spec_collector_file(bench, tmp_path):
+    from traceweaver_tpu.stream.sources import parse_source_spec
+
+    logs = bench._capture_workload(3, churn_at=99)
+    path = tmp_path / "frontend.log"
+    path.write_text(logs["frontend"])
+    src = parse_source_spec(f"collector:{path}?service=frontend")
+    assert isinstance(src, CollectorSource)
+    assert len(src) > 0
+    assert {ev.span.process_id for ev in src.events()} >= {"frontend"}
+
+    # directory mode: every log file is one source (one clock each)
+    d = tmp_path / "caps"
+    d.mkdir()
+    for name, text in logs.items():
+        (d / f"{name}.log").write_text(text)
+    multi = parse_source_spec(f"collector:{d}")
+    assert sorted(multi._ingests) == ["frontend", "search"]
+
+    # the error text surfaces the collector ingress
+    with pytest.raises(ValueError, match="collector:"):
+        parse_source_spec("bogus:/nowhere")
+    with pytest.raises(ValueError, match="no such file"):
+        parse_source_spec("collector:/nowhere/missing.log")
+
+
+def test_iter_live_emits_incrementally(bench):
+    """Live single-source mode: spans come out as exchanges complete,
+    not at end-of-log."""
+    logs = bench._capture_workload(4, churn_at=99)
+    lines = logs["frontend"].splitlines()
+    seen_at = []
+    gen = iter_live(iter(lines), "frontend")
+    count = 0
+    for ev in gen:
+        count += 1
+        seen_at.append(ev.arrival_us)
+    # 4 roots + 4 clients + 4 stub callees (single-source = stub mode)
+    assert count == 12
+    assert seen_at == sorted(seen_at)
+
+
+def test_collector_knobs_registered_typed_and_ranged():
+    for name in ("TW_COLLECTOR_PARTIAL", "TW_COLLECTOR_ORPHANS",
+                 "TW_COLLECTOR_SERVICE", "TW_SKEW_MIN_PAIRS",
+                 "TW_SKEW_MAX_US", "TW_SKEW_CHAOS_US"):
+        assert name in knobs.REGISTRY, name
+    assert knobs.REGISTRY["TW_COLLECTOR_PARTIAL"].choices == (
+        "synthetic", "deadletter")
+    assert knobs.REGISTRY["TW_COLLECTOR_ORPHANS"].lo == 1
+    assert knobs.REGISTRY["TW_SKEW_MAX_US"].lo == 0.0
+    # capture/skew are legal fault sites with per-seed determinism
+    plan = faults.parse_faults("capture:0.5,skew:1.0:max=1", seed=2)
+    assert plan.should_fail("skew") and not plan.should_fail("skew")
+
+
+# ---------------------------------------------------------------------------
+# events surface
+# ---------------------------------------------------------------------------
+
+def test_capture_events_tail_like_fault_ladder(bench, tmp_path, capsys):
+    """capture_loss / clock_skew / capture_churn events land in the
+    TW_EVENTS sink and `cli events` tails them (incl. --kind filter),
+    exactly like fault-ladder and adapt events."""
+    from traceweaver_tpu.obs import events as obs_events
+
+    sink = tmp_path / "events.jsonl"
+    prev = obs_events.install(obs_events.EventLog(str(sink)))
+    try:
+        # clean replay: churn (rekey) + skew-fit events
+        CollectorSource(bench._capture_workload(4, churn_at=2))
+        # faulted replay: chunk-loss events (drop a mid-capture chunk,
+        # not the first preface — dead directions emit no churn)
+        with faults.override("capture:0.3:max=2", seed=1):
+            CollectorSource(bench._capture_workload(4, churn_at=99))
+    finally:
+        obs_events.install(prev)
+    kinds = {json.loads(line)["kind"] for line in sink.read_text()
+             .splitlines()}
+    assert "capture_loss" in kinds
+    assert "capture_churn" in kinds
+    assert "clock_skew" in kinds
+    for kind in ("capture_loss", "clock_skew"):
+        assert kind in obs_events.KNOWN_KINDS
+        rc = obs_events.tail_main([str(sink), "--kind", kind, "-n", "0"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert f"{kind}/" in out
+
+
+# ---------------------------------------------------------------------------
+# stream emission: loss-discounted confidence
+# ---------------------------------------------------------------------------
+
+def test_confidence_discounted_by_observed_loss(bench, tmp_path):
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+        TraceSink,
+    )
+
+    logs = _truncated_logs(bench, n=6, drop_lines=3)
+    src = CollectorSource(logs)
+    rate = src.capture_quality()["loss_rate"]
+    assert rate > 0
+    cfg = StreamConfig(window_us=0.2e6, overlap_us=0.05e6,
+                       ooo_bound_us=0.02e6, verbose=False,
+                       checkpoint_every=10_000)
+    sink = TraceSink(str(tmp_path / "out.jsonl"))
+    svc = StreamingReconstructor(src, cfg, sink=sink)
+    summary = svc.run()
+    # the summary carries the capture ledger
+    assert summary["capture"]["loss_rate"] == rate
+    saw_capture = False
+    for raw in (tmp_path / "out.jsonl").read_text().splitlines():
+        rec = json.loads(raw)
+        tw = rec.get("tw.confidence")
+        if not tw:
+            continue
+        assert tw["capture"]["discount"] == pytest.approx(1.0 - rate)
+        saw_capture = True
+        for tconf in tw["traces"].values():
+            if tconf is not None:
+                assert tconf["conf"] <= 1.0 - rate + 1e-9
+    assert saw_capture, "no emitted record carried the capture block"
+
+
+# ---------------------------------------------------------------------------
+# serve ingestion mode
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+def test_serve_capture_endpoint_roundtrip(bench, tmp_path):
+    import threading
+    import urllib.request
+
+    from traceweaver_tpu.serve import ServeConfig, TenantService, make_server
+
+    service = TenantService(ServeConfig(
+        window_us=0.2e6, overlap_us=0.05e6, ooo_bound_us=0.02e6,
+        verbose=False, pump_windows=10 ** 9,
+        state_dir=str(tmp_path / "serve_state")))
+    server = make_server(service, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def call(method, path, data=None, ctype="application/json"):
+        req = urllib.request.Request(base + path, data=data, method=method)
+        if data:
+            req.add_header("Content-Type", ctype)
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json.loads(resp.read())
+
+    logs = bench._capture_workload(6, churn_at=3)
+    try:
+        # the multi-source bundle form: one post carries every host's
+        # capture so cross-source exchanges join (no duplicate roots)
+        out = call("POST", "/api/v1/tenants/cap/capture",
+                   json.dumps({"sources": logs}).encode())
+        assert out["ingested_spans"] == 18
+        assert out["rekeyed_streams"] == 1
+        flushed = call("POST", "/api/v1/tenants/cap/flush")
+        assert flushed["solved_windows"] >= 1
+        traces = call("GET", "/api/v1/tenants/cap/traces")
+        assert traces["n_traces"] == 6
+        rec = call("GET",
+                   f"/api/v1/tenants/cap/traces/{traces['trace_ids'][0]}")
+        assert rec["n_spans"] == 3
+        # single-source text form: stub-mode ingestion on a second tenant
+        out2 = call("POST", "/api/v1/tenants/cap2/capture?source=frontend",
+                    logs["frontend"].encode(), ctype="text/plain")
+        assert out2["ingested_spans"] == 18  # roots + clients + stubs
+    finally:
+        server.shutdown()
+        server.server_close()
+    service.drain()

@@ -85,6 +85,47 @@ def test_hpack_roundtrip_with_dynamic_table():
             assert len(enc.encode(headers)) < len(blob)
 
 
+def test_hpack_dynamic_table_eviction_under_resize():
+    """RFC 7541 §4.2/§6.3: a mid-block table-size update evicts from the
+    oldest end; entries evicted by the resize are no longer addressable
+    while surviving ones keep decoding — the live replay hits this when
+    a captured peer shrinks its table mid-connection."""
+    from traceweaver_tpu.collector.hpack import (
+        _STATIC,
+        Decoder,
+        encode_integer,
+        encode_string,
+    )
+
+    def literal_indexed(name: bytes, value: bytes) -> bytes:
+        return (encode_integer(0, 6, flags=0x40) + encode_string(name)
+                + encode_string(value))
+
+    dec = Decoder()
+    # two dynamic entries: "aaaa" (older) then "bbbb" (newer)
+    dec.decode(literal_indexed(b"x-aaaa", b"A" * 10)
+               + literal_indexed(b"x-bbbb", b"B" * 10))
+    assert len(dec.table.entries) == 2
+    base = len(_STATIC)
+    # newest first: index base+1 = x-bbbb, base+2 = x-aaaa
+    assert dec.decode(encode_integer(base + 2, 7, flags=0x80)) == [
+        ("x-aaaa", "A" * 10)]
+    # resize to hold exactly ONE entry (entry size = 6+10+32 = 48):
+    # the OLDER entry (x-aaaa) must evict, the newer one survives
+    resize = encode_integer(48, 5, flags=0x20)
+    assert dec.decode(resize + encode_integer(base + 1, 7, flags=0x80)) \
+        == [("x-bbbb", "B" * 10)]
+    assert [n for n, _ in dec.table.entries] == [b"x-bbbb"]
+    # the evicted index is now out of bounds — a hard HpackError, which
+    # the replay layer tolerates as a counted decode_error
+    with pytest.raises(HpackError, match="out of table bounds"):
+        dec.decode(encode_integer(base + 2, 7, flags=0x80))
+    # resize above the protocol max is a protocol error
+    with pytest.raises(HpackError, match="protocol max"):
+        Decoder(max_table_size=4096).decode(
+            encode_integer(65536, 5, flags=0x20))
+
+
 # ---------------------------------------------------------------------------
 # HTTP/2 framing helpers
 # ---------------------------------------------------------------------------
@@ -139,6 +180,48 @@ def test_replay_tolerates_truncated_tail():
     assert [e.kind for e in in_events if e.kind == "request"] == ["request"]
 
 
+def test_interleaved_continuation_drops_pending_counted():
+    """RFC 7540 §6.10: CONTINUATION must be contiguous with its HEADERS.
+    A capture interleaving another frame (or another stream's
+    CONTINUATION) drops the pending block — counted, and the replayer
+    keeps decoding subsequent well-formed blocks."""
+    from traceweaver_tpu.collector.http2 import (
+        CONTINUATION,
+        DirectionReplayer,
+    )
+
+    enc = Encoder()
+    block = enc.encode([(":method", "POST"), (":path", "/a"),
+                        (":authority", "svc")])
+    # HEADERS without END_HEADERS (expects CONTINUATION)...
+    headers_open = _frame(HEADERS, 0, 1, block[:4])
+    # ...but a DATA frame for another stream interleaves
+    interleaved = _frame(0x0, 0, 3, b"zz")
+    # a later complete request must still decode (fresh encoder state —
+    # the dropped block never reached the decoder's dynamic table)
+    enc2 = Encoder()
+    ok_request = _client_request_bytes(enc2, 5, "/b", "t2")
+    rep = DirectionReplayer()
+    events = rep.feed(PREFACE + headers_open + interleaved + ok_request)
+    assert rep.dropped_header_blocks == 1
+    reqs = [e for e in events if e.kind == "request"]
+    assert [e.stream_id for e in reqs] == [5]
+
+    # CONTINUATION for a DIFFERENT stream also drops the pending block
+    rep2 = DirectionReplayer()
+    wrong_stream = _frame(CONTINUATION, 0x4, 9, b"")
+    events2 = rep2.feed(PREFACE + headers_open + wrong_stream)
+    assert rep2.dropped_header_blocks == 1
+    assert [e for e in events2 if e.kind == "request"] == []
+
+    # the matching CONTINUATION completes the block normally
+    rep3 = DirectionReplayer()
+    done = _frame(CONTINUATION, 0x4, 1, block[4:])
+    events3 = rep3.feed(PREFACE + headers_open + done)
+    assert [e.stream_id for e in events3 if e.kind == "request"] == [1]
+    assert rep3.dropped_header_blocks == 0
+
+
 # ---------------------------------------------------------------------------
 # strace reassembly
 # ---------------------------------------------------------------------------
@@ -185,6 +268,78 @@ def _strace_lines_for(pid: int, op: str, fd: int, data: bytes, split_at=None):
         f'{pid} write({fd}, "{esc}", {len(data)} <unfinished ...>',
         f"{pid} <... write resumed> ) = {len(data)}",
     ]
+
+
+def test_strace_truncated_mid_escape_sequence():
+    """A log truncated mid-escape (the capture died mid-line) must not
+    crash or corrupt earlier streams: the partial line fails the
+    tokenizer and is counted unmatched, and unescape handles dangling
+    escapes at end-of-string."""
+    from traceweaver_tpu.collector.strace import StraceParser
+
+    # dangling escapes: lone backslash, partial hex, partial octal
+    assert unescape_strace("abc\\") == b"abc"
+    assert unescape_strace("abc\\x") == b"abcx"
+    assert unescape_strace("abc\\x4") == b"abc\x04"
+    assert unescape_strace("abc\\37") == b"abc\x1f"
+
+    payload = b"intact-data"
+    parser = StraceParser()
+    parser.feed_line(_strace_lines_for(11, "read", 7, payload)[0])
+    # the log ends mid-escape-sequence, no closing quote/ret
+    parser.feed_line('11 read(7, "partial\\x4')
+    parser.feed_line('11 read(7, "partial\\37')
+    streams = parser.finish()
+    assert parser.unmatched_lines == 2
+    assert streams[(7, 0)].inbound == payload
+
+
+def test_capture_ingest_rekeys_on_fd_reuse_without_close():
+    """Connection churn: an fd reused (peer reconnected) with NO close
+    syscall in the capture — the fresh HTTP/2 preface must re-key the
+    logical connection instead of concatenating two connections' bytes,
+    and both generations' exchanges must decode."""
+    from traceweaver_tpu.collector.http2 import SETTINGS as _S
+    from traceweaver_tpu.collector.source import (
+        CaptureCounters,
+        CaptureIngest,
+    )
+
+    def conn_bytes(key: str, enc: Encoder) -> bytes:
+        return (PREFACE + _frame(_S, 0, 0, b"")
+                + _client_request_bytes(enc, 1, "/x", key))
+
+    counters = CaptureCounters()
+    ing = CaptureIngest("svc", counters)
+    ing._on_payload((7, 0), "in", conn_bytes("gen0", Encoder()), 100.0)
+    # fd 7 reused with a fresh preface — no close line ever appeared
+    ing._on_payload((7, 0), "in", conn_bytes("gen1", Encoder()), 200.0)
+    ing.finish()
+    assert counters.rekeyed == {"svc": 1}
+    keys = sorted((r.key, r.gen) for r in ing.records)
+    assert keys == [("gen0", 0), ("gen1", 1)]
+    # both closed out half-open (requests had no captured response) —
+    # counted, synthesized under the default policy, never silent
+    assert counters.loss["svc"]["half_open"] == 2
+
+
+def test_strace_ttt_timestamps_attributed():
+    """strace -ttt epoch stamps ride the byte ranges (ts_at) and split
+    unfinished/resumed pairs stamp at the data-bearing line."""
+    from traceweaver_tpu.collector.strace import StraceParser
+
+    parser = StraceParser()
+    parser.feed_line('11 1722000000.250000 read(7, "abcd", 4) = 4')
+    parser.feed_line('12 1722000000.500000 write(7, "efgh", 4 '
+                     '<unfinished ...>')
+    parser.feed_line('12 1722000000.900000 <... write resumed> ) = 4')
+    streams = parser.finish()
+    s = streams[(7, 0)]
+    assert parser.saw_timestamps
+    assert s.ts_at("in", 0) == pytest.approx(1722000000.25e6)
+    # the write stamps at the UNFINISHED line (data already on the wire)
+    assert s.ts_at("out", 0) == pytest.approx(1722000000.5e6)
+    assert s.ts_at("out", 99) is None
 
 
 def test_strace_reassembly_with_unfinished_and_fd_reuse():

@@ -14,6 +14,13 @@ http2_filter.py) without the ``h2`` dependency:
 4. :mod:`.ebpf` — live-capture equivalent (BCC), import-gated.
 
 :func:`collect_from_strace_log` runs 1–3 end-to-end.
+
+The **capture ingress** (:mod:`.source` + :mod:`.skew`, docs/COLLECTOR.md)
+closes the loop the offline pipeline leaves open: it runs the same
+reassembly/replay machinery incrementally, hardens it against clock skew,
+partial capture, and connection churn, and emits the stream layer's
+timed span events — ``--source collector:<path|fifo>`` on the stream CLI,
+``POST /api/v1/tenants/<id>/capture`` on the serve server.
 """
 
 from __future__ import annotations
@@ -41,6 +48,12 @@ from traceweaver_tpu.collector.threading_model import (  # noqa: F401
     request_key,
     thread_predictability,
 )
+
+# NOTE: the capture ingress (collector.source.CollectorSource, the
+# skew/loss/churn hardening layer) is intentionally NOT imported here —
+# it pulls in the stream layer and numpy, and the offline pipeline above
+# must stay importable from lint/tail fast paths. Import it explicitly:
+#   from traceweaver_tpu.collector.source import CollectorSource
 
 
 @dataclass

@@ -122,6 +122,12 @@ class DirectionReplayer:
         self._opened: Dict[int, bool] = {}
         # pending HEADERS awaiting CONTINUATION: (stream, flags, frag, offset)
         self._pending: Optional[Tuple[int, int, bytearray, int]] = None
+        # capture-loss ledger (consumed by the collector ingress): header
+        # blocks dropped because a CONTINUATION sequence was interrupted
+        # or re-keyed, and HPACK fragments the lost-bootstrap tolerance
+        # skipped — every tolerated corruption is COUNTED, never silent
+        self.dropped_header_blocks = 0
+        self.decode_errors = 0
 
     def feed(self, data: bytes) -> List[Event]:
         """Add captured bytes; returns newly completed events."""
@@ -132,11 +138,17 @@ class DirectionReplayer:
         buf = bytes(self._buffer)
         pos = 0
         if not self._preface_checked:
-            if len(buf) < len(PREFACE):
-                return
             if buf.startswith(PREFACE):
                 pos = len(PREFACE)
-            self._preface_checked = True
+                self._preface_checked = True
+            elif PREFACE.startswith(buf):
+                return  # still a strict preface prefix: need more bytes
+            else:
+                # diverged from the preface: this direction starts at a
+                # frame boundary (a server direction, or a mid-stream
+                # attach) — decide NOW so short captures (a lone 10-byte
+                # response frame) don't wait forever for 24 bytes
+                self._preface_checked = True
         for frame in split_frames(buf, pos):
             pos = frame.offset + 9 + len(frame.payload)
             yield from self._handle(frame)
@@ -144,11 +156,22 @@ class DirectionReplayer:
         del self._buffer[:pos]
         self._consumed += pos
 
+    @property
+    def pending_bytes(self) -> int:
+        """Unconsumed tail bytes (a capture that ended mid-frame)."""
+        return len(self._buffer)
+
+    @property
+    def pending_headers(self) -> bool:
+        """A HEADERS block still awaiting CONTINUATION frames."""
+        return self._pending is not None
+
     def _handle(self, frame: Frame) -> Iterator[Event]:
         abs_offset = self._consumed + frame.offset
         if self._pending is not None and frame.type != CONTINUATION:
             # header block interrupted: drop it (tolerant replay)
             self._pending = None
+            self.dropped_header_blocks += 1
         if frame.type == HEADERS:
             frag = headers_fragment(frame)
             if frame.flags & FLAG_END_HEADERS:
@@ -168,7 +191,12 @@ class DirectionReplayer:
                         stream_id, flags, bytes(frag), offset
                     )
             else:
+                # interleaved CONTINUATION for a different stream: a
+                # protocol error on a live connection, but a real capture
+                # artifact under loss/churn — drop the pending block,
+                # counted (RFC 7540 §6.10 requires contiguity)
                 self._pending = None
+                self.dropped_header_blocks += 1
         elif frame.type == DATA:
             payload = _strip_padding(frame)
             yield Event("data", frame.stream_id, abs_offset,
@@ -187,6 +215,7 @@ class DirectionReplayer:
             # Mid-connection attach: the dynamic table bootstrap is lost.
             # Tolerate and skip, like the reference's error_count path
             # (parser.py:250-258).
+            self.decode_errors += 1
             return
         names = {n for n, _ in headers}
         end_stream = bool(flags & FLAG_END_STREAM)

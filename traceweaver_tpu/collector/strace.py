@@ -21,33 +21,38 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 # One regex per syscall family, complete and split forms
-# (reference parser.py:299-307 pattern1..pattern9).
+# (reference parser.py:299-307 pattern1..pattern9). The optional ``ts``
+# group accepts ``strace -ttt`` epoch timestamps (seconds.micro) right
+# after the pid — the capture ingress (collector/source.py) needs real
+# event times; logs recorded without ``-ttt`` still parse (ts=None, and
+# the parser substitutes a deterministic line-sequence clock).
+_PRE = r'^(?P<pid>\d+)\s+(?:(?P<ts>\d+\.\d+)\s+)?'
 _RE_COMPLETE = re.compile(
-    r'^(?P<pid>\d+)\s+(?P<op>read|write)\((?P<fd>\d+),\s*"(?P<data>(?:[^"\\]|\\.)*)"'
+    _PRE + r'(?P<op>read|write)\((?P<fd>\d+),\s*"(?P<data>(?:[^"\\]|\\.)*)"'
     r'(?:\.\.\.)?,\s*(?P<count>\d+)\)\s*=\s*(?P<ret>-?\d+)'
 )
 _RE_READ_UNFINISHED = re.compile(
-    r'^(?P<pid>\d+)\s+read\((?P<fd>\d+),\s*<unfinished\s+\.+>'
+    _PRE + r'read\((?P<fd>\d+),\s*<unfinished\s+\.+>'
 )
 _RE_READ_RESUMED = re.compile(
-    r'^(?P<pid>\d+)\s+<\.+\s+read resumed>\s*"(?P<data>(?:[^"\\]|\\.)*)"'
+    _PRE + r'<\.+\s+read resumed>\s*"(?P<data>(?:[^"\\]|\\.)*)"'
     r'(?:\.\.\.)?,\s*(?P<count>\d+)\)\s*=\s*(?P<ret>-?\d+)'
 )
 _RE_WRITE_UNFINISHED = re.compile(
-    r'^(?P<pid>\d+)\s+write\((?P<fd>\d+),\s*"(?P<data>(?:[^"\\]|\\.)*)"'
+    _PRE + r'write\((?P<fd>\d+),\s*"(?P<data>(?:[^"\\]|\\.)*)"'
     r'(?:\.\.\.)?,\s*(?P<count>\d+)\s*<unfinished\s+\.+>'
 )
 _RE_WRITE_RESUMED = re.compile(
-    r'^(?P<pid>\d+)\s+<\.+\s+write resumed>\s*\)\s*=\s*(?P<ret>-?\d+)'
+    _PRE + r'<\.+\s+write resumed>\s*\)\s*=\s*(?P<ret>-?\d+)'
 )
 _RE_CLOSE = re.compile(
-    r'^(?P<pid>\d+)\s+close\((?P<fd>\d+)\)\s*=\s*(?P<ret>-?\d+)'
+    _PRE + r'close\((?P<fd>\d+)\)\s*=\s*(?P<ret>-?\d+)'
 )
 _RE_CLOSE_UNFINISHED = re.compile(
-    r'^(?P<pid>\d+)\s+close\((?P<fd>\d+)\s*<unfinished\s+\.*>'
+    _PRE + r'close\((?P<fd>\d+)\s*<unfinished\s+\.*>'
 )
 _RE_CLOSE_RESUMED = re.compile(
-    r'^(?P<pid>\d+)\s+<\.*\s*close resumed>\s*\)\s*=\s*(?P<ret>-?\d+)'
+    _PRE + r'<\.*\s*close resumed>\s*\)\s*=\s*(?P<ret>-?\d+)'
 )
 
 _OCTAL = frozenset("01234567")
@@ -102,6 +107,10 @@ class ByteRange:
     start: int
     end: int
     seq: int  # global line order of the completing syscall
+    # capture timestamp of the syscall (µs since epoch under strace
+    # -ttt; the synthetic line-sequence clock otherwise) — the raw,
+    # per-source clock the skew estimator corrects, never solver time
+    ts_us: float = 0.0
 
 
 @dataclass
@@ -123,6 +132,15 @@ class FdStream:
                 return r.pid
         return None
 
+    def ts_at(self, direction: str, offset: int) -> Optional[float]:
+        """Capture timestamp (µs, raw source clock) of the syscall that
+        carried the byte at ``offset``; None when unattributed."""
+        ranges = self.read_ranges if direction == "in" else self.write_ranges
+        for r in ranges:
+            if r.start <= offset < r.end:
+                return r.ts_us
+        return None
+
 
 @dataclass
 class _Pending:
@@ -130,10 +148,23 @@ class _Pending:
     fd: Optional[int]
     data: Optional[str] = None
     count: Optional[int] = None
+    ts_us: Optional[float] = None
 
 
 class StraceParser:
-    """Streaming parser over strace log lines."""
+    """Streaming parser over strace log lines.
+
+    Two optional hooks let a live consumer ride the parse incrementally
+    (the capture ingress, :mod:`traceweaver_tpu.collector.source`):
+
+    - ``payload_hook(key, direction, payload, ts_us) -> bool`` fires per
+      completed read/write payload *before* it lands in the stream
+      buffers; returning False discards the payload (the capture-loss
+      fault site drops chunks here, so the buffers always match what the
+      consumer actually saw);
+    - ``close_hook(key)`` fires when an fd generation ends, so half-open
+      exchanges can be closed out promptly instead of at end-of-log.
+    """
 
     def __init__(self) -> None:
         self.streams: Dict[Tuple[int, int], FdStream] = {}
@@ -143,6 +174,9 @@ class StraceParser:
         self._pending: Dict[int, _Pending] = {}  # per-pid outstanding call
         self._seq = 0
         self.unmatched_lines = 0
+        self.payload_hook = None  # (key, dir, payload, ts_us) -> keep?
+        self.close_hook = None    # (key) -> None
+        self.saw_timestamps = False
 
     # -- helpers ----------------------------------------------------------
 
@@ -158,21 +192,29 @@ class StraceParser:
         return self.streams[key], self._in_buf[key], self._out_buf[key]
 
     def _record(self, pid: int, op: str, fd: int, data_str: str,
-                ret: int) -> None:
+                ret: int, ts_us: Optional[float] = None) -> None:
         if ret <= 0:
             return
         stream, in_buf, out_buf = self._stream(fd)
         payload = unescape_strace(data_str)[:ret]
+        if ts_us is None:
+            # no -ttt stamps in this log: a deterministic line-sequence
+            # clock (1 ms per line) keeps relative order meaningful
+            ts_us = self._seq * 1000.0
+        direction = "in" if op == "read" else "out"
+        if self.payload_hook is not None and not self.payload_hook(
+                self._key(fd), direction, payload, ts_us):
+            return
         if op == "read":
             stream.read_ranges.append(
                 ByteRange(pid, len(in_buf), len(in_buf) + len(payload),
-                          self._seq)
+                          self._seq, ts_us)
             )
             in_buf.extend(payload)
         else:
             stream.write_ranges.append(
                 ByteRange(pid, len(out_buf), len(out_buf) + len(payload),
-                          self._seq)
+                          self._seq, ts_us)
             )
             out_buf.extend(payload)
 
@@ -180,8 +222,17 @@ class StraceParser:
         key = self._key(fd)
         if key in self.streams:
             self._iteration[fd] = key[1] + 1
+            if self.close_hook is not None:
+                self.close_hook(key)
 
     # -- line handling ----------------------------------------------------
+
+    def _ts(self, m) -> Optional[float]:
+        raw = m.groupdict().get("ts")
+        if not raw:
+            return None
+        self.saw_timestamps = True
+        return float(raw) * 1e6
 
     def feed_line(self, line: str) -> None:
         self._seq += 1
@@ -192,7 +243,7 @@ class StraceParser:
         m = _RE_COMPLETE.match(line)
         if m:
             self._record(int(m["pid"]), m["op"], int(m["fd"]), m["data"],
-                         int(m["ret"]))
+                         int(m["ret"]), ts_us=self._ts(m))
             return
         m = _RE_READ_UNFINISHED.match(line)
         if m:
@@ -202,13 +253,18 @@ class StraceParser:
         if m:
             pending = self._pending.pop(int(m["pid"]), None)
             if pending is not None and pending.op == "read":
+                # reads stamp at the RESUMED line: that is when the data
+                # actually arrived in the process
                 self._record(int(m["pid"]), "read", pending.fd, m["data"],
-                             int(m["ret"]))
+                             int(m["ret"]), ts_us=self._ts(m))
             return
         m = _RE_WRITE_UNFINISHED.match(line)
         if m:
+            # writes stamp at the UNFINISHED line: the payload was
+            # submitted (and visible on the wire) before the call blocked
             self._pending[int(m["pid"])] = _Pending(
-                "write", int(m["fd"]), m["data"], int(m["count"])
+                "write", int(m["fd"]), m["data"], int(m["count"]),
+                ts_us=self._ts(m)
             )
             return
         m = _RE_WRITE_RESUMED.match(line)
@@ -216,7 +272,8 @@ class StraceParser:
             pending = self._pending.pop(int(m["pid"]), None)
             if pending is not None and pending.op == "write":
                 self._record(int(m["pid"]), "write", pending.fd,
-                             pending.data, int(m["ret"]))
+                             pending.data, int(m["ret"]),
+                             ts_us=pending.ts_us)
             return
         m = _RE_CLOSE.match(line)
         if m:

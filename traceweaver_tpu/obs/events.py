@@ -70,6 +70,21 @@ class EventLog:
             self._f.close()
 
 
+#: event kinds the subsystems emit (the ``cli events --kind`` values);
+#: not enforced on emit — the sink takes any kind — but kept here so the
+#: tail tool's help can name the tailing surface completely
+KNOWN_KINDS = (
+    "fault_ladder",       # solve-supervisor rungs (retry/bisect/...)
+    "fault_injected",     # chaos stimulus draws (runtime/faults.py)
+    "confidence_drift",   # PSI excursions (obs/quality.py)
+    "adapt",              # adaptation-ladder actuations (adapt/)
+    "slo_breach",         # seal→emit p99 excursions (stream/serve)
+    "serve",              # serve-layer lifecycle (dispatcher degradation)
+    "capture_loss",       # capture ingress losses per reason
+    "capture_churn",      # connection re-keying (collector/source.py)
+    "clock_skew",         # per-source skew fits (collector/skew.py)
+)
+
 _ACTIVE: Optional[EventLog] = None
 
 
@@ -128,8 +143,9 @@ def tail_main(argv: List[str]) -> int:
     p = argparse.ArgumentParser(
         prog="python -m traceweaver_tpu.runtime.cli events",
         description="Tail a structured JSONL event sink (fault-ladder "
-                    "events, quarantine dead-letters — one record per "
-                    "line, docs/OBSERVABILITY.md).")
+                    "events, quarantine dead-letters, capture-loss / "
+                    "clock-skew excursions — one record per line, "
+                    "docs/OBSERVABILITY.md).")
     p.add_argument("path", help="event/dead-letter JSONL file")
     p.add_argument("-n", type=int, default=20,
                    help="show the last N records (default 20; 0 = all)")
@@ -137,7 +153,8 @@ def tail_main(argv: List[str]) -> int:
                    help="keep the file open and print records as they "
                         "arrive (Ctrl-C to stop)")
     p.add_argument("--kind", default=None,
-                   help="only records whose 'kind' field matches")
+                   help="only records whose 'kind' field matches; known "
+                        "kinds: " + ", ".join(KNOWN_KINDS))
     args = p.parse_args(argv)
     if not os.path.exists(args.path):
         print(f"events: no such file: {args.path}", file=sys.stderr)

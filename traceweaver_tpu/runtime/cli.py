@@ -138,8 +138,13 @@ def build_stream_parser() -> argparse.ArgumentParser:
         description="Online windowed reconstruction over a span stream "
                     "(docs/STREAMING.md).")
     p.add_argument("--source", required=True,
-                   help="source spec, e.g. replay:<corpus-dir>"
-                        "[?fix=2&max_traces=200&ooo_ms=50&seed=0]")
+                   help="source spec: replay:<corpus-dir>"
+                        "[?fix=2&max_traces=200&ooo_ms=50&seed=0] replays "
+                        "a recorded Jaeger corpus; "
+                        "collector:<strace-log|dir|fifo>[?service=name] "
+                        "is the live-capture ingress (uninstrumented "
+                        "apps — strace/eBPF capture -> HTTP/2 replay -> "
+                        "skew-corrected spans, docs/COLLECTOR.md)")
     p.add_argument("--fix", type=int, default=0,
                    help="dataset FIX mode for replay sources (overridden "
                         "by a ?fix= query in --source)")
@@ -215,6 +220,10 @@ def stream_main(argv) -> int:
         print(f"--resume: no checkpoint at {args.checkpoint!r}",
               file=sys.stderr)
         return 2
+    # observability wires up BEFORE the source builds: a collector:
+    # source emits capture_loss/clock_skew/capture_churn events while
+    # parsing the capture — constructing it first would lose them
+    _, tracer, selftrace_path = _obs_setup(args.metrics_port)
     source = parse_source_spec(
         args.source, fix=args.fix, max_traces=args.max_traces,
         ooo_us=args.ooo_ms * 1000.0, strict=args.strict)
@@ -240,7 +249,6 @@ def stream_main(argv) -> int:
                                                 sink=sink)
     else:
         service = StreamingReconstructor(source, cfg, sink=sink)
-    _, tracer, selftrace_path = _obs_setup(args.metrics_port)
     summary = service.run()
     _obs_finish(tracer, selftrace_path)
 
@@ -278,6 +286,19 @@ def stream_main(argv) -> int:
                  summary.get("deadletter_windows", 0),
                  summary.get("deadletter_spans", 0),
                  summary.get("deadletter_bytes", 0)))
+    # capture ingress ledger (collector: sources only): loss/churn/skew
+    # visibility on the console, mirroring the /metrics families
+    cap = summary.get("capture")
+    if cap is not None:
+        skews = cap.get("skew_us", {})
+        print("[stream] capture: %d spans delivered (%d synthetic), "
+              "loss rate %.2f%% %s; %d streams re-keyed; skew %s"
+              % (cap.get("delivered_spans", 0),
+                 cap.get("synthetic_spans", 0),
+                 100.0 * cap.get("loss_rate", 0.0),
+                 dict(cap.get("loss", {})) or "{}",
+                 cap.get("rekeyed_streams", 0),
+                 {k: "%+.0fus" % v for k, v in skews.items()} or "none"))
     streamed_acc = None
     if "accuracy" in summary:
         streamed_acc = summary["accuracy"]["e2e"]

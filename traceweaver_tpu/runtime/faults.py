@@ -33,7 +33,19 @@ Sites (anything else raises — the ops/precision.py raise-on-typo rule):
   unlike the transient sites above, a faulted ring would poison every
   LATER dispatch that gathers from it, so the supervisor answers with
   the ring-invalidate-and-rebuild rung (``devcols_ring_rebuilds``)
-  before retrying.
+  before retrying;
+- ``capture``    — capture ingress payload chunks
+  (``collector/source.py``): a drawn chunk is DROPPED, not retried (a
+  collector cannot re-read bytes the kernel already discarded), and the
+  rest of that connection direction is discarded with it — you cannot
+  resynchronize an HTTP/2 byte stream after a gap — all counted in
+  ``tw_capture_loss_total`` and absorbed by the partial-capture policy;
+- ``skew``       — per-capture-source clock skew: a drawn source's raw
+  timestamps are offset by ``TW_SKEW_CHAOS_US`` before the ingress sees
+  them, the stimulus the skew estimator must detect and correct. Like
+  ``capture``, this site is consumed via ``plan.should_fail`` (a state
+  perturbation, not a raised error), so :func:`maybe_fail` never fires
+  for it inside the solve supervisor.
 
 Determinism: one seeded RNG shared across sites, so a given
 ``(spec, seed)`` produces one fixed draw sequence. Under the pipelined
@@ -53,7 +65,8 @@ from contextlib import contextmanager
 from typing import Dict, Optional
 
 #: every legal injection site, in ladder order of first appearance
-SITES = ("dispatch", "fetch", "host", "checkpoint", "source", "devcols")
+SITES = ("dispatch", "fetch", "host", "checkpoint", "source", "devcols",
+         "capture", "skew")
 
 
 class FaultError(RuntimeError):

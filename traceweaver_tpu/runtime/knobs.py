@@ -107,6 +107,31 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("TW_JAX_CACHE_DIR", "str", None, help="compile cache location"),
     _k("TW_DISABLE_NATIVE", "bool", False,
        help="force the pure-Python ingest parser"),
+    # --- capture ingress (traceweaver_tpu/collector, docs/COLLECTOR.md) --
+    _k("TW_COLLECTOR_PARTIAL", "enum", "synthetic",
+       choices=("synthetic", "deadletter"),
+       help="half-open/truncated capture exchanges: 'synthetic' (default) "
+            "closes them out as counted synthetic spans at the last "
+            "observed activity; 'deadletter' drops them with accounting "
+            "(capture_loss{reason=half_open_dropped})"),
+    _k("TW_COLLECTOR_ORPHANS", "int", 256, lo=1, hi=1 << 16,
+       help="per-source bound on open exchanges awaiting their response "
+            "(the orphan buffer); past it the oldest is evicted, counted, "
+            "and handled per TW_COLLECTOR_PARTIAL"),
+    _k("TW_COLLECTOR_SERVICE", "str", None,
+       help="service name for a single-file capture source (default: the "
+            "file stem; a collector:<path>?service= query overrides both)"),
+    _k("TW_SKEW_MIN_PAIRS", "int", 3, lo=1,
+       help="cross-source request/response pairs required before the "
+            "first clock-skew fit (collector/skew.py)"),
+    _k("TW_SKEW_MAX_US", "float", 30e6, lo=0.0,
+       help="clamp on fitted per-source clock offsets (µs): a corrupt "
+            "capture must not fling a source outside every window; "
+            "clamps are counted as capture loss"),
+    _k("TW_SKEW_CHAOS_US", "float", 250000.0, lo=0.0,
+       help="injected per-source clock offset (µs) applied when the "
+            "'skew' fault site draws — the chaos stimulus the skew "
+            "estimator must detect and correct"),
     # --- faults / robustness (this PR) -----------------------------------
     _k("TW_FAULTS", "str", None,
        help="fault-injection spec, e.g. dispatch:0.2,fetch:0.05 "

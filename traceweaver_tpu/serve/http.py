@@ -8,6 +8,7 @@ state mutation happens inside :class:`TenantService`'s lock.
 Endpoint reference (full table + curl quickstart in docs/SERVING.md)::
 
     POST /api/v1/tenants/<id>/spans                Jaeger-JSON {"data": [...]}
+    POST /api/v1/tenants/<id>/capture              raw strace log (?source=)
     POST /api/v1/tenants/<id>/flush                seal+solve now (one tenant)
     POST /api/v1/flush                             seal+solve now (all)
     GET  /api/v1/tenants                           tenant list
@@ -79,7 +80,7 @@ class ServeHandler(BaseHTTPRequestHandler):
     def _error(self, code: int, message: str) -> None:
         self._reply(code, {"error": message})
 
-    def _read_json(self) -> Optional[dict]:
+    def _read_body(self, expected: str) -> Optional[bytes]:
         try:
             length = int(self.headers.get("Content-Length", "0"))
         except ValueError:
@@ -90,7 +91,13 @@ class ServeHandler(BaseHTTPRequestHandler):
             return None
         raw = self.rfile.read(length) if length else b""
         if not raw:
-            self._error(400, "empty body (expected Jaeger JSON)")
+            self._error(400, f"empty body (expected {expected})")
+            return None
+        return raw
+
+    def _read_json(self) -> Optional[dict]:
+        raw = self._read_body("Jaeger JSON")
+        if raw is None:
             return None
         try:
             return json.loads(raw)
@@ -109,13 +116,41 @@ class ServeHandler(BaseHTTPRequestHandler):
 
     # -- verbs ------------------------------------------------------------
     def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
-        tenant_id, sub, _query = self._tenant_route()
+        tenant_id, sub, query = self._tenant_route()
         try:
             if tenant_id is not None and sub == "/spans":
                 payload = self._read_json()
                 if payload is None:
                     return
                 self._reply(200, self.service.ingest(tenant_id, payload))
+            elif tenant_id is not None and sub == "/capture":
+                # the collector ingress (docs/COLLECTOR.md): raw strace
+                # -f [-ttt] log text (?source= names the capture host;
+                # uncaptured callees synthesize as stubs), or a JSON
+                # {"sources": {name: text}} bundle carrying every host's
+                # capture of the window so cross-source exchanges join
+                # and the skew fit sees its pairs
+                raw = self._read_body("an strace log or "
+                                      '{"sources": {...}}')
+                if raw is None:
+                    return
+                ctype = (self.headers.get("Content-Type") or "").split(
+                    ";")[0].strip()
+                if ctype == "application/json":
+                    try:
+                        bundle = json.loads(raw)
+                    except json.JSONDecodeError as e:
+                        self._error(400, f"invalid JSON: {e}")
+                        return
+                    captures = (bundle or {}).get("sources")
+                    if not isinstance(captures, dict) or not captures:
+                        self._error(400, 'expected {"sources": '
+                                         '{name: strace log text}}')
+                        return
+                else:
+                    captures = raw.decode("utf-8", "replace")
+                self._reply(200, self.service.ingest_capture(
+                    tenant_id, captures, source=query.get("source")))
             elif tenant_id is not None and sub == "/flush":
                 self.service.tenant(tenant_id, create=False)
                 self._reply(200, self.service.flush(tenant_id))

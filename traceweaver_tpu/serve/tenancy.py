@@ -233,6 +233,12 @@ class Tenant:
         # must not advance past them (their spans are still being
         # decoded/stitched)
         self.in_flight: List = []
+        # capture ingestion (docs/COLLECTOR.md): lazy (CaptureCounters,
+        # SkewEstimator) pair shared across every capture POST this
+        # tenant receives, so loss rates/skew accumulate per tenant; the
+        # stream service's confidence discount reads it through
+        # capture_quality_ext once armed
+        self._capture = None
 
     # -- ingestion --------------------------------------------------------
     def ingest_payload(self, payload: dict) -> Dict[str, int]:
@@ -278,6 +284,58 @@ class Tenant:
             ingested_spans=n_spans,
             rejected_traces=rejected,
             malformed_spans=self.ingest_counters.get("malformed_spans", 0),
+            backlog=self.backlog,
+        )
+
+    def ingest_capture(self, captures,
+                       source: Optional[str] = None) -> Dict[str, int]:
+        """Fold one posted ``strace -f [-ttt]`` capture into the
+        tenant's stream (``POST /api/v1/tenants/<id>/capture`` — the
+        serve half of the capture-to-trace loop, docs/COLLECTOR.md).
+
+        ``captures`` is either one log's text (single capture host,
+        named by ``source``; uncaptured callees synthesize as stubs) or
+        a ``{source name: log text}`` bundle — one post carrying every
+        host's capture of the same time window, so cross-source
+        exchanges join, the skew fit sees its pairs, and callee spans
+        attach to their callers instead of minting duplicate roots.
+
+        Every log runs through the collector ingress — HTTP/2 replay,
+        skew correction, partial-capture/churn hardening — and every
+        recovered span feeds the same watermark → windowing → scheduler
+        loop a Jaeger POST does. Loss/skew/churn ledgers accumulate
+        across posts (per tenant), and once a tenant has posted a
+        capture its emitted-trace confidence is discounted by the
+        observed loss rate."""
+        from traceweaver_tpu.collector.source import (
+            CaptureCounters,
+            CollectorSource,
+        )
+        from traceweaver_tpu.collector.skew import SkewEstimator
+
+        self._bump("capture_posts")
+        if self._capture is None:
+            counters, estimator = CaptureCounters(), SkewEstimator()
+            self._capture = (counters, estimator)
+            self.svc.capture_quality_ext = (
+                lambda: counters.snapshot(skew=estimator))
+        counters, estimator = self._capture
+        if isinstance(captures, str):
+            captures = {(source or "capture"): captures}
+        src = CollectorSource(captures,
+                              counters=counters, estimator=estimator)
+        n_spans = 0
+        for ev in src.events():
+            self._ingest_event(ev)
+            n_spans += 1
+        self._bump("capture_spans", n_spans)
+        quality = src.capture_quality()
+        return dict(
+            ingested_spans=n_spans,
+            capture_loss=quality["loss"],
+            capture_loss_rate=quality["loss_rate"],
+            rekeyed_streams=quality["rekeyed_streams"],
+            skew_us=quality.get("skew_us", {}),
             backlog=self.backlog,
         )
 
@@ -545,6 +603,22 @@ class TenantService:
         SHARED dispatches instead of each POST solving alone)."""
         with self._lock:
             summary = self.tenant(tenant_id).ingest_payload(payload)
+            if self.dispatcher is None:
+                if self.total_backlog() >= self.cfg.pump_windows:
+                    summary["pumped_windows"] = self.pump()
+        if self.dispatcher is not None:
+            self.dispatcher.kick()
+        return summary
+
+    def ingest_capture(self, tenant_id: str, captures,
+                       source: Optional[str] = None) -> Dict[str, int]:
+        """Capture ingestion for one tenant (the collector ingress
+        behind ``POST /api/v1/tenants/<id>/capture``): raw log text or
+        a ``{source: text}`` bundle; same pump/kick discipline as
+        :meth:`ingest`."""
+        with self._lock:
+            summary = self.tenant(tenant_id).ingest_capture(
+                captures, source=source)
             if self.dispatcher is None:
                 if self.total_backlog() >= self.cfg.pump_windows:
                     summary["pumped_windows"] = self.pump()

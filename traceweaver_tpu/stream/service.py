@@ -231,6 +231,13 @@ class StreamingReconstructor:
         # window to re-fit from (one window per service — bounded;
         # regenerates after a resume, so it never rides checkpoints)
         self.adapt_material: Dict[str, _WindowProblem] = {}
+        # capture-quality hook (docs/COLLECTOR.md): a source that knows
+        # its own capture loss (CollectorSource.capture_quality) — or an
+        # external feeder like the serve capture endpoint, via this
+        # attribute — discounts every emitted trace's confidence by the
+        # observed loss rate and lands a capture block in the summary.
+        # None (every instrumented/replay source) is fully inert.
+        self.capture_quality_ext = None
         # SLO-breach excursion arming (one event per excursion,
         # re-armed when the p99 falls back under the budget)
         self._slo_breached = False
@@ -591,28 +598,69 @@ class StreamingReconstructor:
         single-tenant stream path."""
         return self.trace_prefix.rstrip(":") or "default"
 
+    def _capture_quality(self) -> Optional[Dict]:
+        """The source's capture ledger, when one exists: a collector
+        source's own ``capture_quality()`` wins, else the external
+        feeder hook (``capture_quality_ext``, the serve capture
+        endpoint). None everywhere else — zero cost on the default
+        instrumented/replay paths."""
+        fn = getattr(self.source, "capture_quality", None)
+        if fn is None:
+            fn = self.capture_quality_ext
+        return fn() if fn is not None else None
+
     def window_confidence(self, res: WindowResult) -> Optional[Dict]:
         """The window's ``tw.confidence`` payload: the per-window summary
         plus one per-trace summary per stitched trace (min over the
         trace's solved spans — a trace is right only if every span is).
         None when the quality path is off or the solve produced no
-        records (docs/OBSERVABILITY.md "Quality telemetry")."""
+        records (docs/OBSERVABILITY.md "Quality telemetry").
+
+        Capture-derived streams additionally discount every confidence
+        by ``1 - loss_rate`` of the capture (docs/COLLECTOR.md): a
+        solver that never SAW the dropped spans can be arbitrarily
+        confident about a wrong containment, so trust in the emitted
+        traces must fall with observed capture loss even while the
+        solver's own margins stay high. The discount and the rate ride
+        the payload (``capture`` block), so consumers can tell solver
+        doubt from capture doubt."""
         if not res.confidence:
             return None
         merged: Dict = {}
         for recs in res.confidence.values():
             merged.update(recs)
-        return dict(
+        out = dict(
             window=_quality.window_confidence_summary(merged),
             traces={tid: _quality.trace_confidence(ids, merged)
                     for tid, ids in sorted(res.traces.items())},
         )
+        cap = self._capture_quality()
+        if cap is not None:
+            rate = float(cap.get("loss_rate", 0.0))
+            disc = max(0.0, 1.0 - rate)
+            if disc < 1.0:
+                for tconf in out["traces"].values():
+                    if tconf is not None:
+                        tconf["conf"] = round(tconf["conf"] * disc, 4)
+                        tconf["mean"] = round(tconf["mean"] * disc, 4)
+                w = out["window"]
+                for k in ("min", "mean"):
+                    if k in w:
+                        w[k] = round(w[k] * disc, 4)
+            out["capture"] = dict(loss_rate=round(rate, 4),
+                                  discount=round(disc, 4))
+        return out
 
     def _observe_confidence(self, res: WindowResult,
                             conf: Optional[Dict]) -> None:
         """Land one emitted window's quality telemetry: per-trace
         histogram + low-confidence counters (per tenant) and the
-        per-service drift watcher."""
+        per-service drift watcher. The trace-level surfaces consume the
+        payload's (capture-discounted) values — trust falls with loss;
+        the drift watcher consumes the RAW solver records, so a lossy
+        capture cannot masquerade as score-model drift and trip the
+        adaptation ladder into refits that cannot help it (capture loss
+        has its own counters)."""
         if conf is None:
             return
         tenant = self._conf_tenant()
@@ -1098,6 +1146,12 @@ class StreamingReconstructor:
             ),
             seal_emit_p99_ms=self.seal_emit_p99_ms(),
         )
+        cap = self._capture_quality()
+        if cap is not None:
+            # capture ingress ledger (docs/COLLECTOR.md): per-source
+            # loss/churn counters and the fitted skew offsets — present
+            # only when the source IS a capture
+            out["capture"] = cap
         if final and self.grader is not None:
             out["accuracy"] = self.grader.finish()
         return out

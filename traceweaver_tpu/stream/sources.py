@@ -39,6 +39,18 @@ class SpanEvent:
     (``traceweaver_tpu/serve``) parses each posted Jaeger-JSON payload
     and feeds every span as one SpanEvent into the owning tenant's
     pipeline, so network ingestion and replay share one event contract.
+
+    **Capture-derived spans** (``collector:`` sources,
+    ``traceweaver_tpu/collector/source.py``) carry one extra semantic:
+    ``capture_us`` is the span's RAW capture timestamp on its source's
+    own clock, while ``event_us`` is solver event time — the same stamp
+    *after* per-source clock-skew correction. The two differ by the
+    source's fitted offset (``tw_clock_skew_us{source}``); consumers
+    that need the original capture clock (debugging a capture, joining
+    back to an strace log) must read ``capture_us``, and everything
+    event-time ordered (watermarks, windows, the solver) must keep
+    using ``event_us``. None on instrumented/replay sources, where the
+    two clocks are the same thing.
     """
 
     span: Span
@@ -46,6 +58,7 @@ class SpanEvent:
     arrival_us: float
     trace_id: str
     processes: Dict[str, str]
+    capture_us: Optional[float] = None
 
 
 class ReplaySource:
@@ -132,23 +145,44 @@ class IterableSource:
 
 def parse_source_spec(spec: str, fix: int = 0, max_traces: int = 1000,
                       ooo_us: float = 0.0, seed: int = 0,
-                      strict: bool = False) -> ReplaySource:
+                      strict: bool = False):
     """Parse a ``--source`` spec into a source.
 
-    ``replay:<dir>`` with optional query parameters overriding the
-    defaults, e.g.::
+    ``replay:<dir>`` replays a recorded Jaeger-style corpus, with
+    optional query parameters overriding the defaults, e.g.::
 
         replay:data/hotel_reservation/hotel_load25?fix=2&max_traces=200
         replay:/abs/path?fix=5&ooo_ms=50&seed=3
 
     Recognized query keys: ``fix``, ``max_traces``, ``ooo_ms`` /
     ``ooo_us``, ``seed``.
+
+    ``collector:<path|fifo>`` is the live-capture ingress
+    (docs/COLLECTOR.md): ``<path>`` is one recorded ``strace -f -ttt``
+    log (one capture source), a directory of per-source logs
+    (``*.log``/``*.txt``/``*.strace``, one clock each — cross-source
+    skew is fitted and corrected), or a FIFO fed by a live ``strace``
+    (single-source incremental mode). Query key ``service`` names the
+    single-file source's service (default ``TW_COLLECTOR_SERVICE``,
+    then the file stem). The replay knobs (``fix``/``ooo_ms``/...) do
+    not apply: arrival order and out-of-orderness come from the capture
+    itself.
     """
+    if spec.startswith("collector:"):
+        from traceweaver_tpu.collector.source import CollectorSource
+
+        rest = spec[len("collector:"):]
+        path, _, query = rest.partition("?")
+        params = dict(urllib.parse.parse_qsl(query))
+        return CollectorSource.from_spec(path,
+                                         service=params.get("service"))
     if not spec.startswith("replay:"):
         raise ValueError(
-            f"unknown source spec {spec!r}: only 'replay:<corpus-dir>' "
-            "sources are available (live collector ingress plugs in via "
-            "stream.sources.IterableSource)")
+            f"unknown source spec {spec!r}: expected "
+            "'replay:<corpus-dir>' (recorded Jaeger corpus) or "
+            "'collector:<strace-log|dir|fifo>' (live-capture ingress, "
+            "docs/COLLECTOR.md); arbitrary in-process streams plug in "
+            "via stream.sources.IterableSource)")
     rest = spec[len("replay:"):]
     path, _, query = rest.partition("?")
     params = dict(urllib.parse.parse_qsl(query))
