@@ -818,6 +818,162 @@ def continuous_fields(n_tenants: int, slo_ms: float, fixed: dict,
     }
 
 
+def aot_fields(status: dict) -> dict:
+    """AOT warmup ledger -> report fields (unit-tested like
+    chaos_fields/serve_fields, tests/test_bench.py).
+
+    ``status`` is :func:`traceweaver_tpu.runtime.aot.status` (or the
+    ``aot`` block a cold-start child reports): lattice size, progress,
+    compile seconds, and the bounded miss ledger — the production
+    inputs for tuning ``TW_AOT_HORIZON``."""
+    misses = dict(status.get("misses", {}))
+    return {
+        "aot_mode": status.get("mode"),
+        "aot_phase": status.get("phase"),
+        "aot_lattice_size": int(status.get("planned", 0)),
+        "aot_precompiled": int(status.get("compiled", 0)),
+        "aot_compile_s": round(float(status.get("compile_s", 0.0)), 3),
+        "aot_misses": misses,
+        "aot_miss_count": int(sum(misses.values())),
+    }
+
+
+def coldstart_fields(cold: dict, warm: dict, target_s: float = 5.0) -> dict:
+    """Cold-start leg child reports -> report fields (unit-tested like
+    chaos_fields/serve_fields, tests/test_bench.py).
+
+    ``cold``/``warm`` each summarize one FRESH subprocess (cold vs warm
+    persistent compile cache, identical TW_AOT=eager config) measuring
+    process start -> first emitted trace. The headline pair: the
+    warm-cache restart must reach its first trace inside ``target_s``
+    (the rolling-restart bar, ROADMAP item 2) AND perform zero backend
+    compiles during the measured solve — a fast restart that still
+    compiles is a horizon gap, visible in the aot_* miss fields."""
+    cold_s = cold.get("first_trace_s")
+    warm_s = warm.get("first_trace_s")
+    speedup = (round(cold_s / warm_s, 2)
+               if cold_s and warm_s and warm_s > 0 else None)
+    solve_compiles = warm.get("fleet_backend_compiles")
+    measured = warm.get("measured_compiles", {})
+    out = {
+        "cold_start_s": cold_s,
+        "warm_start_s": warm_s,
+        "coldstart_target_s": float(target_s),
+        "coldstart_speedup": speedup,
+        "coldstart_warm_under_target": bool(
+            warm_s is not None and warm_s < target_s),
+        "coldstart_warm_solve_compiles": (
+            None if solve_compiles is None else int(solve_compiles)),
+        "coldstart_warm_zero_solve_compiles": solve_compiles == 0,
+        "coldstart_warm_measured_backend_compiles": int(
+            measured.get("backend_compiles", 0)),
+        "coldstart_warmup_s_cold": cold.get("warmup_s"),
+        "coldstart_warmup_s_warm": warm.get("warmup_s"),
+    }
+    out.update(aot_fields(warm.get("aot", {})))
+    return out
+
+
+def run_coldstart_child(out_path: str, spawn_ts: float,
+                        n_bursts: int) -> None:
+    """bench.py --mode coldstart: one fresh process of the cold-start
+    leg — enable the persistent cache, run the TW_AOT=eager lattice
+    warmup, stream a tiny synthetic corpus to its FIRST emitted trace,
+    and report the timeline + compile ledgers. ``spawn_ts`` is the
+    parent's clock at Popen, so ``first_trace_s`` includes interpreter
+    start and imports — the number a rolling restart actually waits."""
+    import jax
+
+    if _knobs.get("TW_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from traceweaver_tpu.runtime.jax_cache import (
+        compile_counters,
+        counters_delta,
+        enable_persistent_compilation_cache,
+    )
+
+    cache_dir = enable_persistent_compilation_cache()
+    from traceweaver_tpu.runtime import aot
+
+    t0 = time.time()
+    aot.startup_warmup(context="bench-coldstart")
+    warmup_s = time.time() - t0
+
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+    )
+    from traceweaver_tpu.stream.sources import IterableSource
+
+    events, _ = _adapt_burst_events(n_bursts, shift_at=10 ** 9)
+    cfg = StreamConfig(window_us=1e6, overlap_us=0.0, ooo_bound_us=1e3,
+                       checkpoint_every=10_000, verbose=False)
+    svc = StreamingReconstructor(IterableSource(events), cfg)
+    before = compile_counters()
+    svc.run(max_windows=1)
+    t_first = time.time()
+    st = aot.status()
+    write_json_atomic(out_path, dict(
+        first_trace_s=round(t_first - spawn_ts, 3),
+        warmup_s=round(warmup_s, 3),
+        measured_compiles=counters_delta(before),
+        fleet_backend_compiles=int(
+            svc.fleet_stats.get("backend_compiles", 0)),
+        emitted_windows=int(svc.emitted_windows),
+        cache_dir=cache_dir,
+        aot=dict(mode=st["mode"], phase=st["phase"],
+                 planned=st["planned"], compiled=st["compiled"],
+                 compile_s=round(float(st["compile_s"]), 3),
+                 misses=st["misses"]),
+    ))
+
+
+def run_coldstart_leg(n_bursts: int) -> dict:
+    """bench.py --cold-start N: the serving cold-start leg.
+
+    Two FRESH subprocesses run the identical TW_AOT=eager startup
+    (lattice warmup sized to the leg's single-service corpus) and
+    measure process start -> first emitted trace: the first against a
+    COLD persistent compile cache (every lattice variant compiles),
+    the second against the cache the first just wrote (every variant
+    deserializes). The warm number is the rolling-restart cost the
+    /readyz gate holds traffic for; the acceptance bar is < 5 s on
+    this CPU stand-in with ZERO backend compiles during the measured
+    solve (TPU targets ride the driver's bench)."""
+    workdir = tempfile.mkdtemp(prefix="tw_coldstart_")
+    cache_dir = os.path.join(workdir, "jax_cache")
+    env = dict(os.environ)
+    env.update(TW_JAX_CACHE_DIR=cache_dir, TW_JAX_CACHE="1",
+               TW_AOT="eager", TW_AOT_TIER="core",
+               TW_AOT_HORIZON="1:1:8:8")
+
+    def child(tag: str) -> dict:
+        out = os.path.join(workdir, f"coldstart_{tag}.json")
+        spawn_ts = time.time()
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "bench.py"),
+             "--mode", "coldstart", "--out", out,
+             "--spawn-ts", repr(spawn_ts), "--cold-start", str(n_bursts)],
+            cwd=HERE, env=env, stdout=sys.stderr, stderr=sys.stderr)
+        rc = proc.wait(timeout=600)
+        if rc != 0 or not os.path.exists(out):
+            raise RuntimeError(f"coldstart {tag} child failed rc={rc}")
+        with open(out) as f:
+            report = json.load(f)
+        log("coldstart %s: first trace %.2fs (warmup %.2fs, %d solve "
+            "compiles)" % (tag, report["first_trace_s"],
+                           report["warmup_s"],
+                           report["fleet_backend_compiles"]))
+        return report
+
+    cold = child("cold")
+    warm = child("warm")
+    report = {"bench": "coldstart", "backend": "cpu",
+              "n_bursts": int(n_bursts)}
+    report.update(coldstart_fields(cold, warm))
+    return report
+
+
 def run_continuous_leg(n_tenants: int) -> dict:
     """bench.py --continuous N: the continuous-batching service leg.
 
@@ -2403,10 +2559,23 @@ def main() -> None:
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["parent", "solver", "baseline"],
+    ap.add_argument("--mode",
+                    choices=["parent", "solver", "baseline", "coldstart"],
                     default="parent")
     ap.add_argument("--bundle")
     ap.add_argument("--out")
+    ap.add_argument("--spawn-ts", type=float, default=None,
+                    help="(coldstart child) parent clock at Popen, so "
+                         "first_trace_s includes interpreter start")
+    ap.add_argument("--cold-start", type=int, nargs="?", const=3,
+                    default=None, metavar="N",
+                    help="standalone serving cold-start leg: two fresh "
+                         "subprocesses (cold vs warm persistent compile "
+                         "cache, TW_AOT=eager lattice warmup) measure "
+                         "process start -> first emitted trace over an "
+                         "N-burst synthetic stream; reports "
+                         "cold_start_s/warm_start_s + the aot_* warmup "
+                         "ledger (bar: warm < 5 s, zero solve compiles)")
     ap.add_argument("--faults", nargs="?", const="dispatch:0.2",
                     default=None, metavar="SPEC",
                     help="opt-in chaos leg: re-solve the subset inputs "
@@ -2467,6 +2636,18 @@ if __name__ == "__main__":
                          "confidence-decile calibration check "
                          "(warn-flagged when not monotone-ish)")
     args = ap.parse_args()
+    if args.mode == "coldstart":
+        run_coldstart_child(args.out, args.spawn_ts or time.time(),
+                            args.cold_start or 3)
+        sys.exit(0)
+    if args.cold_start:
+        coldstart_report = run_coldstart_leg(args.cold_start)
+        line = json.dumps(coldstart_report)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        sys.exit(0)
     if args.faults:
         # env, so the solver CHILD (where the leg runs) inherits it
         os.environ["TW_BENCH_FAULTS"] = args.faults

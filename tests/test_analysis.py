@@ -775,6 +775,74 @@ def test_tw010_public_api_and_unrelated_modules_clean():
 
 
 # ---------------------------------------------------------------------------
+# TW011 — AOT compile discipline
+# ---------------------------------------------------------------------------
+
+def test_tw011_chained_lower_compile_outside_aot_flagged():
+    findings, _ = lint("""
+        import jax
+
+        def private_warmup(fn, spec):
+            return fn.lower(spec, spec).compile()
+    """, path="traceweaver_tpu/serve/tenancy.py")
+    assert rules_of(findings).count("TW011") == 1
+
+
+def test_tw011_two_statement_form_flagged():
+    findings, _ = lint("""
+        def warm(fn, spec):
+            lowered = fn.lower(spec)
+            exe = lowered.compile()
+            return exe
+    """, path="traceweaver_tpu/stream/service.py")
+    assert rules_of(findings).count("TW011") == 1
+
+
+def test_tw011_cache_config_write_outside_jax_cache_flagged():
+    findings, _ = lint("""
+        import jax
+
+        def my_cache(path):
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    """, path="traceweaver_tpu/runtime/cli.py")
+    assert rules_of(findings).count("TW011") == 2
+
+
+def test_tw011_lattice_modules_and_lookalikes_clean():
+    # the lattice enumerator and the cache module own the idiom
+    for allowed in ("traceweaver_tpu/runtime/aot.py",
+                    "traceweaver_tpu/runtime/jax_cache.py"):
+        findings, _ = lint("""
+            import jax
+
+            def warm(fn, spec, path):
+                jax.config.update("jax_compilation_cache_dir", path)
+                return fn.lower(spec).compile()
+        """, path=allowed)
+        assert [f for f in findings if f.rule == "TW011"] == []
+    # string .lower(), re.compile over lowered strings, and non-cache
+    # config updates are not AOT compiles
+    findings, _ = lint("""
+        import re
+        import jax
+
+        def f(name, pattern):
+            jax.config.update("jax_platforms", "cpu")
+            key = (name or "").lower()
+            rx = re.compile(pattern.lower())
+            return key, rx
+    """, path="traceweaver_tpu/stream/service.py")
+    assert [f for f in findings if f.rule == "TW011"] == []
+    # suppression works like every rule
+    findings, suppressed = lint("""
+        def warm(fn, spec):
+            return fn.lower(spec).compile()  # twlint: disable=TW011 — why
+    """, path="traceweaver_tpu/serve/http.py")
+    assert findings == [] and suppressed == 1
+
+
+# ---------------------------------------------------------------------------
 # CLI plumbing + the tier-1 repo gate
 # ---------------------------------------------------------------------------
 

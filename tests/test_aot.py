@@ -1,0 +1,339 @@
+"""AOT shape-lattice precompile unit tests (runtime/aot.py, ISSUE 14).
+
+Lattice enumeration semantics (horizon grammar, tier composition, the
+dispatch-rule gates: no B=1 warm-sweep variants, refit only under
+compaction with B>=2), the miss-ledger hooks' key agreement with the
+enumerator, readiness-state transitions, and the persistent-cache
+failure hardening + compile-time histogram satellites in
+runtime/jax_cache.py. Everything in-memory/tmp-path — the only real
+compiles live in tests/test_bench_smoke.py's eager-warmup smoke.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from traceweaver_tpu.runtime import aot, knobs
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.aot
+
+
+@pytest.fixture(autouse=True)
+def _clean_aot():
+    aot.reset_for_tests()
+    yield
+    aot.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# horizon / knobs
+# ---------------------------------------------------------------------------
+
+def test_parse_horizon_rounds_to_pow2_grid():
+    h = aot.parse_horizon("100:3:50:50")
+    assert h == {"B": 128, "E": 4, "W": 64, "M": 64, "D": 1}
+    # W/M honor the 8-minimum sublane tile; optional D axis
+    assert aot.parse_horizon("1:1:1:1:3") == {
+        "B": 1, "E": 1, "W": 8, "M": 8, "D": 4}
+
+
+@pytest.mark.parametrize("bad", ["8:2:8", "a:2:8:16", "0:2:8:16", "1:2"])
+def test_parse_horizon_raises_on_malformed_spec(bad):
+    with pytest.raises(aot.AotError):
+        aot.parse_horizon(bad)
+
+
+def test_aot_knobs_are_registered_and_validated(monkeypatch):
+    assert knobs.REGISTRY["TW_AOT"].choices == ("off", "background", "eager")
+    assert knobs.REGISTRY["TW_AOT_TIER"].choices == ("core", "serve", "full")
+    monkeypatch.setenv("TW_AOT", "sometimes")
+    with pytest.raises(knobs.KnobError):
+        knobs.get("TW_AOT")
+
+
+# ---------------------------------------------------------------------------
+# lattice enumeration
+# ---------------------------------------------------------------------------
+
+def _entries(keys):
+    return {k[1] for k in keys if k[0] == "fleet"} | {
+        k[0] for k in keys if k[0] != "fleet"}
+
+
+def test_lattice_tiers_compose(monkeypatch):
+    monkeypatch.setenv("TW_AOT_HORIZON", "2:1:8:8")
+    core = aot.plan_lattice(tier="core")
+    serve = aot.plan_lattice(tier="serve")
+    full = aot.plan_lattice(tier="full")
+    assert set(core) < set(serve) < set(full)
+    assert _entries(core) == {"solve_windows_fleet", "assemble", "ring",
+                              "gmm"}
+    assert _entries(serve) == _entries(core) | {
+        "solve_em_fleet", "refit_fleet_params"}
+    assert _entries(full) == _entries(serve) | {
+        "solve_windows_packed", "solve_em_packed"}
+
+
+def test_lattice_respects_dispatch_rules(monkeypatch):
+    monkeypatch.setenv("TW_AOT_HORIZON", "4:2:8:8")
+    keys = aot.plan_lattice(tier="serve")
+    fleet = [k for k in keys if k[0] == "fleet"]
+    warm = knobs.get_int("TW_SWEEP_WARM")
+    # no warm-sweep variant at B=1 (compaction needs n_rows > 1) and no
+    # B=1 refit (singleton groups refit in-graph); solve_em_fleet only
+    # at B=1 under compaction
+    for k in fleet:
+        entry, B, n_sweeps = k[1], k[2], k[10]
+        if entry == "solve_windows_fleet" and n_sweeps == warm:
+            assert B >= 2, k
+        if entry == "refit_fleet_params":
+            assert B >= 2, k
+        if entry == "solve_em_fleet":
+            assert B == 1, k
+    # every geometry axis stays inside the horizon's pow2 grid
+    for k in fleet:
+        _, _, B, E, W, M = k[:6]
+        assert B in (1, 2, 4) and E in (1, 2) and W == 8 and M == 8
+
+
+def test_lattice_shrinks_without_compaction(monkeypatch):
+    monkeypatch.setenv("TW_AOT_HORIZON", "4:1:8:8")
+    keys_on = aot.plan_lattice(tier="serve")
+    monkeypatch.setenv("TW_COMPACT", "0")
+    keys_off = aot.plan_lattice(tier="serve")
+    # no compaction: no warm-sweep or standalone-refit variants, but
+    # solve_em_fleet now spans the whole B range (uncompacted two-pass
+    # groups dispatch it directly)
+    assert not any(k[1] == "refit_fleet_params" for k in keys_off
+                   if k[0] == "fleet")
+    em_bs = {k[2] for k in keys_off if k[0] == "fleet"
+             and k[1] == "solve_em_fleet"}
+    assert em_bs == {1, 2, 4}
+    assert {k[2] for k in keys_on if k[0] == "fleet"
+            and k[1] == "solve_em_fleet"} == {1}
+
+
+# ---------------------------------------------------------------------------
+# miss ledger — hook keys must agree with the enumerator
+# ---------------------------------------------------------------------------
+
+def _arm(monkeypatch, horizon="2:2:8:8", tier="serve"):
+    """Arm the lattice WITHOUT compiling: plan, then install the key
+    set directly (the smoke test covers the real warmup)."""
+    monkeypatch.setenv("TW_AOT", "eager")
+    monkeypatch.setenv("TW_AOT_HORIZON", horizon)
+    monkeypatch.setenv("TW_AOT_TIER", tier)
+    keys = aot.plan_lattice()
+    with aot._LOCK:
+        aot._LATTICE = frozenset(keys)
+        aot._STATE.update(mode="eager", tier=tier, phase="ready",
+                          planned=len(keys), compiled=len(keys),
+                          seeded=len(keys))
+    aot.__dict__["_ARMED"] = True
+    return keys
+
+
+def _common(B, E, W, M):
+    return (np.zeros((B, W), np.float32), np.zeros((B, W), np.float32),
+            np.zeros((B, W), bool), np.zeros((B, E, M), np.float32),
+            np.zeros((B, E, M), np.float32), np.zeros((B, E, M), bool),
+            np.zeros((B, E), np.float32), np.zeros((B, E, W), bool),
+            np.zeros((B,), np.int32))
+
+
+_HYPERS = dict(epsilon=1.0, n_sinkhorn=40, sinkhorn_tol=1e-3,
+               precision="f32", pallas=True, confidence=False,
+               max_preds=1, max_succs=1)
+
+
+def test_note_fleet_hits_lattice_and_counts_escapes(monkeypatch):
+    _arm(monkeypatch)
+    tables = (np.zeros((1, 2, 2), bool),)  # only [0].shape[0] is read
+    # an enumerated shape: full-sweep B=2/E=2/W=8/M=8/P=1 -> hit
+    assert aot.note_fleet("solve_windows_fleet", _common(2, 2, 8, 8),
+                          tables, 5, _HYPERS) is None
+    # B=4 escapes the B<=2 horizon -> named miss, counted
+    shape = aot.note_fleet("solve_windows_fleet", _common(4, 2, 8, 8),
+                           tables, 5, _HYPERS)
+    assert shape == ("solve_windows_fleet"
+                     "[B=4,E=2,W=8,M=8,P=1,mp=1,ms=1,sweeps=5]")
+    assert aot.status()["misses"] == {shape: 1.0}
+    # non-default hypers select different programs -> miss even in-geometry
+    assert aot.note_fleet("solve_windows_fleet", _common(2, 2, 8, 8),
+                          tables, 5, dict(_HYPERS, n_sinkhorn=13))
+    assert aot.status()["misses"][shape] == 1.0
+
+
+def test_note_refit_and_assemble_agree_with_enumerator(monkeypatch):
+    _arm(monkeypatch)
+    from traceweaver_tpu.ops.devcols import ring_capacity
+
+    cap = ring_capacity()
+    assert aot.note_refit(np.zeros((2, 2, 8), np.int32),
+                          np.zeros((1, 2), np.int32),
+                          np.zeros((2, 2, 8), np.float32)) is None
+    assert aot.note_assemble(cap, np.zeros((2, 8), np.int32),
+                             np.zeros((2, 2, 8), np.int32)) is None
+    # a foreign ring capacity is not enumerated
+    assert aot.note_assemble(64, np.zeros((2, 8), np.int32),
+                             np.zeros((2, 2, 8), np.int32))
+
+
+def test_note_hooks_are_inert_until_armed():
+    assert aot.note_fleet("solve_windows_fleet", _common(2, 2, 8, 8),
+                          (np.zeros((1, 2, 2), bool),), 5, _HYPERS) is None
+    assert aot.note_refit(np.zeros((2, 2, 8), np.int32),
+                          np.zeros((1, 2), np.int32),
+                          np.zeros((2, 2, 8), np.float32)) is None
+    assert aot.status()["misses"] == {}
+
+
+def test_miss_ledger_is_bounded(monkeypatch):
+    _arm(monkeypatch, horizon="1:1:8:8", tier="core")
+    tables = (np.zeros((1, 1, 1), bool),)
+    for b in range(2, 2 + aot.MISS_KEY_CAP + 50):
+        aot.note_fleet("solve_windows_fleet", _common(b, 1, 8, 8),
+                       tables, 5, _HYPERS)
+    assert len(aot.status()["misses"]) == aot.MISS_KEY_CAP
+
+
+# ---------------------------------------------------------------------------
+# readiness / status
+# ---------------------------------------------------------------------------
+
+def test_readiness_off_mode_is_always_ready(monkeypatch):
+    monkeypatch.setenv("TW_AOT", "off")
+    assert aot.startup_warmup()["phase"] == "idle"
+    ready, detail = aot.readiness()
+    assert ready and detail == {"aot": "off", "phase": "off", "planned": 0,
+                                "compiled": 0, "ready": True}
+
+
+def test_warmup_errors_surface_in_readiness(monkeypatch):
+    monkeypatch.setenv("TW_AOT", "eager")
+
+    def broken_plan(tier, horizon, prelower=True):
+        def boom():
+            raise RuntimeError("variant exploded")
+        return [aot._Variant(("fake", 0), boom)]
+
+    monkeypatch.setattr(aot, "_plan", broken_plan)
+    status = aot.startup_warmup()
+    assert status["phase"] == "error"
+    assert "variant exploded" in status["errors"][0]
+    ready, detail = aot.readiness()
+    # a wedged warmup must alert the rollout, not silently pass
+    assert not ready and detail["errors"]
+
+
+def test_startup_warmup_is_idempotent(monkeypatch):
+    monkeypatch.setenv("TW_AOT", "eager")
+    monkeypatch.setattr(
+        aot, "_plan",
+        lambda tier, horizon, prelower=True: [
+            aot._Variant(("fake", 0), lambda: 0.01)])
+    first = aot.startup_warmup()
+    assert first["phase"] == "ready" and first["planned"] == 1
+    # second call returns the standing state, does not re-plan
+    monkeypatch.setattr(aot, "_plan", lambda *a, **k: pytest.fail(
+        "re-armed an armed warmup"))
+    assert aot.startup_warmup()["planned"] == 1
+
+
+def test_metrics_collector_exposes_lattice_and_misses(monkeypatch):
+    from traceweaver_tpu.obs.registry import get_registry
+
+    monkeypatch.setenv("TW_AOT", "eager")
+    monkeypatch.setattr(
+        aot, "_plan",
+        lambda tier, horizon, prelower=True: [
+            aot._Variant(("fake", 0), lambda: 0.01)])
+    aot.startup_warmup()
+    with aot._LOCK:
+        aot._MISSES["solve_windows_fleet[B=64,...]"] = 3.0
+    snap = get_registry().snapshot(include_collectors=True)
+    assert snap["tw_aot_lattice_size"] == 1.0
+    assert snap["tw_aot_precompiled_total"] == 1.0
+    assert snap["tw_aot_ready"] == 1.0
+    assert snap['tw_aot_miss_total{entry="solve_windows_fleet"}'] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# jax_cache satellites: compile-seconds histogram + cache-dir hardening
+# ---------------------------------------------------------------------------
+
+@pytest.mark.obs
+def test_xla_compile_seconds_histogram_observes_compiles():
+    from traceweaver_tpu.obs.registry import get_registry
+    from traceweaver_tpu.runtime.jax_cache import install_compile_counters
+
+    install_compile_counters()
+    snap0 = get_registry().snapshot(include_collectors=True)
+    before = snap0.get("tw_xla_compile_seconds_count", 0.0)
+
+    @jax.jit
+    def f(x):
+        return x * 3.0 + 1.0
+
+    np.asarray(f(np.arange(7.0, dtype=np.float32)))
+    snap = get_registry().snapshot(include_collectors=True)
+    assert snap["tw_xla_compile_seconds_count"] >= before + 1
+    assert snap["tw_xla_compile_seconds_sum"] >= snap0.get(
+        "tw_xla_compile_seconds_sum", 0.0)
+
+
+def test_uncreatable_cache_dir_warns_counts_and_serves(
+        tmp_path, monkeypatch, capsys):
+    import traceweaver_tpu.runtime.jax_cache as jc
+
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("a file where the cache dir should go")
+    monkeypatch.setenv("TW_JAX_CACHE_DIR", str(blocker))
+    monkeypatch.setattr(jc, "_CACHE_WARNED", False)
+    errors_before = jc._CACHE_ERRORS
+    # no raise: serving continues with the cache disabled
+    assert jc.enable_persistent_compilation_cache() == ""
+    assert jc._CACHE_ERRORS == errors_before + 1
+    assert "WARNING" in capsys.readouterr().err
+    # warned ONCE: a second enable counts but stays quiet
+    assert jc.enable_persistent_compilation_cache() == ""
+    assert jc._CACHE_ERRORS == errors_before + 2
+    assert "WARNING" not in capsys.readouterr().err
+    # the counter reaches /metrics through the jax_cache collector
+    from traceweaver_tpu.obs.registry import get_registry
+
+    snap = get_registry().snapshot(include_collectors=True)
+    assert snap["tw_xla_cache_errors_total"] >= 2
+
+
+def test_readonly_cache_dir_still_enables_reads(tmp_path, monkeypatch,
+                                                capsys):
+    import traceweaver_tpu.runtime.jax_cache as jc
+
+    monkeypatch.setenv("TW_JAX_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(jc, "_CACHE_WARNED", False)
+    # root ignores permission bits, so simulate the read-only mount at
+    # the probe seam (the probe itself is a real write+unlink)
+    monkeypatch.setattr(jc, "_probe_writable", lambda d: False)
+    errors_before = jc._CACHE_ERRORS
+    cache_dir = jc.enable_persistent_compilation_cache()
+    # existing entries still deserialize — the cache stays ENABLED
+    assert cache_dir.startswith(str(tmp_path))
+    assert jc._CACHE_ERRORS == errors_before + 1
+    assert "not writable" in capsys.readouterr().err
+
+
+def test_writable_cache_dir_probe_is_clean(tmp_path, monkeypatch):
+    import traceweaver_tpu.runtime.jax_cache as jc
+
+    monkeypatch.setenv("TW_JAX_CACHE_DIR", str(tmp_path))
+    errors_before = jc._CACHE_ERRORS
+    cache_dir = jc.enable_persistent_compilation_cache()
+    assert cache_dir and os.path.isdir(cache_dir)
+    assert jc._CACHE_ERRORS == errors_before
+    assert not os.path.exists(os.path.join(cache_dir, ".tw_write_probe"))

@@ -167,6 +167,60 @@ def test_backend_label_flags_cpu_fallback(bench):
     assert bench.backend_label("axon") == ("axon", True)
 
 
+@pytest.mark.aot
+def test_aot_fields_summarizes_warmup_ledger(bench):
+    """The AOT warmup report builder: runtime/aot.status() -> aot_*
+    fields, with the miss ledger passed through verbatim and summed."""
+    status = {"mode": "eager", "phase": "ready", "planned": 10,
+              "compiled": 10, "compile_s": 2.7816,
+              "misses": {"solve_windows_fleet[B=64,...]": 3.0,
+                         "fit_gmm[e=8,n=128]": 1.0}}
+    out = bench.aot_fields(status)
+    assert out["aot_mode"] == "eager" and out["aot_phase"] == "ready"
+    assert out["aot_lattice_size"] == 10
+    assert out["aot_precompiled"] == 10
+    assert out["aot_compile_s"] == 2.782
+    assert out["aot_miss_count"] == 4
+    assert out["aot_misses"]["fit_gmm[e=8,n=128]"] == 1.0
+    # an empty status degrades to zeros, not a crash
+    empty = bench.aot_fields({})
+    assert empty["aot_lattice_size"] == 0
+    assert empty["aot_miss_count"] == 0
+
+
+@pytest.mark.aot
+def test_coldstart_fields_targets_and_verdicts(bench):
+    """The cold-start leg report builder: two child reports -> the
+    cold_start_s/warm_start_s pair, the <5 s warm-restart verdict, the
+    zero-solve-compile verdict, and the warm child's aot_* ledger."""
+    cold = {"first_trace_s": 7.807, "warmup_s": 6.821,
+            "fleet_backend_compiles": 0,
+            "measured_compiles": {"backend_compiles": 0}}
+    warm = {"first_trace_s": 3.785, "warmup_s": 2.837,
+            "fleet_backend_compiles": 0,
+            "measured_compiles": {"backend_compiles": 0},
+            "aot": {"mode": "eager", "phase": "ready", "planned": 10,
+                    "compiled": 10, "compile_s": 2.782, "misses": {}}}
+    out = bench.coldstart_fields(cold, warm)
+    assert out["cold_start_s"] == 7.807
+    assert out["warm_start_s"] == 3.785
+    assert out["coldstart_speedup"] == 2.06
+    assert out["coldstart_warm_under_target"] is True
+    assert out["coldstart_warm_zero_solve_compiles"] is True
+    assert out["aot_lattice_size"] == 10 and out["aot_miss_count"] == 0
+
+    # a slow warm restart or a compiling solve is flagged, not hidden
+    slow = bench.coldstart_fields(
+        cold, {**warm, "first_trace_s": 9.0, "fleet_backend_compiles": 2})
+    assert slow["coldstart_warm_under_target"] is False
+    assert slow["coldstart_warm_zero_solve_compiles"] is False
+    # empty children degrade to None/False, not a crash
+    empty = bench.coldstart_fields({}, {})
+    assert empty["cold_start_s"] is None
+    assert empty["coldstart_speedup"] is None
+    assert empty["coldstart_warm_under_target"] is False
+
+
 @pytest.mark.faults
 def test_chaos_fields_ledger_and_delta(bench):
     """The chaos-leg report builder: fleet fault counters -> chaos_*

@@ -448,6 +448,128 @@ def test_capture_chaos_smoke_loss_counted_confidence_discounted(
     assert saw, "no emitted trace carried discounted confidence"
 
 
+@pytest.mark.aot
+def test_aot_eager_warmup_makes_fleet_solve_compile_free(monkeypatch):
+    """Tier-1 cold-start smoke (ISSUE 14 acceptance pin): after a
+    TW_AOT=eager shape-lattice warmup under JAX_PLATFORMS=cpu, a
+    representative fleet solve — compaction + pipeline on, the default
+    serving configuration — performs ZERO backend compiles and the
+    per-solve ``aot_misses`` ledger stays empty: every dispatched
+    program (warm pass, compacted redispatch, standalone refit, devcols
+    assembly, ring fills) was enumerated, compiled, and seeded by the
+    lattice, so a warm rolling restart never stalls a solve on a cold
+    jit."""
+    from test_pipeline import _service_items
+
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+    from traceweaver_tpu.runtime import aot
+
+    # a workload whose pow2 geometry sits inside a deliberately tiny
+    # horizon, so the eager warmup stays test-sized: one service, two
+    # windows of 8 (B=2, W=8, M=8), a 2-endpoint chain (E=2, mp=ms=1)
+    monkeypatch.setenv("TW_AOT", "eager")
+    monkeypatch.setenv("TW_AOT_HORIZON", "2:2:8:8")
+    monkeypatch.setenv("TW_AOT_TIER", "serve")
+    aot.reset_for_tests()
+    try:
+        status = aot.startup_warmup(context="test")
+        assert status["phase"] == "ready", status["errors"]
+        assert status["planned"] == status["compiled"] > 0
+        ready, detail = aot.readiness()
+        assert ready and detail["ready"]
+
+        items = [_service_items("uni", n_traces=16, burst=8,
+                                eps=("A", "B"), seed=0)]
+        before = compile_counters()
+        stats = {}
+        out = solve_fleet(items, stats=stats)
+        delta = counters_delta(before)
+
+        assert len(out) == 1 and out[0] is not None
+        assert stats.get("pipeline_groups", 0) > 0, (
+            f"not the pipelined serving path: {stats}")
+        assert stats.get("compact_windows_total", 0) > 0, (
+            f"compaction never engaged: {stats}")
+        assert delta["backend_compiles"] == 0, (
+            "a dispatched program escaped the AOT lattice and compiled "
+            f"during the solve: {delta}, misses={stats.get('aot_misses')}")
+        assert stats.get("aot_misses", []) == [], (
+            "the lattice enumerator and the dispatch planner disagree "
+            f"on shapes: {stats['aot_misses']}")
+    finally:
+        aot.reset_for_tests()
+
+
+@pytest.mark.aot
+def test_aot_readyz_gates_503_while_warming_then_200(monkeypatch):
+    """Tier-1 /readyz smoke (ISSUE 14 acceptance pin): the serve
+    server's readiness endpoint returns 503 while the configured AOT
+    lattice tier is still compiling and flips to 200 once it completes
+    — the contract a rolling-restart orchestrator holds traffic on.
+    The warmup is a real background ``startup_warmup`` whose variants
+    are stubbed to block on an event, so the gate's transition is
+    observed end to end without burning compile time; TW_AOT=off keeps
+    /readyz at 200 (nothing gated, the default deployment)."""
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from traceweaver_tpu.runtime import aot
+    from traceweaver_tpu.serve import ServeConfig, TenantService, make_server
+
+    service = TenantService(ServeConfig(
+        fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+        verbose=False))
+    server = make_server(service, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def readyz():
+        try:
+            with urllib.request.urlopen(base + "/readyz",
+                                        timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    release = threading.Event()
+
+    def fake_plan(tier, horizon, prelower=True):
+        def run():
+            release.wait(timeout=60)
+            return 0.0
+        return [aot._Variant(("fake", i), run) for i in range(2)]
+
+    aot.reset_for_tests()
+    try:
+        # TW_AOT=off (the default): nothing gated, ready immediately
+        code, body = readyz()
+        assert code == 200 and body["ready"] and body["aot"] == "off"
+
+        monkeypatch.setenv("TW_AOT", "background")
+        monkeypatch.setattr(aot, "_plan", fake_plan)
+        aot.startup_warmup(context="test")
+        code, body = readyz()
+        assert code == 503, body
+        assert body["ready"] is False and body["phase"] == "warming"
+        assert body["compiled"] < body["planned"] == 2
+
+        release.set()
+        assert aot.wait_ready(timeout_s=60)
+        code, body = readyz()
+        assert code == 200, body
+        assert body["ready"] and body["phase"] == "ready"
+        assert body["compiled"] == body["planned"] == 2
+    finally:
+        release.set()
+        server.shutdown()
+        server.server_close()
+        aot.reset_for_tests()
+    service.drain()
+
+
 @pytest.mark.adapt
 def test_adapt_smoke_inert_off_and_compile_free_steady_state(
         monkeypatch, tmp_path):

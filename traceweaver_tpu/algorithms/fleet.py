@@ -61,6 +61,7 @@ from traceweaver_tpu.algorithms.weaver_tpu import (
     solve_windows_fleet,
 )
 from traceweaver_tpu.ops import devcols as _devcols
+from traceweaver_tpu.runtime import aot as _aot
 from traceweaver_tpu.obs import events as _events
 from traceweaver_tpu.obs import profile as _profile
 from traceweaver_tpu.obs import quality as _quality
@@ -288,6 +289,15 @@ def _trace_stage(keys, stage: str, w0_us: float,
         return
     for key in keys:
         tr.stage(key, stage, w0_us, w1_us)
+
+
+def _note_aot(st: "_Stats", shape: Optional[str]) -> None:
+    """Per-solve AOT-escape ledger: a dispatched shape outside the
+    precompiled lattice lands in the ordered ``aot_misses`` event list
+    (runtime/aot.py names it; the horizon is tuned from this). No-op
+    when no warmup armed the lattice, or on a lattice hit."""
+    if shape:
+        st.note("aot_misses", shape)
 
 
 def _copy_async(out) -> None:
@@ -1429,11 +1439,17 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
             pad_b = _bucket(pg["n_rows"], minimum=1) - pg["n_rows"]
             common = assemble(None, pad_b) + (_pad_pidx(pidx, pad_b),)
             if n_passes == 2:
+                _note_aot(st, _aot.note_fleet(
+                    "solve_em_fleet", common, _tables_of(params), n_sweeps,
+                    hypers, window_rows=window_rows))
                 out, _ = solve_em_fleet(
                     *common, window_rows, window_valid, *_tables_of(params),
                     n_sweeps=n_sweeps, **hypers,
                 )
             else:
+                _note_aot(st, _aot.note_fleet(
+                    "solve_windows_fleet", common, _tables_of(params),
+                    n_sweeps, hypers))
                 out, _ = solve_windows_fleet(
                     *common, *_tables_of(params), n_sweeps=n_sweeps,
                     **hypers,
@@ -1463,11 +1479,17 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
             _bill_shipped(st, batch)
             common = tuple(batch[k] for k in _BATCH_KEYS) + (pidx,)
             if n_passes == 2:
+                _note_aot(st, _aot.note_fleet(
+                    "solve_em_fleet", common, _tables_of(params), n_sweeps,
+                    hypers, window_rows=window_rows))
                 out, _ = solve_em_fleet(
                     *common, window_rows, window_valid, *_tables_of(params),
                     n_sweeps=n_sweeps, **hypers,
                 )
             else:
+                _note_aot(st, _aot.note_fleet(
+                    "solve_windows_fleet", common, _tables_of(params),
+                    n_sweeps, hypers))
                 out, _ = solve_windows_fleet(
                     *common, *_tables_of(params), n_sweeps=n_sweeps,
                     **hypers,
@@ -1579,6 +1601,7 @@ def _make_assembler(dc_items: List[Dict], batch: Dict, st: _Stats):
         oi, oo = rows(origin_in, 0), rows(origin_out, 0)
         st.add("h2d_bytes_index",
                float(si.nbytes + so.nbytes + oi.nbytes + oo.nbytes))
+        _note_aot(st, _aot.note_assemble(int(ring_in.cap), si, so))
         outs = _devcols.assemble_resident(ring_in, ring_out,
                                           si, so, oi, oo)
         skip_cap = rows(batch["skip_cap"], 0)
@@ -1653,6 +1676,10 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
             warm_common = assemble(None, pad0) + (_pad_pidx(pidx, pad0),)
         else:
             warm_common = place(batch, pidx)
+        if mesh is None:
+            _note_aot(st, _aot.note_fleet(
+                "solve_windows_fleet", warm_common, tables_dev, warm,
+                hypers))
         out_warm, flags = solve_windows_fleet(
             *warm_common, *tables_dev, n_sweeps=warm, **hypers)
     # the big warm block starts its D2H NOW — it overlaps the flag fetch,
@@ -1712,6 +1739,10 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
         redispatch_common = place(gathered, pidx_active)
     w0 = _selftrace.now_us()
     with _profile.annotate("tw:fleet:redispatch"):
+        if mesh is None:
+            _note_aot(st, _aot.note_fleet(
+                "solve_windows_fleet", redispatch_common, tables_dev,
+                n_sweeps, hypers))
         out_full, _ = solve_windows_fleet(
             *redispatch_common, *tables_dev,
             n_sweeps=n_sweeps, **hypers)
@@ -1755,8 +1786,12 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
     else:
         bi = batch
         pidx_refit = pidx
+    assign_refit = out0[..., _layout.CH_ASSIGN].astype(np.int32)
+    if mesh is None:
+        _note_aot(st, _aot.note_refit(assign_refit, window_rows,
+                                      bi["out_start"]))
     new_tables = refit_fleet_params(
-        out0[..., _layout.CH_ASSIGN].astype(np.int32),
+        assign_refit,
         bi["in_start"], bi["in_end"], bi["in_valid"],
         bi["out_start"], bi["out_end"], pidx_refit,
         window_rows, window_valid,

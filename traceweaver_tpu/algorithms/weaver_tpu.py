@@ -1815,6 +1815,17 @@ class WeaverTPU:
                       cells * (float(itemsize) + 2 * 4.0))
             t0 = _time.perf_counter()
             solve_fn = solve_em_packed if use_fused else solve_windows_packed
+            if mesh is None:
+                # AOT-escape accounting for the per-service path (tier
+                # "full" of the lattice, runtime/aot.py); numeric here —
+                # the ordered shape ledger rides the fleet stats dict
+                from traceweaver_tpu.runtime import aot as _aot
+
+                if _aot.note_packed(
+                        solve_fn.__name__, B_c, E, W_c, M_c, mp, ms,
+                        n_sweeps, self.epsilon, self.n_sinkhorn,
+                        self.sinkhorn_tol, self.precision):
+                    _stat_add(stats, "aot_packed_misses", 1.0)
             with _obs_profile.annotate("tw:solve:dispatch"):
                 out = solve_fn(
                     a["in_start"], a["in_end"], a["in_valid"],

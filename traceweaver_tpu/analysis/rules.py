@@ -1126,8 +1126,99 @@ class AdaptLedgerDiscipline:
         return findings
 
 
+# ---------------------------------------------------------------------------
+# TW011 — AOT compile discipline
+# ---------------------------------------------------------------------------
+
+class AotCompileDiscipline:
+    """``.lower().compile()`` / compile-cache config writes live only in
+    ``runtime/aot.py`` + ``runtime/jax_cache.py``.
+
+    The AOT shape lattice (ISSUE 14) is the single source of
+    precompiled variants: every ahead-of-time compile goes through the
+    lattice enumerator so the miss ledger, the ``/readyz`` gate, and
+    the ``tw_aot_*`` telemetry see the complete precompile surface. A
+    stray ``entry.lower(...).compile()`` elsewhere is an unledgered
+    program the readiness gate doesn't know it is waiting for (or
+    worse, not waiting for); a stray
+    ``jax.config.update("jax_compilation_cache_dir", ...)`` forks the
+    persistent-cache location away from the host-keyed directory that
+    ``jax_cache.py`` namespaces (the round-3 poisoned-cache lesson).
+
+    Mechanics: flags (a) a ``.compile()`` call whose receiver is a
+    ``.lower(...)`` call (the chained idiom), (b) a ``.compile()`` call
+    on a name bound from a ``.lower(...)`` call in the same function
+    (the two-statement form), and (c) ``jax.config.update`` with a
+    first-argument string starting ``jax_compilation_cache`` /
+    ``jax_persistent_cache``. String ``.lower()`` is untouched — only a
+    ``.compile`` on the lowered VALUE matches, and strings have none.
+    """
+
+    id = "TW011"
+    title = "AOT lower/compile or compile-cache write outside the lattice"
+
+    ALLOWED = ("runtime/aot.py", "runtime/jax_cache.py")
+    _CACHE_PREFIXES = ("jax_compilation_cache", "jax_persistent_cache")
+
+    @staticmethod
+    def _is_lower_call(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "lower")
+
+    def _flag(self, mod: Module, node: ast.AST) -> Finding:
+        return mod.finding(
+            self.id, node,
+            "ahead-of-time .lower().compile() outside runtime/aot.py — "
+            "the shape lattice is the single source of precompiled "
+            "variants (miss ledger + /readyz gate); add the variant to "
+            "the lattice enumerator instead of compiling it privately")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if _path_in(mod, self.ALLOWED):
+            return []
+        findings: List[Finding] = []
+        # the whole module (module scope included): the chained form and
+        # cache-config writes; then the two-statement form per function
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "compile"
+                    and self._is_lower_call(node.func.value)):
+                findings.append(self._flag(mod, node))
+            elif (dotted(node.func) in ("jax.config.update",
+                                        "config.update")
+                    and node.args):
+                key = const_str(node.args[0])
+                if key and key.startswith(self._CACHE_PREFIXES):
+                    findings.append(mod.finding(
+                        self.id, node,
+                        f"compile-cache config write ({key!r}) outside "
+                        "runtime/jax_cache.py — the cache directory is "
+                        "namespaced per backend+host there (a foreign "
+                        "location risks the round-3 poisoned-cache "
+                        "failure); route it through "
+                        "enable_persistent_compilation_cache"))
+        for fn in outer_functions(mod.tree):
+            lowered: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and self._is_lower_call(
+                        node.value):
+                    for t in node.targets:
+                        lowered.update(HostSyncHazard._target_names(t))
+                elif (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "compile"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in lowered):
+                    findings.append(self._flag(mod, node))
+        return findings
+
+
 #: registration order == reporting order for same-line findings
 RULE_CLASSES = [KnobDiscipline, ImportTimeFreeze, HostSyncHazard,
                 RecompileDiscipline, LockDiscipline, PrecisionDiscipline,
                 MetricDiscipline, ChannelLayoutDiscipline,
-                DevcolsResidency, AdaptLedgerDiscipline]
+                DevcolsResidency, AdaptLedgerDiscipline,
+                AotCompileDiscipline]

@@ -1,0 +1,734 @@
+"""Ahead-of-time shape-lattice precompile (serving-grade cold start).
+
+The on-chip bench pays ~49.5 s of warmup compiles for a 1.05 s solve
+(BENCH_r05_builder_tpu.json ``warmup_compile_s``), so every serve
+rollout eats ~50x its steady-state cost before emitting a single trace.
+The dispatch surface is lattice-shaped by construction — every dynamic
+axis is pow2-bucketed (``runtime/bucketing.pow2_bucket``) and every
+static-arg axis is enumerable (precision x pallas x TW_CONF_DEVICE) —
+so the full set of programs a deployment can dispatch is FINITE and
+known before the first span arrives. This module enumerates that
+lattice and pre-compiles it at startup:
+
+- each variant is lowered and compiled ahead of time
+  (``entry.lower(...).compile()`` — twlint TW011 keeps this idiom
+  HERE, so the lattice stays the single source of precompiled
+  variants), which writes the persistent XLA compile cache
+  (``runtime/jax_cache.py``): a warm-cache rolling restart turns every
+  compile into a ~ms deserialize;
+- each variant is then SEEDED — one dummy-argument call that installs
+  the executable in the in-process jit dispatch cache, so the first
+  real dispatch of that shape performs zero backend compiles (the
+  compile-event counter fires even on a persistent-cache hit; only a
+  seeded dispatch cache is silent);
+- dummy arguments mirror the real call sites' ABSTRACT VALUES exactly:
+  the jit executable cache keys on avals (shape, dtype, weak-typedness,
+  committed sharding), not on host-vs-device placement, so strong-typed
+  NumPy dummies cover both the host-packed flow and the device-resident
+  flow whose window tensors are devcols-assembler jit outputs — but a
+  weak-typed scalar (``jnp.full``-style) or a committed ``device_put``
+  arg WOULD mint a distinct program, which is why the builders
+  construct every dummy as a dtyped array.
+
+Shapes not in the lattice fall through to on-demand jit — counted
+(``tw_aot_miss_total``, and a per-solve ``aot_misses`` ledger entry
+naming the escaped shape) but never blocking correctness; the miss
+ledger is how the horizon is tuned from production data.
+
+Knobs (docs/PERF.md "Cold start (r14)"):
+
+- ``TW_AOT``          off (default) | background | eager
+- ``TW_AOT_HORIZON``  ``B:E:W:M[:D]`` pow2 caps of the geometry lattice
+- ``TW_AOT_TIER``     core | serve (default) | full — which entry
+                      points ride the lattice, and what ``/readyz``
+                      gates on
+
+Geometry derivation (one place, so the enumerator and the miss hooks
+cannot drift): windows-per-dispatch ``B`` and endpoint bucket ``E``
+enumerate powers of two from 1, window/candidate buckets ``W``/``M``
+from 8 (the sublane tile, ``weaver_tpu._bucket``); the fleet table
+axes enumerate services ``P`` in pow2 <= min(B, 4) with the refit row
+map ``Bmax`` in pow2 spanning [B/P, B]; neighbour-degree statics
+``max_preds``/``max_succs`` enumerate pow2 <= min(E, D). Static
+hypers (epsilon / n_sinkhorn / n_sweeps / sinkhorn_tol) are the
+serving defaults of ``fleet.solve_fleet``, with the compaction warm
+sweep count (``TW_SWEEP_WARM``) as a second n_sweeps point. The mesh
+path is out of scope (multi-chip dispatches re-place sharded arrays
+per shard — a different program family); its shapes surface in the
+miss ledger like any other escape.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from traceweaver_tpu.runtime import knobs as _knobs
+from traceweaver_tpu.runtime.bucketing import pow2_bucket
+
+#: services-per-group cap of the lattice's P axis (continuous batching
+#: admits small tenant subsets; larger fleets surface in the miss ledger)
+MAX_LATTICE_P = 4
+
+#: bound on distinct miss keys retained (the ledger names shapes, and
+#: shape strings are operator-facing — never let a pathological workload
+#: grow this without bound)
+MISS_KEY_CAP = 256
+
+_LOCK = threading.RLock()
+#: armed := a lattice is planned and the miss hooks are live
+_ARMED = False
+_STATE: Dict[str, object] = {
+    "mode": "off",        # TW_AOT at arm time
+    "tier": None,
+    "phase": "idle",      # idle | warming | ready | error
+    "context": "",
+    "planned": 0,
+    "compiled": 0,
+    "seeded": 0,
+    "compile_s": 0.0,
+    "errors": [],
+    "t_start": 0.0,
+    "t_done": 0.0,
+}
+_LATTICE: frozenset = frozenset()
+_MISSES: Dict[str, float] = {}
+_THREAD: Optional[threading.Thread] = None
+_COLLECTOR_INSTALLED = False
+
+
+class AotError(ValueError):
+    """A malformed AOT knob value (the raise-on-typo rule)."""
+
+
+# ---------------------------------------------------------------------------
+# knob parsing
+# ---------------------------------------------------------------------------
+
+def parse_horizon(spec: Optional[str] = None) -> Dict[str, int]:
+    """``TW_AOT_HORIZON`` -> pow2 axis caps ``{B, E, W, M, D}``.
+
+    Grammar ``B:E:W:M[:D]`` (D = neighbour-degree cap, default 1).
+    Caps round UP to the axis's pow2 grid (W/M to the 8-minimum tile)
+    so a horizon of ``100:3:50:50`` means what the operator expects.
+    """
+    raw = spec if spec is not None else _knobs.get("TW_AOT_HORIZON")
+    parts = str(raw).split(":")
+    if len(parts) not in (4, 5):
+        raise AotError(
+            f"TW_AOT_HORIZON={raw!r}: expected B:E:W:M[:D] pow2 caps")
+    try:
+        vals = [int(p) for p in parts]
+    except ValueError:
+        raise AotError(
+            f"TW_AOT_HORIZON={raw!r}: non-integer axis cap") from None
+    if any(v < 1 for v in vals):
+        raise AotError(f"TW_AOT_HORIZON={raw!r}: caps must be >= 1")
+    b, e, w, m = vals[:4]
+    d = vals[4] if len(vals) == 5 else 1
+    return {"B": pow2_bucket(b), "E": pow2_bucket(e),
+            "W": pow2_bucket(w, minimum=8), "M": pow2_bucket(m, minimum=8),
+            "D": pow2_bucket(d)}
+
+
+def _pow2_range(lo: int, hi: int) -> List[int]:
+    out, v = [], lo
+    while v <= hi:
+        out.append(v)
+        v *= 2
+    return out
+
+
+def _serving_hypers() -> Dict[str, float]:
+    """The static hyper values the serving path dispatches with — read
+    off ``fleet.solve_fleet``'s signature so the lattice can never
+    drift from the defaults the stream/serve layers actually pass."""
+    import inspect
+
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+    sig = inspect.signature(solve_fleet)
+    return {k: sig.parameters[k].default
+            for k in ("epsilon", "n_sinkhorn", "n_sweeps", "sinkhorn_tol")}
+
+
+# ---------------------------------------------------------------------------
+# lattice keys — ONE canonical form shared by the enumerator and the
+# miss hooks (drift here would mint phantom misses)
+# ---------------------------------------------------------------------------
+
+def _fleet_key(entry: str, B: int, E: int, W: int, M: int, P: Optional[int],
+               bmax: Optional[int], mp: int, ms: int, n_sweeps: int,
+               epsilon: float, n_sinkhorn: int, sinkhorn_tol: float,
+               precision: str, pallas: bool,
+               confidence: Optional[bool]) -> Tuple:
+    return ("fleet", entry, B, E, W, M, P, bmax, mp, ms, n_sweeps,
+            float(epsilon), int(n_sinkhorn), float(sinkhorn_tol),
+            precision, bool(pallas),
+            None if confidence is None else bool(confidence))
+
+
+def _assemble_key(cap: int, B: int, E: int, W: int, M: int) -> Tuple:
+    return ("assemble", cap, B, E, W, M)
+
+
+def _ring_key(cap: int, length: int) -> Tuple:
+    return ("ring", cap, length)
+
+
+def _gmm_key(e: int, n: int) -> Tuple:
+    return ("gmm", e, n)
+
+
+def _key_str(key: Tuple) -> str:
+    """Operator-facing shape string for the miss ledger, e.g.
+    ``solve_windows_fleet[B=4,E=2,W=8,M=16,P=1,Bmax=4,mp=1,ms=1,sweeps=5,dev]``."""
+    if key[0] == "assemble":
+        _, cap, B, E, W, M = key
+        return f"assemble_windows[cap={cap},B={B},E={E},W={W},M={M}]"
+    if key[0] == "ring":
+        return f"ring_append[cap={key[1]},len={key[2]}]"
+    if key[0] == "gmm":
+        return f"fit_gmm[e={key[1]},n={key[2]}]"
+    if key[0] != "fleet" or len(key) != 17:
+        return repr(key)  # unknown kind (test stubs): degrade readably
+    (_, entry, B, E, W, M, P, bmax, mp, ms, n_sweeps,
+     _eps, _sink, _tol, precision, _pal, conf) = key
+    bits = [f"B={B}", f"E={E}", f"W={W}", f"M={M}"]
+    if P is not None:
+        bits.append(f"P={P}")
+    if bmax is not None:
+        bits.append(f"Bmax={bmax}")
+    bits += [f"mp={mp}", f"ms={ms}", f"sweeps={n_sweeps}"]
+    if precision != "f32":
+        bits.append(precision)
+    if conf:
+        bits.append("conf")
+    return f"{entry}[{','.join(bits)}]"
+
+
+# ---------------------------------------------------------------------------
+# lattice enumeration
+# ---------------------------------------------------------------------------
+
+class _Variant:
+    """One precompilable program variant: a lattice key plus a builder
+    that compiles AND seeds it (the builder owns argument placement)."""
+
+    __slots__ = ("key", "run")
+
+    def __init__(self, key: Tuple, run) -> None:
+        self.key = key
+        self.run = run
+
+
+def _plan(tier: str, horizon: Dict[str, int],
+          prelower: bool = True) -> List[_Variant]:
+    """Enumerate the configured lattice tier. Imports the jax-heavy
+    entry points lazily — planning only happens once a warmup is
+    requested.
+
+    ``prelower=True`` (the background production path) runs the full
+    ``entry.lower(...).compile()`` idiom before the seed call — the
+    explicit AOT artifact, with the pure compile time observable.
+    ``prelower=False`` (eager mode — the startup-latency-critical
+    path) seeds only: the dummy dispatch itself compiles cold or
+    deserializes warm AND installs the executable, at one trace+lower
+    instead of two, which is what gets a warm-cache restart to first
+    trace in seconds."""
+    import numpy as np
+
+    from traceweaver_tpu.algorithms import weaver_tpu as _wt
+    from traceweaver_tpu.algorithms.timing import MAX_COMPONENTS as K
+    from traceweaver_tpu.algorithms.weaver_tpu import columnar_enabled
+    from traceweaver_tpu.obs import quality as _quality
+    from traceweaver_tpu.ops import devcols as _devcols
+    from traceweaver_tpu.ops.precision import precision_from_env
+
+    hyp = _serving_hypers()
+    full_sweeps = int(hyp["n_sweeps"])
+    warm_sweeps = _knobs.get_int("TW_SWEEP_WARM")
+    compaction = _knobs.get_bool("TW_COMPACT") and warm_sweeps < full_sweeps
+    sweep_points = ([warm_sweeps, full_sweeps] if compaction
+                    else [full_sweeps])
+    precision = precision_from_env()
+    confidence = _quality.conf_device_enabled()
+    use_devcols = _devcols.devcols_enabled() and columnar_enabled()
+    cap = _devcols.ring_capacity() if use_devcols else 0
+    statics = dict(epsilon=hyp["epsilon"], n_sinkhorn=hyp["n_sinkhorn"],
+                   sinkhorn_tol=hyp["sinkhorn_tol"], precision=precision,
+                   pallas=True, max_preds=0, max_succs=0)  # mp/ms per point
+
+    def batch_np(B, E, W, M):
+        """Dummy window tensors: all-invalid, strong-typed NumPy zeros
+        (padding rows' convention — they assign nothing and converge at
+        once). The jit executable cache keys on avals, so these cover
+        the devcols-assembled device tensors of the resident flow too."""
+        return (np.zeros((B, W), np.float32), np.zeros((B, W), np.float32),
+                np.zeros((B, W), bool),
+                np.zeros((B, E, M), np.float32),
+                np.zeros((B, E, M), np.float32), np.zeros((B, E, M), bool),
+                np.zeros((B, E), np.float32), np.zeros((B, E, W), bool))
+
+    def tables_np(P, E):
+        t = {}
+        for name in ("edge_wt", "edge_mu"):
+            t[name] = np.zeros((P, E, E, K), np.float32)
+        t["edge_sd"] = np.ones((P, E, E, K), np.float32)
+        for name in ("in_wt", "in_mu", "ret_wt", "ret_mu"):
+            t[name] = np.zeros((P, E, K), np.float32)
+        for name in ("in_sd", "ret_sd"):
+            t[name] = np.ones((P, E, K), np.float32)
+        return (np.zeros((P, E, E), bool), np.zeros((P, E), bool),
+                np.zeros((P, E), bool),
+                t["edge_wt"], t["edge_mu"], t["edge_sd"],
+                t["in_wt"], t["in_mu"], t["in_sd"],
+                t["ret_wt"], t["ret_mu"], t["ret_sd"])
+
+    def compile_and_seed(fn, make_args, kwargs=None):
+        """The warmup unit: optionally ``lower().compile()`` (the
+        explicit AOT compile — persistent-cache write/read, timed),
+        then one dummy call (compiles-or-deserializes if not
+        pre-lowered, and installs the executable in the jit dispatch
+        cache either way). ``make_args`` is called per use — donated
+        dummies are consumed. Returns the wall seconds."""
+        kw = kwargs or {}
+        t0 = time.perf_counter()
+        with warnings.catch_warnings():
+            # dummy-donation UserWarnings: expected for NumPy dummies,
+            # same as the real pipeline's host-fed calls
+            warnings.simplefilter("ignore")
+            if prelower:
+                fn.lower(*make_args(), **kw).compile()
+            out = fn(*make_args(), **kw)
+        try:
+            # jax arrays only; tuples of outputs fall through — the
+            # seed only needs the dispatch to have happened
+            out.block_until_ready()
+        except AttributeError:
+            pass
+        return time.perf_counter() - t0
+
+    variants: List[_Variant] = []
+
+    def add_fleet(entry_name, fn, B, E, W, M, P, bmax, mp, ms, n_sweeps,
+                  with_rows):
+        key = _fleet_key(entry_name, B, E, W, M, P, bmax, mp, ms,
+                         n_sweeps, hyp["epsilon"], hyp["n_sinkhorn"],
+                         hyp["sinkhorn_tol"], precision, True, confidence)
+        kw = dict(statics, n_sweeps=n_sweeps, max_preds=mp, max_succs=ms,
+                  confidence=confidence)
+
+        def make_args():
+            args = batch_np(B, E, W, M) + (np.zeros((B,), np.int32),)
+            if with_rows:
+                args += (np.zeros((P, bmax), np.int32),
+                         np.zeros((P, bmax), bool))
+            return args + tables_np(P, E)
+
+        variants.append(_Variant(
+            key, lambda: compile_and_seed(fn, make_args, kw)))
+
+    def add_refit(B, E, W, M, P, bmax):
+        key = _fleet_key("refit_fleet_params", B, E, W, M, P, bmax, 1, 1,
+                         0, 0.0, 0, 0.0, "f32", True, None)
+
+        def make_args():
+            six = batch_np(B, E, W, M)
+            tab = tables_np(P, E)
+            return ((np.zeros((B, E, W), np.int32),)
+                    + six[:3] + six[3:5] + (np.zeros((B,), np.int32),
+                                            np.zeros((P, bmax), np.int32),
+                                            np.zeros((P, bmax), bool))
+                    + tab[:2] + tab[3:])  # no is_last in the refit
+
+        variants.append(_Variant(
+            key, lambda: compile_and_seed(_wt.refit_fleet_params,
+                                          make_args)))
+
+    def add_packed(entry_name, fn, B, E, W, M, mp, ms, n_sweeps):
+        key = _fleet_key(entry_name, B, E, W, M, None, None, mp, ms,
+                         n_sweeps, hyp["epsilon"], hyp["n_sinkhorn"],
+                         hyp["sinkhorn_tol"], precision, True, None)
+        kw = dict(statics, n_sweeps=n_sweeps, max_preds=mp, max_succs=ms)
+
+        def make_args():
+            return (batch_np(B, E, W, M)
+                    + tuple(a[0] for a in tables_np(1, E)))
+
+        variants.append(_Variant(
+            key, lambda: compile_and_seed(fn, make_args, kw)))
+
+    geoms = [(B, E, W, M)
+             for B in _pow2_range(1, horizon["B"])
+             for E in _pow2_range(1, horizon["E"])
+             for W in _pow2_range(8, horizon["W"])
+             for M in _pow2_range(8, horizon["M"])]
+
+    for B, E, W, M in geoms:
+        degs = [(mp, ms)
+                for mp in _pow2_range(1, min(E, horizon["D"]))
+                for ms in _pow2_range(1, min(E, horizon["D"]))]
+        ps = _pow2_range(1, min(B, MAX_LATTICE_P))
+        if use_devcols:
+
+            def make_assemble(B=B, E=E, W=W, M=M):
+                import jax.numpy as jnp
+
+                def make_args():
+                    return (jnp.zeros((cap, 3), jnp.int32),
+                            jnp.zeros((cap, 3), jnp.int32),
+                            np.full((B, W), -1, np.int32),
+                            np.full((B, E, M), -1, np.int32),
+                            np.zeros((B,), np.int32),
+                            np.zeros((B,), np.int32))
+                return lambda: compile_and_seed(_devcols.assemble_windows,
+                                                make_args)
+
+            variants.append(_Variant(_assemble_key(cap, B, E, W, M),
+                                     make_assemble()))
+        for mp, ms in degs:
+            for n_sweeps in sweep_points:
+                if n_sweeps != full_sweeps and B < 2:
+                    # warm-sweep dispatches only exist under compaction,
+                    # which requires n_rows > 1 — a B=1 warm variant can
+                    # never be dispatched
+                    continue
+                for P in ps:
+                    add_fleet("solve_windows_fleet", _wt.solve_windows_fleet,
+                              B, E, W, M, P, None, mp, ms, n_sweeps,
+                              with_rows=False)
+            if tier in ("serve", "full"):
+                # solve_em_fleet only dispatches for singleton groups
+                # when compaction is on (n_rows > 1 takes the compacted
+                # warm/full + refit chain instead)
+                em_bs = [1] if compaction else _pow2_range(1, horizon["B"])
+                if B in em_bs:
+                    for P in ps:
+                        for bmax in _pow2_range(
+                                pow2_bucket(max(1, -(-B // P))), B):
+                            add_fleet("solve_em_fleet", _wt.solve_em_fleet,
+                                      B, E, W, M, P, bmax, mp, ms,
+                                      full_sweeps, with_rows=True)
+            if tier == "full":
+                add_packed("solve_windows_packed", _wt.solve_windows_packed,
+                           B, E, W, M, mp, ms, full_sweeps)
+                add_packed("solve_em_packed", _wt.solve_em_packed,
+                           B, E, W, M, mp, ms, full_sweeps)
+        if tier in ("serve", "full") and compaction and B >= 2:
+            # the standalone refit only dispatches from the compacted
+            # two-pass chain (n_rows > 1); singleton and uncompacted
+            # groups refit in-graph inside solve_em_fleet
+            for P in ps:
+                for bmax in _pow2_range(pow2_bucket(max(1, -(-B // P))), B):
+                    add_refit(B, E, W, M, P, bmax)
+
+    if use_devcols:
+        # ring appends: one tiny dynamic-update-slice program per
+        # (capacity, pow2 chunk length) — enumerate to the largest slot
+        # set a horizon-sized dispatch can reference (bigger backfills
+        # jit on demand at ~15 ms each; harmless)
+        def make_ring(length):
+            import jax.numpy as jnp
+
+            def run():
+                buf = jnp.zeros((cap, 3), jnp.int32)
+                upd = np.zeros((length, 3), np.int32)
+                t0 = time.perf_counter()
+                # seed-only: the start operand is a weak-typed python
+                # int at the real call site, which .lower() specs
+                # cannot express — the dummy call compiles AND seeds
+                _devcols.ring_append(buf, upd, 0).block_until_ready()
+                return time.perf_counter() - t0
+            return run
+
+        max_len = min(cap, max(horizon["B"] * horizon["W"],
+                               horizon["B"] * horizon["E"] * horizon["M"]))
+        for length in _pow2_range(1, max_len):
+            variants.append(_Variant(_ring_key(cap, length),
+                                     make_ring(length)))
+    # the host-side warm-state GMM refresh (stream/service.py ->
+    # timing.fit_edge_gmms -> ops/gmm._fit_gmm_z) runs in EVERY tier's
+    # steady state, so its family rides every tier: e = pow2 edge rows
+    # per service, n = pow2 delay samples (>= the 4-sample fit floor,
+    # <= what a horizon-sized window batch can collect)
+    from traceweaver_tpu.ops import gmm as _gmm
+
+    def make_gmm(e, n):
+        def make_args():
+            return (np.zeros((e, n), np.float32), np.zeros((e, n), bool))
+        return lambda: compile_and_seed(
+            _gmm._fit_gmm_z, make_args, dict(max_k=K, n_iters=50))
+
+    for e in _pow2_range(1, 2 * horizon["E"]):
+        for n in _pow2_range(4, horizon["B"] * horizon["W"]):
+            variants.append(_Variant(_gmm_key(e, n), make_gmm(e, n)))
+    return variants
+
+
+def plan_lattice(tier: Optional[str] = None,
+                 horizon: Optional[str] = None) -> List[Tuple]:
+    """The planned lattice keys for the configured (or given) tier and
+    horizon — pure enumeration, nothing compiles. The operator-facing
+    view is ``[_key_str(k) for k in plan_lattice()]``."""
+    t = tier or _knobs.get("TW_AOT_TIER")
+    h = parse_horizon(horizon)
+    return [v.key for v in _plan(t, h)]
+
+
+# ---------------------------------------------------------------------------
+# warmup driver
+# ---------------------------------------------------------------------------
+
+def _install_collector() -> None:
+    global _COLLECTOR_INSTALLED
+    if _COLLECTOR_INSTALLED:
+        return
+    from traceweaver_tpu.obs.registry import get_registry
+
+    def _collect():
+        with _LOCK:
+            st = dict(_STATE)
+            misses = dict(_MISSES)
+        fams = [
+            ("tw_aot_lattice_size", "gauge",
+             "program variants in the configured AOT lattice tier "
+             "(runtime/aot.py)", [({}, float(st["planned"]))]),
+            ("tw_aot_precompiled_total", "counter",
+             "AOT variants compiled AND seeded so far this process",
+             [({}, float(st["seeded"]))]),
+            ("tw_aot_ready", "gauge",
+             "1 once the configured lattice tier is fully compiled "
+             "(the /readyz gate)",
+             [({}, 1.0 if st["phase"] == "ready" else 0.0)]),
+        ]
+        if misses:
+            by_entry: Dict[str, float] = {}
+            for shape, n in misses.items():
+                entry = shape.split("[", 1)[0]
+                by_entry[entry] = by_entry.get(entry, 0.0) + n
+            fams.append((
+                "tw_aot_miss_total", "counter",
+                "dispatched shapes that escaped the AOT lattice "
+                "(tune TW_AOT_HORIZON from the aot_misses ledger)",
+                [({"entry": e}, v) for e, v in sorted(by_entry.items())]))
+        return fams
+
+    get_registry().register_collector("aot", _collect)
+    _COLLECTOR_INSTALLED = True
+
+
+def _compile_seconds_histogram():
+    from traceweaver_tpu.obs.registry import get_registry
+
+    return get_registry().histogram(
+        "tw_aot_compile_seconds",
+        "per-variant AOT compile+seed time (a warm persistent cache "
+        "collapses these to deserialize cost)")
+
+
+def _run_warmup(variants: Sequence[_Variant]) -> None:
+    hist = _compile_seconds_histogram()
+    for v in variants:
+        try:
+            secs = v.run()
+        except Exception as e:  # noqa: BLE001 — warmup must never kill serving
+            with _LOCK:
+                _STATE["errors"].append(
+                    f"{_key_str(v.key)}: {type(e).__name__}: {e}")
+            continue
+        hist.observe(secs)
+        with _LOCK:
+            _STATE["compiled"] += 1
+            _STATE["seeded"] += 1
+            _STATE["compile_s"] += secs
+    with _LOCK:
+        _STATE["phase"] = "error" if _STATE["errors"] else "ready"
+        _STATE["t_done"] = time.time()
+
+
+def startup_warmup(context: str = "",
+                   print_fn=None) -> Dict[str, object]:
+    """The startup phase (stream CLI / serve server / executor):
+    read ``TW_AOT`` and act.
+
+    - ``off``: no-op — default programs stay byte-identical, and
+      ``/readyz`` reports ready (nothing is gated).
+    - ``background``: plan the lattice, arm the miss hooks, compile on
+      a daemon thread. Serving begins immediately; shapes not yet
+      compiled fall through to on-demand jit (counted).
+    - ``eager``: same, but compile synchronously before returning —
+      the strict-rollout/test mode.
+
+    Idempotent per process: a second call while armed returns the
+    current status.
+    """
+    global _ARMED, _LATTICE, _THREAD
+    mode = _knobs.get("TW_AOT")
+    if mode == "off":
+        return status()
+    with _LOCK:
+        if _ARMED:
+            return status()
+        tier = _knobs.get("TW_AOT_TIER")
+        horizon = parse_horizon()
+        _STATE.update(mode=mode, tier=tier, phase="warming",
+                      context=context, t_start=time.time(),
+                      compiled=0, seeded=0, compile_s=0.0, errors=[])
+        _ARMED = True
+    _install_collector()
+    # eager is the startup-latency path (tests, strict rollouts, the
+    # cold-start bench children): seed-only, one trace+lower per
+    # variant. background amortizes off the serving path and runs the
+    # full explicit lower().compile() idiom before each seed.
+    variants = _plan(tier, horizon, prelower=(mode == "background"))
+    with _LOCK:
+        _LATTICE = frozenset(v.key for v in variants)
+        _STATE["planned"] = len(variants)
+    if print_fn:
+        print_fn("[aot] %s warmup: %d lattice variants (tier=%s, "
+                 "horizon=%s) — /readyz gates on completion"
+                 % (mode, len(variants), tier,
+                    _knobs.get("TW_AOT_HORIZON")))
+    if mode == "eager":
+        _run_warmup(variants)
+    else:
+        _THREAD = threading.Thread(
+            target=_run_warmup, args=(variants,),
+            name="tw-aot-warmup", daemon=True)
+        _THREAD.start()
+    return status()
+
+
+def wait_ready(timeout_s: float = 600.0) -> bool:
+    """Block until the warmup finishes (tests, eager-ish callers).
+    True iff the lattice tier completed without errors."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        with _LOCK:
+            if _STATE["phase"] in ("ready", "error", "idle"):
+                return _STATE["phase"] == "ready"
+        time.sleep(0.05)
+    return False
+
+
+def status() -> Dict[str, object]:
+    """Snapshot for logs/bench: mode, phase, progress, compile seconds,
+    and the bounded miss ledger (shape string -> count)."""
+    with _LOCK:
+        out = dict(_STATE)
+        out["errors"] = list(_STATE["errors"])
+        out["misses"] = dict(_MISSES)
+        out["lattice_size"] = len(_LATTICE)
+    return out
+
+
+def readiness() -> Tuple[bool, Dict[str, object]]:
+    """The ``/readyz`` contract: (ready, detail). Ready immediately
+    when no warmup is configured (``TW_AOT=off``); 503-worthy while the
+    configured lattice tier is still compiling or if the warmup died
+    (a wedged warmup must alert the rollout, not silently pass)."""
+    with _LOCK:
+        phase = _STATE["phase"]
+        detail = {
+            "aot": _STATE["mode"] if _ARMED else "off",
+            "phase": phase if _ARMED else "off",
+            "planned": _STATE["planned"],
+            "compiled": _STATE["compiled"],
+        }
+        if _STATE["errors"]:
+            detail["errors"] = list(_STATE["errors"])[:8]
+    if not _ARMED:
+        detail["ready"] = True
+        return True, detail
+    ready = phase == "ready"
+    detail["ready"] = ready
+    return ready, detail
+
+
+# ---------------------------------------------------------------------------
+# miss hooks — called from the dispatch sites (algorithms/fleet.py,
+# algorithms/weaver_tpu.py, ops/devcols.py callers)
+# ---------------------------------------------------------------------------
+
+def _record_miss(key: Tuple) -> Optional[str]:
+    if key in _LATTICE:
+        return None
+    shape = _key_str(key)
+    with _LOCK:
+        if shape in _MISSES or len(_MISSES) < MISS_KEY_CAP:
+            _MISSES[shape] = _MISSES.get(shape, 0.0) + 1.0
+    return shape
+
+
+def note_fleet(entry: str, common, tables, n_sweeps: int,
+               hypers: Dict, window_rows=None) -> Optional[str]:
+    """Miss check for one fleet dispatch: ``common`` is the 9-tuple the
+    entry receives (8 window tensors + param_idx), ``tables`` the
+    stacked param tuple, ``hypers`` the static-arg dict. Returns the
+    escaped shape string (for the caller's per-solve ``aot_misses``
+    ledger) or None on a lattice hit. No-op until a warmup arms."""
+    if not _ARMED:
+        return None
+    B, W = common[0].shape
+    E, M = common[3].shape[1], common[3].shape[2]
+    P = tables[0].shape[0]
+    bmax = None if window_rows is None else window_rows.shape[1]
+    key = _fleet_key(entry, B, E, W, M, P, bmax,
+                     hypers.get("max_preds", 0), hypers.get("max_succs", 0),
+                     n_sweeps, hypers.get("epsilon", 1.0),
+                     hypers.get("n_sinkhorn", 40),
+                     hypers.get("sinkhorn_tol", 0.0),
+                     hypers.get("precision", "f32"),
+                     hypers.get("pallas", True),
+                     hypers.get("confidence", False))
+    return _record_miss(key)
+
+
+def note_refit(assign0, window_rows, out_start) -> Optional[str]:
+    """Miss check for the standalone refit dispatch (shapes only — the
+    refit program has no static args)."""
+    if not _ARMED:
+        return None
+    B, E, W = assign0.shape
+    M = out_start.shape[2]
+    P, bmax = window_rows.shape
+    key = _fleet_key("refit_fleet_params", B, E, W, M, P, bmax, 1, 1,
+                     0, 0.0, 0, 0.0, "f32", True, None)
+    return _record_miss(key)
+
+
+def note_packed(entry: str, B: int, E: int, W: int, M: int, mp: int,
+                ms: int, n_sweeps: int, epsilon: float, n_sinkhorn: int,
+                sinkhorn_tol: float, precision: str) -> Optional[str]:
+    """Miss check for the per-service packed dispatch path."""
+    if not _ARMED:
+        return None
+    key = _fleet_key(entry, B, E, W, M, None, None, mp, ms, n_sweeps,
+                     epsilon, n_sinkhorn, sinkhorn_tol, precision, True,
+                     None)
+    return _record_miss(key)
+
+
+def note_assemble(cap: int, in_idx, out_idx) -> Optional[str]:
+    """Miss check for one devcols window assembly."""
+    if not _ARMED:
+        return None
+    B, W = in_idx.shape
+    E, M = out_idx.shape[1], out_idx.shape[2]
+    return _record_miss(_assemble_key(cap, B, E, W, M))
+
+
+def reset_for_tests() -> None:
+    """Disarm and clear all module state (test isolation only)."""
+    global _ARMED, _LATTICE, _THREAD
+    with _LOCK:
+        _ARMED = False
+        _LATTICE = frozenset()
+        _MISSES.clear()
+        _THREAD = None
+        _STATE.update(mode="off", tier=None, phase="idle", context="",
+                      planned=0, compiled=0, seeded=0, compile_s=0.0,
+                      errors=[], t_start=0.0, t_done=0.0)

@@ -463,12 +463,18 @@ def main(argv=None) -> int:
 
         cache_dir = enable_persistent_compilation_cache()
         if cache_dir:
-            # serving-grade cold start (ROADMAP item 2, first slice): a
-            # rolling restart reloads its programs from this cache
-            # instead of recompiling; hit rate is on GET /metrics
+            # serving-grade cold start (ROADMAP item 2): a rolling
+            # restart reloads its programs from this cache instead of
+            # recompiling; hit rate is on GET /metrics
             # (tw_xla_compile_cache_hit_ratio)
             print(f"[serve] persistent XLA compile cache: {cache_dir} "
                   "(TW_JAX_CACHE_DIR; hit rate on /metrics)")
+        # AOT shape-lattice warmup (TW_AOT=background|eager): the cache
+        # must be wired FIRST so a warm cache turns each lattice compile
+        # into a deserialize; /readyz gates rollouts on completion
+        from traceweaver_tpu.runtime import aot
+
+        aot.startup_warmup(context="serve", print_fn=print)
         return serve_main(argv[1:])
     if argv and argv[0] == "stream":
         # online mode rides its own subcommand; the bare flag surface
@@ -486,6 +492,12 @@ def main(argv=None) -> int:
             print(f"[stream] persistent XLA compile cache: {cache_dir} "
                   "(TW_JAX_CACHE_DIR; hit rate on the --metrics-port "
                   "scrape)")
+        # AOT shape-lattice warmup (TW_AOT, runtime/aot.py): background
+        # mode starts consuming immediately while the lattice fills in;
+        # eager blocks until the first micro-batch cannot cold-compile
+        from traceweaver_tpu.runtime import aot
+
+        aot.startup_warmup(context="stream", print_fn=print)
         return stream_main(argv[1:])
     # Backend selection. The sandbox's sitecustomize force-selects the
     # remote "axon" TPU backend whose init can stall for minutes; the env

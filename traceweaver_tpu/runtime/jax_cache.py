@@ -33,6 +33,11 @@ _COUNTERS = {"backend_compiles": 0,
              "persistent_cache_hits": 0,
              "persistent_cache_misses": 0}
 _COUNTERS_INSTALLED = False
+# cache-directory failures (unwritable/read-only/uncreatable TW_JAX_CACHE
+# location): counted so a deployment silently re-paying every compile on
+# every restart is visible on /metrics, warned ONCE on stderr
+_CACHE_ERRORS = 0
+_CACHE_WARNED = False
 
 
 def install_compile_counters() -> None:
@@ -70,6 +75,13 @@ def install_compile_counters() -> None:
                 "(hits / (hits + misses); absent before the first "
                 "cache-eligible compile)",
                 [({}, hits / (hits + misses))]))
+        if _CACHE_ERRORS:
+            fams.append((
+                "tw_xla_cache_errors_total", "counter",
+                "persistent compile-cache setup failures (unwritable/"
+                "uncreatable TW_JAX_CACHE directory): serving continues "
+                "but re-pays compiles every restart",
+                [({}, float(_CACHE_ERRORS))]))
         return fams
 
     get_registry().register_collector("jax_cache", _collect)
@@ -82,9 +94,20 @@ def install_compile_counters() -> None:
         elif name == "/jax/compilation_cache/cache_misses":
             _COUNTERS["persistent_cache_misses"] += 1
 
+    # compile-time histogram (tw_xla_compile_seconds): the SAME duration
+    # event feeds a registry histogram, so warmup vs steady-state compile
+    # cost is visible on /metrics, not only in bench deltas — a healthy
+    # serving process front-loads its mass at startup (AOT warmup /
+    # persistent-cache deserializes) and observes ~nothing afterwards
+    compile_hist = get_registry().histogram(
+        "tw_xla_compile_seconds",
+        "XLA backend compile durations (includes persistent-cache "
+        "deserializes — those land in the millisecond buckets)")
+
     def _on_duration(name, secs, **kw):
         if name == "/jax/core/compile/backend_compile_duration":
             _COUNTERS["backend_compiles"] += 1
+            compile_hist.observe(secs)
 
     monitoring.register_event_listener(_on_event)
     monitoring.register_event_duration_secs_listener(_on_duration)
@@ -141,6 +164,34 @@ def host_cache_key() -> str:
     return f"{platforms.replace(',', '+')}-{fp}"
 
 
+def _cache_dir_error(msg: str) -> None:
+    """Count (always) and warn (once) a cache-directory failure — the
+    former 'silent drop': an unwritable ``TW_JAX_CACHE`` location used
+    to mean quietly compiling everything from scratch on every restart.
+    Serving continues either way; the counter
+    (``tw_xla_cache_errors_total``) is the rollout's tripwire."""
+    global _CACHE_ERRORS, _CACHE_WARNED
+    import sys
+
+    _CACHE_ERRORS += 1
+    if not _CACHE_WARNED:
+        print(f"[jax_cache] WARNING: {msg}", file=sys.stderr)
+        _CACHE_WARNED = True
+
+
+def _probe_writable(cache_dir: str) -> bool:
+    """One write+unlink probe — ``os.access`` lies for root and for
+    read-only mounts, the actual failure mode of a cache volume."""
+    probe = os.path.join(cache_dir, ".tw_write_probe")
+    try:
+        with open(probe, "w") as f:
+            f.write("probe")
+        os.remove(probe)
+        return True
+    except OSError:
+        return False
+
+
 def enable_persistent_compilation_cache(cache_dir: str | None = None) -> str:
     """Point JAX at an on-disk compilation cache (idempotent).
 
@@ -149,6 +200,14 @@ def enable_persistent_compilation_cache(cache_dir: str | None = None) -> str:
     is fine). Returns the cache dir in use ("" when disabled). The actual
     directory is always namespaced per backend+host (:func:`host_cache_key`)
     so entries compiled elsewhere can never be deserialized here.
+
+    Failure hardening (ISSUE 14): an UNCREATABLE location disables the
+    cache with a once-only warning and a ``tw_xla_cache_errors_total``
+    count instead of crashing startup; a created-but-READ-ONLY directory
+    (the typical mis-mounted cache volume) still enables the cache —
+    existing entries deserialize, which is the whole rolling-restart
+    win — but warns and counts, because every NEW program silently
+    re-compiles on every restart until the mount is fixed.
     """
     install_compile_counters()
     if not _knobs.get_bool("TW_JAX_CACHE"):
@@ -156,7 +215,19 @@ def enable_persistent_compilation_cache(cache_dir: str | None = None) -> str:
     base_dir = (cache_dir or _knobs.get("TW_JAX_CACHE_DIR")
                 or DEFAULT_CACHE_DIR)
     cache_dir = os.path.join(base_dir, host_cache_key())
-    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError as e:
+        _cache_dir_error(
+            f"cannot create compile-cache dir {cache_dir!r} ({e}); "
+            "persistent cache DISABLED — every restart re-pays every "
+            "compile (tw_xla_cache_errors_total)")
+        return ""
+    if not _probe_writable(cache_dir):
+        _cache_dir_error(
+            f"compile-cache dir {cache_dir!r} is not writable; existing "
+            "entries will still deserialize but NEW programs re-compile "
+            "every restart (tw_xla_cache_errors_total)")
 
     import jax
 

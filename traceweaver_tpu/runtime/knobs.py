@@ -105,6 +105,23 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        help="ground-truth-free invocation-DAG discovery"),
     _k("TW_JAX_CACHE", "bool", True, help="persistent XLA compile cache"),
     _k("TW_JAX_CACHE_DIR", "str", None, help="compile cache location"),
+    # --- AOT shape-lattice precompile (runtime/aot.py, docs/PERF.md) -----
+    _k("TW_AOT", "enum", "off", choices=("off", "background", "eager"),
+       help="startup AOT precompile of the dispatch shape lattice: "
+            "'background' fills the lattice behind live serving, "
+            "'eager' blocks startup until the tier is compiled, 'off' "
+            "(default) leaves every program to on-demand jit"),
+    _k("TW_AOT_HORIZON", "str", "8:2:8:16",
+       help="pow2 geometry caps of the AOT lattice, B:E:W:M[:D] "
+            "(windows/dispatch, endpoint bucket, window bucket, "
+            "candidate bucket, neighbour-degree bucket); shapes past "
+            "the horizon jit on demand and land in the aot_misses "
+            "ledger"),
+    _k("TW_AOT_TIER", "enum", "serve", choices=("core", "serve", "full"),
+       help="which entry points ride the AOT lattice (and what /readyz "
+            "gates on): core = the 1-pass fleet dispatch (+devcols "
+            "assembly), serve = + fused-EM/refit chain, full = + the "
+            "per-service packed entries"),
     _k("TW_DISABLE_NATIVE", "bool", False,
        help="force the pure-Python ingest parser"),
     # --- capture ingress (traceweaver_tpu/collector, docs/COLLECTOR.md) --

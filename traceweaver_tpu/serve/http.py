@@ -20,6 +20,12 @@ Endpoint reference (full table + curl quickstart in docs/SERVING.md)::
     GET  /api/v1/stats                             service-wide ledger
     GET  /metrics                                  Prometheus exposition
     GET  /healthz                                  liveness
+    GET  /readyz                                   readiness (rolling restarts):
+                                                   503 while the AOT shape
+                                                   lattice is compiling, 200
+                                                   once the configured tier is
+                                                   ready (TW_AOT=off: always
+                                                   200 — docs/SERVING.md)
 
 Error mapping: bad JSON / malformed payloads (strict mode) -> 400,
 unknown tenant or trace -> 404, tenant cap / invalid tenant id -> 429 /
@@ -174,6 +180,17 @@ class ServeHandler(BaseHTTPRequestHandler):
                 if sub == "/healthz":
                     self._reply(200, {"ok": True,
                                       "tenants": len(self.service.tenants)})
+                elif sub == "/readyz":
+                    # the rolling-restart gate (docs/SERVING.md): an
+                    # orchestrator keeps the previous replica in rotation
+                    # until this flips to 200 — i.e. until the AOT shape
+                    # lattice tier is compiled and the first real solve
+                    # cannot stall on a cold jit. TW_AOT=off = always
+                    # ready (nothing is gated).
+                    from traceweaver_tpu.runtime import aot as _aot
+
+                    ready, detail = _aot.readiness()
+                    self._reply(200 if ready else 503, detail)
                 elif sub == "/metrics":
                     # Prometheus text exposition (docs/OBSERVABILITY.md):
                     # the process registry (fleet/stream mirrors, compile

@@ -35,6 +35,7 @@ from traceweaver_tpu.obs import quality as _quality
 from traceweaver_tpu.obs import selftrace as _selftrace
 from traceweaver_tpu.obs.registry import get_registry as _get_registry
 from traceweaver_tpu.ops.precision import precision_from_env
+from traceweaver_tpu.runtime import aot as _aot
 from traceweaver_tpu.runtime import knobs as _knobs
 from traceweaver_tpu.spans import NA, SKIP, Span, SpanArray
 from traceweaver_tpu.stream.checkpoint import load_checkpoint, save_checkpoint
@@ -1146,6 +1147,17 @@ class StreamingReconstructor:
             ),
             seal_emit_p99_ms=self.seal_emit_p99_ms(),
         )
+        aot_status = _aot.status()
+        if aot_status["phase"] != "idle":
+            # AOT warmup ledger (runtime/aot.py): present only when a
+            # warmup armed the lattice — the cold-start bench child and
+            # the serve layer both read progress + misses from here
+            out["aot"] = dict(
+                mode=aot_status["mode"], phase=aot_status["phase"],
+                planned=aot_status["planned"],
+                compiled=aot_status["compiled"],
+                compile_s=round(float(aot_status["compile_s"]), 3),
+                misses=aot_status["misses"])
         cap = self._capture_quality()
         if cap is not None:
             # capture ingress ledger (docs/COLLECTOR.md): per-source
