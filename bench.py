@@ -1707,6 +1707,76 @@ def run_scorecard_leg(n_traces: int) -> dict:
     return report
 
 
+def campaign_fields(artifact: dict) -> dict:
+    """Campaign-leg report builder: flatten one CAMPAIGN_* artifact's
+    headline numbers into bench fields (per-rung sustained spans/s,
+    the steady-state zero-compile gate, accuracy floor, and the
+    aot-miss escape count) — the standing instrument later perf PRs
+    report against (docs/CAMPAIGN.md)."""
+    rungs = artifact.get("rungs", [])
+    spans_per_s = {r["rung"]: r["steady"]["spans_per_s"] for r in rungs}
+    accs = [r["accuracy"]["e2e_pct"] for r in rungs]
+    return {
+        "campaign_name": artifact.get("name"),
+        "campaign_rungs": len(rungs),
+        "campaign_devices": artifact.get("plan", {}).get("devices"),
+        "campaign_slices": artifact.get("plan", {}).get("slices"),
+        "campaign_spans_total": sum(r["manifest"]["spans"] for r in rungs),
+        "campaign_spans_per_s": spans_per_s,
+        "campaign_accuracy_e2e_min": min(accs) if accs else None,
+        "campaign_steady_compiles": sum(
+            r["steady"]["backend_compiles"] for r in rungs),
+        "campaign_aot_misses": sum(
+            len(r["steady"]["aot_misses"]) for r in rungs),
+        "campaign_quarantined": sum(
+            r["steady"]["quarantined"] for r in rungs),
+        "campaign_multislice_agree": all(
+            r["multislice"]["agree"] for r in rungs
+            if r.get("multislice")),
+    }
+
+
+def run_campaign_leg(traces_per_graph: int) -> dict:
+    """bench.py --campaign N: the 2-rung synthetic mini campaign
+    through the REAL harness (traceweaver_tpu/campaign) — mesh-sharded
+    fleet drive, warmup-to-zero-compiles, timed rounds, multislice
+    allreduce — plus a self-compare through the regression gate (a
+    broken gate would wave every future regression through). N sizes
+    the rungs (traces per call graph). Full-scale campaigns run via
+    `cli campaign run` (docs/CAMPAIGN.md)."""
+    import jax
+
+    if _knobs.get("TW_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import tempfile
+
+    from traceweaver_tpu.campaign import (
+        compare_artifacts,
+        mini_plan,
+        run_campaign,
+    )
+
+    n_dev = min(2, jax.device_count())
+    plan = mini_plan(devices=n_dev if n_dev >= 2 else 0,
+                     traces_per_graph=traces_per_graph)
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="tw-bench-campaign-") as tmp:
+        artifact = run_campaign(plan, cache_root=os.path.join(tmp, "cache"),
+                                print_fn=log)
+    self_cmp = compare_artifacts(artifact, artifact)
+    report = dict(mode="campaign",
+                  campaign_wall_s=round(time.perf_counter() - t0, 2),
+                  campaign_compare_self_ok=bool(self_cmp["ok"]),
+                  **campaign_fields(artifact))
+    log("campaign leg: %s spans/s per rung; steady compiles %d, "
+        "aot misses %d, self-compare ok=%s"
+        % (report["campaign_spans_per_s"],
+           report["campaign_steady_compiles"],
+           report["campaign_aot_misses"],
+           report["campaign_compare_self_ok"]))
+    return report
+
+
 def telemetry_fields(stage_stats: dict, snap_before: dict,
                      snap_after: dict) -> dict:
     """Obs-registry agreement proof -> report fields (unit-tested like
@@ -2627,6 +2697,15 @@ if __name__ == "__main__":
                          "skew/loss; gates on skew corrected, churn "
                          "tolerated, and loss degrading gracefully "
                          "(counted, confidence discounted, no crash)")
+    ap.add_argument("--campaign", type=int, nargs="?", const=40,
+                    default=None, metavar="N",
+                    help="standalone campaign leg: the 2-rung synthetic "
+                         "mini campaign through the real harness "
+                         "(traceweaver_tpu/campaign) — mesh fleet drive, "
+                         "warmup to zero compiles, timed rounds, "
+                         "multislice allreduce, and a self-compare "
+                         "through the regression gate; N = traces per "
+                         "call graph (docs/CAMPAIGN.md)")
     ap.add_argument("--scorecard", type=int, nargs="?", const=48,
                     default=None, metavar="N",
                     help="standalone per-regime scorecard leg: all five "
@@ -2686,6 +2765,14 @@ if __name__ == "__main__":
     if args.capture:
         capture_report = run_capture_leg(args.capture)
         line = json.dumps(capture_report)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        sys.exit(0)
+    if args.campaign:
+        campaign_report = run_campaign_leg(args.campaign)
+        line = json.dumps(campaign_report)
         if args.out:
             with open(args.out, "w") as f:
                 f.write(line + "\n")

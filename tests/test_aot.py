@@ -193,6 +193,58 @@ def test_note_hooks_are_inert_until_armed():
     assert aot.status()["misses"] == {}
 
 
+def test_mesh_family_enumerated_when_mesh_configured(monkeypatch):
+    """ISSUE 15 satellite: with TW_MESH_DEVICES configured the lattice
+    grows the sharded program family — per-shard pow2 row counts times
+    the mesh size (the bucket_rows_per_shard padding fleet applies),
+    keyed by shard count so host-fed variants can never masquerade as
+    sharded ones. Without a mesh the family is absent."""
+    monkeypatch.setenv("TW_AOT_HORIZON", "2:1:8:8")
+    plain = aot.plan_lattice(tier="serve")
+    assert all(k[-1] == 1 for k in plain if k[0] == "fleet")
+
+    monkeypatch.setenv("TW_MESH_DEVICES", "2")
+    keys = aot.plan_lattice(tier="serve")
+    mesh_keys = [k for k in keys if k[0] == "fleet" and k[-1] == 2]
+    assert mesh_keys, "no sharded variants planned"
+    # B axis = per-shard pow2 x mesh size, inside the horizon
+    assert {k[2] for k in mesh_keys} == {2, 4}
+    assert {k[1] for k in mesh_keys} == {"solve_windows_fleet",
+                                         "solve_em_fleet"}
+    # mesh-origin standalone refits stay shards=1 (host-array programs)
+    # but appear at the padded mesh row counts with the widened bmax
+    refits = [k for k in keys if k[0] == "fleet"
+              and k[1] == "refit_fleet_params"]
+    assert any(k[2] == 4 and k[7] == 1 for k in refits), (
+        "mesh-origin refit (B=4, bmax=1) not planned")
+    # single-device family unchanged, keys dedupe cleanly
+    assert set(plain) < set(keys)
+    assert len(keys) == len(set(keys))
+    # shard count renders in the operator-facing shape string
+    assert any("x2dev" in aot._key_str(k) for k in mesh_keys)
+
+
+def test_note_fleet_mesh_keys_agree_with_enumerator(monkeypatch):
+    """A mesh dispatch's miss hook must hit the enumerated sharded key
+    (and only it): same geometry without the mesh marker is a DIFFERENT
+    program and must not be confused for it."""
+    from traceweaver_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setenv("TW_MESH_DEVICES", "2")
+    _arm(monkeypatch, horizon="2:2:8:8")
+    mesh = make_mesh(2)
+    tables = (np.zeros((1, 2, 2), bool),)
+    # the sharded full-sweep dispatch at B = 1 row/shard x 2 devices
+    assert aot.note_fleet("solve_windows_fleet", _common(2, 2, 8, 8),
+                          tables, 5, _HYPERS, mesh=mesh) is None
+    # an 8-device dispatch under a 2-device lattice is an escape, named
+    # with the shard marker
+    shape = aot.note_fleet("solve_windows_fleet", _common(8, 2, 8, 8),
+                           tables, 5, _HYPERS, mesh=make_mesh(8))
+    assert shape == ("solve_windows_fleet"
+                     "[B=8,E=2,W=8,M=8,P=1,mp=1,ms=1,sweeps=5,x8dev]")
+
+
 def test_miss_ledger_is_bounded(monkeypatch):
     _arm(monkeypatch, horizon="1:1:8:8", tier="core")
     tables = (np.zeros((1, 1, 1), bool),)

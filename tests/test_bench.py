@@ -542,3 +542,43 @@ def test_scorecard_fields_regimes_and_calibration_flags(bench):
     bare = bench.scorecard_fields({"per_regime": {}, "calibration": []})
     assert bare["scorecard_top_vs_bottom_ok"] is None
     assert bare["scorecard_tpu_minus_best_baseline"] == {}
+
+
+@pytest.mark.campaign
+def test_campaign_fields_flatten_artifact_headlines(bench):
+    """The campaign-leg report builder: per-rung sustained spans/s,
+    the steady zero-compile gate, the accuracy floor, and the
+    multislice agreement flag — flattened from one CAMPAIGN_* artifact
+    (docs/CAMPAIGN.md)."""
+    art = dict(
+        name="mini",
+        plan=dict(devices=2, slices=2),
+        rungs=[
+            dict(rung="a",
+                 manifest=dict(spans=1000),
+                 steady=dict(spans_per_s=2500.0, backend_compiles=0,
+                             aot_misses=[], quarantined=0),
+                 accuracy=dict(e2e_pct=100.0),
+                 multislice=dict(agree=True)),
+            dict(rung="b",
+                 manifest=dict(spans=3000),
+                 steady=dict(spans_per_s=4000.0, backend_compiles=2,
+                             aot_misses=["solve_windows_fleet[B=64]"],
+                             quarantined=1),
+                 accuracy=dict(e2e_pct=98.5),
+                 multislice=None),
+        ])
+    out = bench.campaign_fields(art)
+    assert out["campaign_rungs"] == 2
+    assert out["campaign_devices"] == 2
+    assert out["campaign_spans_total"] == 4000
+    assert out["campaign_spans_per_s"] == {"a": 2500.0, "b": 4000.0}
+    assert out["campaign_accuracy_e2e_min"] == 98.5
+    assert out["campaign_steady_compiles"] == 2
+    assert out["campaign_aot_misses"] == 1
+    assert out["campaign_quarantined"] == 1
+    assert out["campaign_multislice_agree"] is True
+    # empty artifact degrades to counts, not a crash
+    empty = bench.campaign_fields(dict(name="x", plan={}, rungs=[]))
+    assert empty["campaign_rungs"] == 0
+    assert empty["campaign_accuracy_e2e_min"] is None

@@ -570,6 +570,77 @@ def test_aot_readyz_gates_503_while_warming_then_200(monkeypatch):
     service.drain()
 
 
+@pytest.mark.campaign
+def test_campaign_smoke_mini_ladder_end_to_end(tmp_path):
+    """Tier-1 campaign smoke (ISSUE 15 acceptance pin): the 2-rung
+    synthetic mini campaign runs END TO END under JAX_PLATFORMS=cpu —
+    corpus ladder synthesized + manifested, fleet driven data-parallel
+    across a 2-device mesh through the compaction-capable mesh path,
+    warmup until a round compiles nothing, timed steady-state rounds
+    with zero compiles, the multislice allreduce tier agreeing — and
+    writes a valid artifact that (a) self-compares clean through
+    `campaign compare` and (b) FAILS the compare with the right field
+    named when the throughput or accuracy is doctored."""
+    import copy
+    import json
+
+    from traceweaver_tpu.campaign import (
+        compare_artifacts,
+        load_artifact,
+        mini_plan,
+        run_campaign,
+        write_artifact,
+    )
+
+    plan = mini_plan(devices=2, slices=2, traces_per_graph=25)
+    out = str(tmp_path / "CAMPAIGN_smoke.json")
+    art = run_campaign(plan, out_path=out,
+                       cache_root=str(tmp_path / "corpus"))
+
+    # artifact round-trips from disk and carries the whole ledger
+    loaded = load_artifact(out)
+    assert loaded == json.loads(json.dumps(art))  # json-clean
+    assert [r["rung"] for r in art["rungs"]] == ["mini-a", "mini-b"]
+    for r in art["rungs"]:
+        assert r["manifest"]["spans"] > 0
+        assert r["steady"]["spans_per_s"] > 0
+        assert r["steady"]["rounds"] == 2
+        # the steady state is the zero-compile contract the warmup buys
+        assert r["warmup"]["backend_compiles"][-1] == 0
+        assert r["steady"]["backend_compiles"] == 0, r["steady"]
+        assert r["steady"]["aot_misses"] == []
+        assert r["steady"]["quarantined"] == 0
+        # the mesh path actually ran: sharded dispatches fetched flags
+        # through the coalesced single-transfer fan-in
+        assert r["steady"]["bytes"]["d2h_flag_fetches"] > 0
+        assert r["steady"]["bytes"]["d2h_bytes_flags"] > 0
+        assert r["steady"]["fleet"]["compact_windows_total"] > 0
+        assert r["accuracy"]["e2e_pct"] > 90.0
+        assert r["multislice"]["agree"] and r["multislice"]["slices"] == 2
+    assert art["plan"]["devices"] == 2
+
+    # the regression gate: self-compare passes...
+    assert compare_artifacts(art, art)["ok"]
+    # ...a doctored throughput regression fails naming rung+field...
+    slow = copy.deepcopy(art)
+    slow["rungs"][1]["steady"]["spans_per_s"] *= 0.5
+    res = compare_artifacts(art, slow)
+    assert not res["ok"]
+    assert [(r["rung"], r["field"]) for r in res["regressions"]] == \
+        [("mini-b", "spans_per_s")]
+    # ...and so does a doctored accuracy drop, through the CLI surface
+    bad_acc = copy.deepcopy(art)
+    bad_acc["rungs"][0]["accuracy"]["e2e_pct"] -= 5.0
+    p_bad = str(tmp_path / "doctored.json")
+    write_artifact(p_bad, bad_acc)
+    from traceweaver_tpu.campaign import main as campaign_main
+
+    assert campaign_main(["compare", out, p_bad]) == 1
+    res2 = compare_artifacts(art, bad_acc)
+    assert {r["field"] for r in res2["regressions"]} == \
+        {"accuracy_e2e_pct"}
+
+
 @pytest.mark.adapt
 def test_adapt_smoke_inert_off_and_compile_free_steady_state(
         monkeypatch, tmp_path):

@@ -1,0 +1,324 @@
+"""Campaign harness tests (ISSUE 15, docs/CAMPAIGN.md).
+
+Corpus-ladder determinism + manifest invariants, plan-spec validation,
+the regression-gate compare semantics, the events + tw_campaign_*
+observability mirror, and the multislice/mesh integration seams. The
+full end-to-end mini campaign (mesh-sharded run -> artifact ->
+self-compare -> doctored-regression detection) is the tier-1 smoke in
+tests/test_bench_smoke.py.
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.campaign
+
+
+# ---------------------------------------------------------------------------
+# corpus ladder: synthesizer determinism + manifest invariants
+# ---------------------------------------------------------------------------
+
+def _tree_bytes(root):
+    """{relative path: bytes} over a corpus tree (order-independent)."""
+    out = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            with open(path, "rb") as f:
+                out[rel] = f.read()
+    return out
+
+
+def test_synthesize_same_seed_is_byte_identical(tmp_path):
+    """Same seed => byte-identical corpus across runs: the campaign
+    cache key is the spec fingerprint, which is only sound if the
+    synthesizer is a pure function of it — every Jaeger file, every
+    call-graph grouping, and the replica-table pickle must match."""
+    from traceweaver_tpu.alibaba.synthesize import synthesize_corpus
+
+    kw = dict(n_graphs=2, traces_per_graph=20, seed=33, base_gap_ms=500,
+              n_services=10)
+    dirs_a = synthesize_corpus(str(tmp_path / "a"), **kw)
+    dirs_b = synthesize_corpus(str(tmp_path / "b"), **kw)
+    assert len(dirs_a) == len(dirs_b) > 0
+    a, b = _tree_bytes(tmp_path / "a"), _tree_bytes(tmp_path / "b")
+    assert sorted(a) == sorted(b)
+    diff = [rel for rel in a if a[rel] != b[rel]]
+    assert diff == [], f"same-seed corpus diverged on {diff[:5]}"
+
+    # a different seed must actually change the corpus (the ladder's
+    # rungs are distinct workloads, not copies)
+    synthesize_corpus(str(tmp_path / "c"), **dict(kw, seed=34))
+    c = _tree_bytes(tmp_path / "c")
+    assert sorted(a) != sorted(c) or any(
+        a[rel] != c.get(rel) for rel in a)
+
+
+def test_build_rung_manifest_matches_recomputed_regimes(tmp_path):
+    """Manifest invariants: the regime-mix fields must equal
+    service_regime recomputed from the loaded spans, and the span/
+    service counts must equal what the stores actually hold."""
+    from traceweaver_tpu.campaign.corpus import build_rung
+    from traceweaver_tpu.campaign.plan import RungSpec
+    from traceweaver_tpu.metrics.accuracy import service_regime
+
+    spec = RungSpec("inv", n_graphs=2, traces_per_graph=25, gap_ms=300,
+                    seed=5, n_services=10, source="synthetic")
+    corpus = build_rung(spec, str(tmp_path))
+    man = corpus.manifest
+    assert man["spans"] == sum(len(s.all_spans) for s in corpus.stores)
+    assert man["services_solvable"] == len(corpus.problems) > 0
+    assert man["call_graphs"] == len(corpus.stores) == 2
+
+    recomputed = {}
+    for meta in corpus.problems:
+        reg = service_regime(meta["prob"].in_span_partitions,
+                             meta["prob"].out_span_partitions)
+        recomputed[reg["regime"]] = recomputed.get(reg["regime"], 0) + 1
+        # the per-problem regime the runner grades with matches too
+        assert meta["regime"]["regime"] == reg["regime"]
+        assert meta["regime"]["fan_out"] == reg["fan_out"]
+    assert man["regime_mix"] == dict(sorted(recomputed.items()))
+    per_service_mix = {}
+    for row in man["per_service"]:
+        per_service_mix[row["regime"]] = \
+            per_service_mix.get(row["regime"], 0) + 1
+    assert per_service_mix == man["regime_mix"]
+
+
+def test_build_rung_reuses_cached_corpus(tmp_path):
+    """Second build of the same spec must NOT re-synthesize: the
+    manifest fingerprint keys the cache (a 1M-span rung is minutes of
+    synthesis)."""
+    from traceweaver_tpu.campaign.corpus import build_rung
+    from traceweaver_tpu.campaign.plan import RungSpec
+
+    spec = RungSpec("cache", n_graphs=2, traces_per_graph=15, seed=3,
+                    n_services=8, source="synthetic")
+    first = build_rung(spec, str(tmp_path))
+    assert first.cached is False
+    trace_file = next(
+        os.path.join(dp, f) for dp, _, fs in os.walk(first.root)
+        for f in fs if f.endswith(".json") and f != "manifest.json")
+    mtime = os.path.getmtime(trace_file)
+    second = build_rung(spec, str(tmp_path))
+    assert second.cached is True
+    assert os.path.getmtime(trace_file) == mtime
+    assert second.manifest["spans"] == first.manifest["spans"]
+
+    # a changed spec (different seed) must invalidate, not reuse
+    third = build_rung(RungSpec("cache", n_graphs=2, traces_per_graph=15,
+                                seed=4, n_services=8, source="synthetic"),
+                       str(tmp_path))
+    assert third.cached is False
+
+
+# ---------------------------------------------------------------------------
+# plan spec
+# ---------------------------------------------------------------------------
+
+def test_plan_validation_raises_on_bad_specs():
+    from traceweaver_tpu.campaign.plan import (
+        CampaignPlan,
+        PlanError,
+        RungSpec,
+        from_dict,
+    )
+
+    with pytest.raises(PlanError):
+        CampaignPlan(rungs=[]).validate()  # no rungs
+    with pytest.raises(PlanError):
+        CampaignPlan(rungs=[RungSpec("a"), RungSpec("a")]).validate()
+    with pytest.raises(PlanError):
+        CampaignPlan(rungs=[RungSpec("a")], devices=3).validate()
+    with pytest.raises(PlanError):
+        CampaignPlan(rungs=[RungSpec("a")],
+                     knobs={"TW_TYPO": "1"}).validate()
+    with pytest.raises(PlanError):
+        from_dict({"rungs": [{"name": "a"}], "surprise": 1})
+    with pytest.raises(PlanError):
+        from_dict({"rungs": [{"name": "a", "surprise": 1}]})
+    # round trip: to_dict -> from_dict is the identity on valid plans
+    plan = CampaignPlan(rungs=[RungSpec("a"), RungSpec("b", seed=2)],
+                        devices=2, slices=2, knobs={"TW_COMPACT": "1"})
+    assert from_dict(plan.to_dict()).to_dict() == plan.to_dict()
+
+
+def test_campaign_knobs_registered_typed_ranged():
+    from traceweaver_tpu.runtime import knobs
+
+    for name, typ in [("TW_CAMPAIGN_ROUNDS", "int"),
+                      ("TW_CAMPAIGN_WARMUP_MAX", "int"),
+                      ("TW_CAMPAIGN_CACHE", "str"),
+                      ("TW_CAMPAIGN_TOL_PCT", "float"),
+                      ("TW_CAMPAIGN_TOL_ACC", "float")]:
+        assert name in knobs.REGISTRY, name
+        assert knobs.REGISTRY[name].type == typ, name
+    assert knobs.REGISTRY["TW_CAMPAIGN_ROUNDS"].lo == 1
+    assert knobs.REGISTRY["TW_CAMPAIGN_TOL_ACC"].lo == 0.0
+
+
+# ---------------------------------------------------------------------------
+# regression gate
+# ---------------------------------------------------------------------------
+
+def _fake_artifact():
+    def rung(name, tp, acc, misses=(), compiles=0):
+        return dict(
+            rung=name,
+            manifest=dict(spans=1000, regime_mix={"sequential": 3}),
+            steady=dict(spans_per_s=tp, backend_compiles=compiles,
+                        aot_misses=list(misses), quarantined=0),
+            accuracy=dict(e2e_pct=acc, per_regime={}),
+        )
+
+    return dict(schema=1, kind="campaign", name="t", created_unix=0.0,
+                backend="cpu", devices_visible=2,
+                plan=dict(devices=2, slices=2),
+                rungs=[rung("r1", 1000.0, 99.0), rung("r2", 5000.0, 97.0)],
+                metrics_scrape=None, wall_s=1.0)
+
+
+def test_compare_flags_each_regression_class():
+    from traceweaver_tpu.campaign.compare import compare_artifacts
+
+    base = _fake_artifact()
+    assert compare_artifacts(base, base)["ok"]
+
+    # throughput drop past tolerance, named with the right rung+field
+    cand = copy.deepcopy(base)
+    cand["rungs"][1]["steady"]["spans_per_s"] = 4000.0
+    res = compare_artifacts(base, cand, tol_pct=10.0, tol_acc=1.0)
+    assert not res["ok"]
+    assert [(r["rung"], r["field"]) for r in res["regressions"]] == \
+        [("r2", "spans_per_s")]
+    # inside tolerance -> clean
+    assert compare_artifacts(base, cand, tol_pct=25.0, tol_acc=1.0)["ok"]
+
+    # accuracy drop past the points bar
+    cand = copy.deepcopy(base)
+    cand["rungs"][0]["accuracy"]["e2e_pct"] = 97.5
+    res = compare_artifacts(base, cand, tol_pct=10.0, tol_acc=1.0)
+    assert [(r["rung"], r["field"]) for r in res["regressions"]] == \
+        [("r1", "accuracy_e2e_pct")]
+
+    # new AOT escapes + steady compiles are cold-start regressions
+    cand = copy.deepcopy(base)
+    cand["rungs"][0]["steady"]["aot_misses"] = ["solve_windows_fleet[B=64]"]
+    cand["rungs"][0]["steady"]["backend_compiles"] = 3
+    res = compare_artifacts(base, cand)
+    fields = {r["field"] for r in res["regressions"]}
+    assert fields == {"aot_misses", "steady_backend_compiles"}
+
+    # a silently dropped rung must not pass
+    cand = copy.deepcopy(base)
+    cand["rungs"] = cand["rungs"][:1]
+    res = compare_artifacts(base, cand)
+    assert [r["field"] for r in res["regressions"]] == ["missing_rung"]
+
+    # improvements are never flagged
+    cand = copy.deepcopy(base)
+    cand["rungs"][1]["steady"]["spans_per_s"] = 9000.0
+    cand["rungs"][1]["accuracy"]["e2e_pct"] = 99.5
+    assert compare_artifacts(base, cand)["ok"]
+
+
+def test_compare_tolerances_come_from_registry_knobs(monkeypatch):
+    from traceweaver_tpu.campaign.compare import compare_artifacts
+
+    base = _fake_artifact()
+    cand = copy.deepcopy(base)
+    cand["rungs"][0]["steady"]["spans_per_s"] = 900.0  # -10%
+    monkeypatch.setenv("TW_CAMPAIGN_TOL_PCT", "5")
+    assert not compare_artifacts(base, cand)["ok"]
+    monkeypatch.setenv("TW_CAMPAIGN_TOL_PCT", "15")
+    assert compare_artifacts(base, cand)["ok"]
+
+
+# ---------------------------------------------------------------------------
+# events + /metrics mirror (TW007 discipline: scrape == ledger)
+# ---------------------------------------------------------------------------
+
+def test_campaign_run_emits_events_and_metrics(tmp_path, monkeypatch):
+    """A (single-device, single-slice, tiny) campaign run must emit
+    kind="campaign" start/rung/finish events to the TW_EVENTS sink and
+    mirror the rung ledger onto tw_campaign_* families — values equal
+    to the artifact's own numbers, by construction."""
+    from traceweaver_tpu.campaign import ledger, mini_plan, run_campaign
+    from traceweaver_tpu.campaign.plan import CampaignPlan, RungSpec
+    from traceweaver_tpu.obs import events as obs_events
+    from traceweaver_tpu.obs.registry import get_registry
+
+    ledger.reset_for_tests()
+    sink_path = tmp_path / "events.jsonl"
+    prev = obs_events.install(obs_events.EventLog(str(sink_path)))
+    try:
+        plan = CampaignPlan(
+            name="evt",
+            rungs=[RungSpec("only", n_graphs=2, traces_per_graph=12,
+                            seed=9, n_services=8, source="synthetic")],
+            devices=0, slices=1, timed_rounds=1, warmup_max=2)
+        art = run_campaign(plan, out_path=str(tmp_path / "evt.json"),
+                           cache_root=str(tmp_path / "cache"))
+    finally:
+        obs_events.install(prev)
+
+    records = [json.loads(line)
+               for line in sink_path.read_text().splitlines()]
+    campaign_events = [r for r in records if r.get("kind") == "campaign"]
+    assert [r["event"] for r in campaign_events] == \
+        ["start", "rung", "finish"]
+    rung_evt = campaign_events[1]
+    assert rung_evt["rung"] == "only"
+    assert rung_evt["spans_per_s"] == pytest.approx(
+        art["rungs"][0]["steady"]["spans_per_s"], rel=0.01)
+    # "campaign" is a documented tailing kind (cli events --kind)
+    assert "campaign" in obs_events.KNOWN_KINDS
+
+    snap = get_registry().snapshot(include_collectors=True)
+    assert snap['tw_campaign_spans_per_s{rung="only"}'] == \
+        art["rungs"][0]["steady"]["spans_per_s"]
+    assert snap['tw_campaign_accuracy_e2e{rung="only"}'] == \
+        art["rungs"][0]["accuracy"]["e2e_pct"]
+    assert snap["tw_campaign_runs_total"] == 1.0
+    assert snap["tw_campaign_rungs_total"] == 1.0
+    assert snap["tw_campaign_steady_compiles_total"] == \
+        art["rungs"][0]["steady"]["backend_compiles"]
+    # the artifact carries the mid-run /metrics scrape
+    assert art["metrics_scrape"]["total_samples"] > 0
+    assert any(s.startswith("tw_")
+               for s in art["metrics_scrape"]["samples"])
+
+
+# ---------------------------------------------------------------------------
+# cli surface (no-backend paths)
+# ---------------------------------------------------------------------------
+
+def test_campaign_cli_compare_and_report_roundtrip(tmp_path, capsys):
+    from traceweaver_tpu.campaign import main as campaign_main
+    from traceweaver_tpu.campaign.ledger import write_artifact
+
+    base = _fake_artifact()
+    doctored = copy.deepcopy(base)
+    doctored["rungs"][0]["steady"]["spans_per_s"] = 10.0
+    p_base = str(tmp_path / "base.json")
+    p_bad = str(tmp_path / "bad.json")
+    write_artifact(p_base, base)
+    write_artifact(p_bad, doctored)
+
+    assert campaign_main(["compare", p_base, p_base]) == 0
+    assert campaign_main(["compare", p_base, p_bad]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION r1/spans_per_s" in out
+    assert campaign_main(["report", p_base]) == 0
+    assert "r2" in capsys.readouterr().out
+    assert campaign_main([]) == 2
+    assert campaign_main(["frobnicate"]) == 2

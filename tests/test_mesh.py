@@ -129,3 +129,32 @@ def test_fleet_identical_on_1_vs_8_devices(mesh8, hotel_store):
     for it, s, m in zip(items, single, sharded):
         assert m[0] == s[0], f"mesh fleet diverged on {it.svc}"
         assert m[2] == s[2] and m[4] == s[4] and m[5] == s[5]
+
+
+def test_mesh_flag_fetch_coalesced_and_batch_pow2_bucketed(mesh8):
+    """ISSUE 15 satellites on a SYNTHETIC workload (no datasets): the
+    mesh path's compaction flag fetch is ONE ledgered transfer per
+    dispatch group (device-side shard gather, ``coalesce_to_device0``)
+    billed under d2h_bytes_flags like the single-device path, the mesh
+    batch axis pads to bucket_rows_per_shard (pow2 rows per shard — the
+    bound that puts the sharded family inside the AOT lattice), and the
+    sharded solve stays output-identical to single-device."""
+    import sys
+
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_pipeline import _mixed_items
+
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+    single = solve_fleet(_mixed_items(), stats={})
+    stats = {}
+    sharded = solve_fleet(_mixed_items(), mesh=mesh8, stats=stats)
+    assert stats.get("compact_windows_total", 0) > 0
+    # one coalesced fetch per compacted pass; each fetch is the padded
+    # [B] bool flag vector, so the byte ledger equals the window count
+    assert stats.get("d2h_flag_fetches", 0) > 0
+    assert stats["d2h_bytes_flags"] == stats["compact_windows_total"]
+    # every mesh dispatch's padded batch is pow2 rows per shard
+    assert stats["compact_windows_total"] % 8 == 0
+    for s, m in zip(single, sharded):
+        assert m[0] == s[0] and m[1] == s[1] and m[2:] == s[2:]

@@ -334,6 +334,25 @@ def _fetch(handle, st: _Stats, flow_wait=None, flag_fetch: bool = False):
     return out
 
 
+def _fetch_flags(flags, st: _Stats, flow_wait, mesh=None):
+    """Convergence-flag fetch for one dispatch group, coalesced.
+
+    Single device: one blocking fetch of the ``[B]`` bool array (O(B)
+    bytes — the compaction contract). On a mesh the flags come back
+    SHARDED, and a naive host read fans into one D2H round trip per
+    shard; instead the shards are gathered onto the mesh's first device
+    (ICI, device-side) and the host pays ONE transfer — billed under
+    ``d2h_bytes_flags`` exactly like the single-device path, with
+    ``d2h_flag_fetches`` counting fetches (one per dispatch group, not
+    per shard) so the fan-in stays auditable from stats alone."""
+    if mesh is not None and int(mesh.devices.size) > 1:
+        from traceweaver_tpu.parallel.mesh import coalesce_to_device0
+
+        flags = coalesce_to_device0(flags, mesh)
+    st.add("d2h_flag_fetches", 1.0)
+    return _fetch(flags, st, flow_wait, flag_fetch=True)
+
+
 class FleetItem:
     """One service's solve request (the FindAssignments argument set)."""
 
@@ -757,10 +776,20 @@ def solve_fleet(
     # around the WHOLE dispatch phase — per-dispatch deltas would double
     # count under the pipeline's concurrent flows.
     counters_before = compile_counters()
-    if _pipeline_on():
+    # mesh dispatches carry cross-replica collectives (the sweep's global
+    # convergence reduce, the fused refit's cross-shard gather), and XLA's
+    # rendezvous matches participants by per-device SUBMISSION order —
+    # two host threads racing their sharded launches onto the same
+    # devices interleave run ids and deadlock the whole mesh (observed
+    # live on the campaign's 2-device CPU probe). Sharded groups
+    # therefore always launch from the single serial flow; the pipeline's
+    # pack/dispatch overlap is a single-device optimization.
+    if _pipeline_on() and mesh is None:
         _solve_groups_pipelined(specs, solver, results, st, hypers_common,
                                 mesh, ctx)
     else:
+        if mesh is not None and _pipeline_on() and len(specs) > 1:
+            st.add("mesh_serialized_groups", float(len(specs)))
         _solve_groups_serial(specs, solver, results, st, hypers_common,
                              mesh, ctx)
     for key, val in counters_delta(counters_before).items():
@@ -1397,11 +1426,21 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
         # batch rows pad to the mesh size ON THE HOST and stay numpy here:
         # the compacted flow gathers redispatch rows from these host
         # tensors and places fresh sharded copies per dispatch (the
-        # donated device buffers of an earlier dispatch cannot be reused)
-        from traceweaver_tpu.parallel.mesh import _pad_batch
+        # donated device buffers of an earlier dispatch cannot be reused).
+        # The padded size is bucket_rows_per_shard — pow2 rows per shard
+        # — not just a multiple of the mesh: raw row counts vary per
+        # group, and an unbucketed mesh batch axis would mint one
+        # compiled sharded program per count, putting the whole mesh
+        # family outside any finite AOT lattice (runtime/aot.py
+        # enumerates exactly these pow2-per-shard sizes)
+        from traceweaver_tpu.parallel.mesh import (
+            _pad_batch,
+            bucket_rows_per_shard,
+        )
 
         n_dev = int(mesh.devices.size)
-        batch, true_b = _pad_batch(batch, n_dev)
+        batch, true_b = _pad_batch(
+            batch, bucket_rows_per_shard(pg["n_rows"], n_dev))
         pidx = np.concatenate(
             [pidx, np.zeros(batch["in_start"].shape[0] - true_b,
                             dtype=pidx.dtype)])
@@ -1481,7 +1520,7 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
             if n_passes == 2:
                 _note_aot(st, _aot.note_fleet(
                     "solve_em_fleet", common, _tables_of(params), n_sweeps,
-                    hypers, window_rows=window_rows))
+                    hypers, window_rows=window_rows, mesh=mesh))
                 out, _ = solve_em_fleet(
                     *common, window_rows, window_valid, *_tables_of(params),
                     n_sweeps=n_sweeps, **hypers,
@@ -1489,7 +1528,7 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
             else:
                 _note_aot(st, _aot.note_fleet(
                     "solve_windows_fleet", common, _tables_of(params),
-                    n_sweeps, hypers))
+                    n_sweeps, hypers, mesh=mesh))
                 out, _ = solve_windows_fleet(
                     *common, *_tables_of(params), n_sweeps=n_sweeps,
                     **hypers,
@@ -1676,10 +1715,9 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
             warm_common = assemble(None, pad0) + (_pad_pidx(pidx, pad0),)
         else:
             warm_common = place(batch, pidx)
-        if mesh is None:
-            _note_aot(st, _aot.note_fleet(
-                "solve_windows_fleet", warm_common, tables_dev, warm,
-                hypers))
+        _note_aot(st, _aot.note_fleet(
+            "solve_windows_fleet", warm_common, tables_dev, warm,
+            hypers, mesh=mesh))
         out_warm, flags = solve_windows_fleet(
             *warm_common, *tables_dev, n_sweeps=warm, **hypers)
     # the big warm block starts its D2H NOW — it overlaps the flag fetch,
@@ -1687,8 +1725,8 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
     _copy_async(out_warm)
     w0 = _selftrace.now_us()
     with _profile.annotate("tw:fleet:flag-fetch"):
-        converged = _fetch(flags, st, flow_wait,
-                           flag_fetch=True).astype(bool)
+        converged = _fetch_flags(flags, st, flow_wait,
+                                 mesh=mesh).astype(bool)
     if assemble is not None:
         # drop the pow2 padding rows: all-invalid windows converge by
         # construction and must not inflate the compaction ledger (or
@@ -1739,10 +1777,9 @@ def _compacted_pass(batch, pidx, tables, n_sweeps, warm, hypers, stats,
         redispatch_common = place(gathered, pidx_active)
     w0 = _selftrace.now_us()
     with _profile.annotate("tw:fleet:redispatch"):
-        if mesh is None:
-            _note_aot(st, _aot.note_fleet(
-                "solve_windows_fleet", redispatch_common, tables_dev,
-                n_sweeps, hypers))
+        _note_aot(st, _aot.note_fleet(
+            "solve_windows_fleet", redispatch_common, tables_dev,
+            n_sweeps, hypers, mesh=mesh))
         out_full, _ = solve_windows_fleet(
             *redispatch_common, *tables_dev,
             n_sweeps=n_sweeps, **hypers)
@@ -1787,9 +1824,11 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
         bi = batch
         pidx_refit = pidx
     assign_refit = out0[..., _layout.CH_ASSIGN].astype(np.int32)
-    if mesh is None:
-        _note_aot(st, _aot.note_refit(assign_refit, window_rows,
-                                      bi["out_start"]))
+    # the refit's inputs stay host NumPy on BOTH paths (the mesh flow
+    # hands it the pre-placement tensors), so its compiled program is
+    # the single-device one regardless of mesh — note with shards=1
+    _note_aot(st, _aot.note_refit(assign_refit, window_rows,
+                                  bi["out_start"]))
     new_tables = refit_fleet_params(
         assign_refit,
         bi["in_start"], bi["in_end"], bi["in_valid"],

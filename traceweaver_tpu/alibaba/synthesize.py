@@ -114,6 +114,7 @@ def synthesize_corpus(
     messy: Dict[str, float] = None,
     replica_dist: str = "loguniform-16-128",
     stats: Dict[str, int] = None,
+    n_services: int = 60,
 ) -> List[str]:
     # base_gap_ms defaults to ~2s between trace arrivals: clusterdata traces
     # spread over hours, and exp5's compress_factor=15000 sweep only makes
@@ -147,17 +148,25 @@ def synthesize_corpus(
     (``loguniform-A-B`` or ``fixed-N``) — the exp5 top-rung absolute
     accuracies scale with this assumption (see BASELINE.md), so the knob
     exists to measure sensitivity.
+
+    ``n_services`` sizes the cluster-wide microservice pool the call
+    graphs sample from (default 60, the historical corpus). The campaign
+    corpus ladder (``traceweaver_tpu/campaign/corpus.py``) widens it on
+    the top rungs so service-count scaling is measured, not held fixed.
     """
     rng = random.Random(seed)
     messy = messy or {}
-    services = [f"MS_{i:05d}" for i in range(60)]
+    services = [f"MS_{i:05d}" for i in range(n_services)]
     traces: Dict[str, List[CallRecord]] = {}
     counters = stats if stats is not None else {}
     counters.update(emitted=0, kept=0, dropped=0, defect_injected=0)
 
     t_now = 1_600_000_000_000  # epoch ms
     for g in range(n_graphs):
-        n_services = rng.randint(3, 12)
+        # clamp to the pool: a narrow campaign rung (n_services < 12)
+        # must not over-sample; the default 60-service pool draws the
+        # historical randint(3, 12) sequence unchanged
+        n_services = rng.randint(3, min(12, len(services)))
         svc_ids = rng.sample(range(len(services)), n_services)
         topology = _random_topology(
             rng, n_services,

@@ -318,16 +318,17 @@ class HostSyncHazard:
 
     HOT = ("algorithms/fleet.py", "algorithms/weaver_tpu.py",
            "stream/service.py")
-    #: functions allowed to convert device handles: THE ledgered helper
-    ALLOWED_FUNCS = ("_fetch",)
+    #: functions allowed to convert device handles: the ledgered
+    #: helpers (_fetch_flags wraps _fetch with the mesh shard fan-in)
+    ALLOWED_FUNCS = ("_fetch", "_fetch_flags")
     #: the helper named in finding messages (subclasses re-point it)
     LEDGER_HINT = "fleet._fetch"
     _DEVICE_RE = re.compile(r"^(solve_|refit_|fused_)")
     _DEVICE_EXACT = {"jax.device_put", "device_put"}
     _CONVERSIONS = {"np.asarray", "np.array", "numpy.asarray",
                     "numpy.array", "float"}
-    _LAUNDER = {"_fetch", "np.asarray", "np.array", "numpy.asarray",
-                "numpy.array", "float"}
+    _LAUNDER = {"_fetch", "_fetch_flags", "np.asarray", "np.array",
+                "numpy.asarray", "numpy.array", "float"}
 
     def _is_device_call(self, node: ast.AST) -> bool:
         if not isinstance(node, ast.Call):

@@ -1,0 +1,188 @@
+"""Campaign harness: the Alibaba-scale sustained-throughput subsystem.
+
+``cli campaign run|compare|report`` (docs/CAMPAIGN.md) turns the
+paper's headline claim — >=100x spans/s vs Gurobi on the Alibaba trace
+— from a one-off bench leg into a durable, regression-gated load test:
+
+- :mod:`~traceweaver_tpu.campaign.corpus`  — the 100k..1M-span corpus
+  ladder (real shards or the deterministic synthesize ladder), cached,
+  with a per-rung regime-mix manifest;
+- :mod:`~traceweaver_tpu.campaign.plan`    — the declarative campaign
+  spec (rung ladder x device topology x knob profile);
+- :mod:`~traceweaver_tpu.campaign.runner`  — fleet drive data-parallel
+  across the mesh, warmup-to-zero-compiles, timed steady-state rounds,
+  and the multislice allreduce tier;
+- :mod:`~traceweaver_tpu.campaign.ledger`  — the ``CAMPAIGN_*.json``
+  artifact + the ``tw_campaign_*`` /metrics mirror and
+  ``kind="campaign"`` events;
+- :mod:`~traceweaver_tpu.campaign.compare` — the regression gate.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from traceweaver_tpu.campaign.compare import (  # noqa: F401
+    compare_artifacts,
+    compare_paths,
+    format_compare,
+    format_report,
+)
+from traceweaver_tpu.campaign.corpus import build_rung  # noqa: F401
+from traceweaver_tpu.campaign.ledger import (  # noqa: F401
+    load_artifact,
+    write_artifact,
+)
+from traceweaver_tpu.campaign.plan import (  # noqa: F401
+    CampaignPlan,
+    PlanError,
+    RungSpec,
+    alibaba_ladder,
+    from_dict,
+    load_plan,
+    mini_plan,
+)
+from traceweaver_tpu.campaign.runner import run_campaign  # noqa: F401
+
+
+def _build_run_parser():
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m traceweaver_tpu.runtime.cli campaign run",
+        description="Run a sustained-throughput campaign over the "
+                    "Alibaba corpus ladder (docs/CAMPAIGN.md).")
+    p.add_argument("--plan", default=None,
+                   help="campaign plan JSON (default: the built-in "
+                        "alibaba ladder; --mini for the 2-rung smoke)")
+    p.add_argument("--mini", action="store_true",
+                   help="run the built-in 2-rung synthetic mini "
+                        "campaign (CI-sized)")
+    p.add_argument("--out", default=None,
+                   help="write the CAMPAIGN_*.json artifact here")
+    p.add_argument("--devices", type=int, default=None,
+                   help="override the plan's mesh size (0/1 = single "
+                        "device; >=2 pow2 shards the fleet)")
+    p.add_argument("--slices", type=int, default=None,
+                   help="override the plan's multislice tier count")
+    p.add_argument("--rounds", type=int, default=None,
+                   help="override timed steady-state rounds "
+                        "(default TW_CAMPAIGN_ROUNDS)")
+    p.add_argument("--cache", default=None,
+                   help="corpus cache root (default TW_CAMPAIGN_CACHE "
+                        "or .campaign_corpus next to --out)")
+    return p
+
+
+def _run_main(argv: List[str]) -> int:
+    """``campaign run``: resolve the plan BEFORE any jax import so the
+    CPU stand-in can still grow virtual devices for the mesh."""
+    import os
+
+    args = _build_run_parser().parse_args(argv)
+    if args.plan:
+        plan = load_plan(args.plan)
+    elif args.mini:
+        plan = mini_plan()
+    else:
+        plan = alibaba_ladder()
+    if args.devices is not None:
+        plan.devices = args.devices
+    if args.slices is not None:
+        plan.slices = args.slices
+    if args.rounds is not None:
+        plan.timed_rounds = args.rounds
+    plan.validate()
+
+    from traceweaver_tpu.runtime import knobs as _knobs
+
+    if (plan.devices >= 2 and _knobs.get("TW_BACKEND") == "cpu"
+            and "jax" not in sys.modules
+            and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        # the CPU stand-in materializes one device unless XLA is told
+        # otherwise BEFORE backend init — same dance as tests/conftest.py
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={plan.devices}"
+        ).strip()
+
+    import jax
+
+    if _knobs.get("TW_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from traceweaver_tpu.runtime.jax_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    cache_dir = enable_persistent_compilation_cache()
+    if cache_dir:
+        print(f"[campaign] persistent XLA compile cache: {cache_dir}")
+    # AOT warmup BEFORE the drive: with TW_AOT armed (and the mesh
+    # family in the lattice, runtime/aot.py) the first warmup round
+    # should already be compile-free
+    from traceweaver_tpu.runtime import aot
+
+    # the plan's knob profile applies for the warmup too (run_campaign
+    # re-applies and restores it around the drive): the lattice must be
+    # planned under the same TW_MESH_DEVICES/TW_* the rungs dispatch with
+    from traceweaver_tpu.campaign.runner import _knob_profile
+
+    os.environ.update(_knob_profile(plan))
+    aot.startup_warmup(context="campaign", print_fn=print)
+
+    run_campaign(plan, out_path=args.out, cache_root=args.cache,
+                 print_fn=print)
+    return 0
+
+
+def _compare_main(argv: List[str]) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m traceweaver_tpu.runtime.cli campaign compare",
+        description="Regression-gate one campaign artifact against a "
+                    "baseline (exit 1 on regression).")
+    p.add_argument("baseline")
+    p.add_argument("candidate")
+    p.add_argument("--tol-pct", type=float, default=None,
+                   help="allowed throughput drop, percent "
+                        "(default TW_CAMPAIGN_TOL_PCT)")
+    p.add_argument("--tol-acc", type=float, default=None,
+                   help="allowed accuracy drop, points "
+                        "(default TW_CAMPAIGN_TOL_ACC)")
+    args = p.parse_args(argv)
+    result = compare_paths(args.baseline, args.candidate,
+                           tol_pct=args.tol_pct, tol_acc=args.tol_acc)
+    print(format_compare(result))
+    return 0 if result["ok"] else 1
+
+
+def _report_main(argv: List[str]) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m traceweaver_tpu.runtime.cli campaign report",
+        description="Render one campaign artifact as a human table.")
+    p.add_argument("artifact")
+    args = p.parse_args(argv)
+    print(format_report(load_artifact(args.artifact)))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``cli campaign <run|compare|report>`` dispatcher. ``compare``
+    and ``report`` are pure host analytics (no JAX backend); ``run``
+    owns its backend bring-up."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in ("run", "compare", "report"):
+        print("usage: cli campaign {run|compare|report} ... "
+              "(docs/CAMPAIGN.md)", file=sys.stderr)
+        return 2
+    sub, rest = argv[0], argv[1:]
+    if sub == "run":
+        return _run_main(rest)
+    if sub == "compare":
+        return _compare_main(rest)
+    return _report_main(rest)

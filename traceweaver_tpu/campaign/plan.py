@@ -1,0 +1,195 @@
+"""Declarative campaign specs: rung ladder x device topology x knobs.
+
+A campaign is the repo's standing heavy-traffic instrument (ROADMAP
+item 1, docs/CAMPAIGN.md): a rung ladder of Alibaba-scale corpora
+(``campaign/corpus.py``) driven data-parallel across a device mesh
+through the compaction-capable fleet path (``campaign/runner.py``),
+with every sustained-throughput / accuracy / byte-ledger number frozen
+into a ``CAMPAIGN_*.json`` artifact (``campaign/ledger.py``) that
+``campaign compare`` diffs against any later run.
+
+The spec is deliberately small and strict: a plan is a JSON object, an
+unknown field is an error (:class:`PlanError`), and every field that
+shapes the measured numbers — seeds, rung sizes, device count, slice
+count, knob profile — is IN the artifact so a compare always knows
+whether it is comparing like with like.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+
+class PlanError(ValueError):
+    """A malformed campaign plan (unknown field, bad topology, ...)."""
+
+
+@dataclass
+class RungSpec:
+    """One rung of the corpus ladder (see ``campaign/corpus.py``).
+
+    ``source`` — ``auto`` (default) uses real preprocessed Alibaba
+    shards when ``/root/reference`` carries them and the synthesizer
+    ladder otherwise; ``synthetic``/``real`` force one.
+    ``gap_ms`` — mean inter-trace arrival gap: the load-intensity knob
+    (small gaps interleave requests; the statistically hard regime).
+    """
+
+    name: str
+    n_graphs: int = 15
+    traces_per_graph: int = 1000
+    gap_ms: int = 2000
+    seed: int = 10
+    n_services: int = 60
+    source: str = "auto"
+
+    def validate(self) -> None:
+        if not self.name or "/" in self.name:
+            raise PlanError(f"rung name {self.name!r} must be a non-empty "
+                            "path-safe token")
+        if self.n_graphs < 1 or self.traces_per_graph < 1:
+            raise PlanError(f"rung {self.name!r}: n_graphs and "
+                            "traces_per_graph must be >= 1")
+        if self.gap_ms < 1:
+            raise PlanError(f"rung {self.name!r}: gap_ms must be >= 1")
+        if self.n_services < 3:
+            raise PlanError(f"rung {self.name!r}: n_services must be >= 3")
+        if self.source not in ("auto", "synthetic", "real"):
+            raise PlanError(f"rung {self.name!r}: source must be "
+                            "auto|synthetic|real")
+
+
+@dataclass
+class CampaignPlan:
+    """The whole campaign: rung ladder x device topology x knob profile.
+
+    ``devices`` — 1-D mesh size for the fleet's sharded dispatch path
+    (0/1 = single device; >= 2 must be a power of two, the
+    ``TW_MESH_DEVICES`` shape constraint).
+    ``slices`` — corpus-level data-parallel tiers exercised through
+    ``parallel/multislice.py``: the rung's solved per-edge delay
+    statistics are sharded per slice and allreduced through the
+    filesystem transport, with the merged corpus-wide statistics
+    asserted identical on every slice.
+    ``knobs`` — TW_* env overrides applied (and recorded) for the run;
+    unknown knob names raise at validation, same rule as
+    ``runtime/knobs.warn_unknown``.
+    ``timed_rounds`` / ``warmup_max`` — None defers to the
+    ``TW_CAMPAIGN_ROUNDS`` / ``TW_CAMPAIGN_WARMUP_MAX`` registry knobs.
+    """
+
+    name: str = "campaign"
+    rungs: List[RungSpec] = field(default_factory=list)
+    devices: int = 0
+    slices: int = 1
+    timed_rounds: Optional[int] = None
+    warmup_max: Optional[int] = None
+    knobs: Dict[str, str] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        from traceweaver_tpu.runtime import knobs as _knobs
+
+        if not self.rungs:
+            raise PlanError("a campaign needs at least one rung")
+        names = [r.name for r in self.rungs]
+        if len(set(names)) != len(names):
+            raise PlanError(f"duplicate rung names: {sorted(names)}")
+        for rung in self.rungs:
+            rung.validate()
+        if self.devices < 0 or (self.devices > 1
+                                and self.devices & (self.devices - 1)):
+            raise PlanError(f"devices={self.devices} must be 0/1 or a "
+                            "power of two (the mesh shape constraint)")
+        if self.slices < 1:
+            raise PlanError(f"slices={self.slices} must be >= 1")
+        if self.timed_rounds is not None and self.timed_rounds < 1:
+            raise PlanError("timed_rounds must be >= 1")
+        if self.warmup_max is not None and self.warmup_max < 1:
+            raise PlanError("warmup_max must be >= 1")
+        for k in self.knobs:
+            if k not in _knobs.REGISTRY:
+                raise PlanError(
+                    f"knob profile names unknown knob {k!r} (declared "
+                    "knobs live in runtime/knobs.py)")
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+
+_RUNG_FIELDS = {f for f in RungSpec.__dataclass_fields__}
+_PLAN_FIELDS = {f for f in CampaignPlan.__dataclass_fields__}
+
+
+def from_dict(raw: Dict) -> CampaignPlan:
+    """Parse + validate a plan dict (the JSON file's object)."""
+    if not isinstance(raw, dict):
+        raise PlanError(f"plan must be a JSON object, got {type(raw).__name__}")
+    unknown = set(raw) - _PLAN_FIELDS
+    if unknown:
+        raise PlanError(f"unknown plan field(s): {sorted(unknown)}")
+    rungs = []
+    for i, r in enumerate(raw.get("rungs") or []):
+        if not isinstance(r, dict):
+            raise PlanError(f"rungs[{i}] must be an object")
+        bad = set(r) - _RUNG_FIELDS
+        if bad:
+            raise PlanError(f"rungs[{i}]: unknown field(s) {sorted(bad)}")
+        rungs.append(RungSpec(**r))
+    plan = CampaignPlan(**{**{k: v for k, v in raw.items() if k != "rungs"},
+                           "rungs": rungs})
+    plan.validate()
+    return plan
+
+
+def load_plan(path: str) -> CampaignPlan:
+    with open(path) as f:
+        try:
+            raw = json.load(f)
+        except json.JSONDecodeError as e:
+            raise PlanError(f"{path}: not valid JSON ({e})") from None
+    return from_dict(raw)
+
+
+def alibaba_ladder(devices: int = 8, slices: int = 2,
+                   seed: int = 10) -> CampaignPlan:
+    """The default Alibaba-scale ladder (the ROADMAP item 1 campaign):
+    100k -> 1M-span rungs at tightening arrival gaps, data-parallel
+    across the visible mesh. The top rung is sized for a v5e-8; on the
+    CPU stand-in run the lower rungs (docs/CAMPAIGN.md runbook)."""
+    return CampaignPlan(
+        name="alibaba-ladder",
+        rungs=[
+            RungSpec("r100k", n_graphs=15, traces_per_graph=1000,
+                     gap_ms=500, seed=seed),
+            RungSpec("r300k", n_graphs=24, traces_per_graph=2000,
+                     gap_ms=200, seed=seed + 1, n_services=120),
+            RungSpec("r1m", n_graphs=40, traces_per_graph=4000,
+                     gap_ms=100, seed=seed + 2, n_services=240),
+        ],
+        devices=devices,
+        slices=slices,
+    )
+
+
+def mini_plan(devices: int = 2, slices: int = 2, seed: int = 7,
+              traces_per_graph: int = 40) -> CampaignPlan:
+    """The 2-rung synthetic mini campaign (tier-1 smoke + bench leg):
+    small enough to run end-to-end under JAX_PLATFORMS=cpu in CI, but
+    through every real stage — synthesize, mesh-sharded fleet solve,
+    multislice allreduce, ledger, artifact."""
+    return CampaignPlan(
+        name="mini",
+        rungs=[
+            RungSpec("mini-a", n_graphs=2, traces_per_graph=traces_per_graph,
+                     gap_ms=800, seed=seed, n_services=12,
+                     source="synthetic"),
+            RungSpec("mini-b", n_graphs=3, traces_per_graph=traces_per_graph,
+                     gap_ms=400, seed=seed + 1, n_services=12,
+                     source="synthetic"),
+        ],
+        devices=devices,
+        slices=slices,
+        timed_rounds=2,
+    )

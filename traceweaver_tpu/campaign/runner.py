@@ -1,0 +1,328 @@
+"""Campaign runner: sustained-throughput drive over the rung ladder.
+
+Per rung (docs/CAMPAIGN.md):
+
+1. **materialize** the corpus (``campaign/corpus.py`` — cached,
+   deterministic, columnar at load) and build every solvable service's
+   FleetItem once;
+2. **warm up**: repeat full-rung fleet solves until a round performs
+   ZERO backend compiles (bounded by ``TW_CAMPAIGN_WARMUP_MAX``) — the
+   same zero-recompile steady-state definition the bench legs use, and
+   with ``TW_AOT`` armed the round that should already be free after
+   ``/readyz`` (the mesh family rides the lattice, runtime/aot.py);
+3. **measure**: ``TW_CAMPAIGN_ROUNDS`` timed rounds through
+   ``solve_fleet`` — data-parallel across the mesh (``devices >= 2``
+   shards every dispatch group's window axis through the
+   compaction-capable mesh path) — freezing sustained spans/s, dispatch
+   latency percentiles, the h2d/d2h byte split, compile counts, and
+   any ``aot_misses`` escapes;
+4. **grade**: exact-match accuracy versus the held-out ground truth
+   (trace-ID join — used for grading only), end-to-end per call graph
+   and per regime bucket;
+5. **allreduce** (``slices >= 2``): the rung's solved per-edge delay
+   statistics shard across slices and merge through
+   ``parallel/multislice.py``'s filesystem transport — the corpus-wide
+   distribution fit of the DCN tier, asserted identical on every slice.
+
+The artifact (``campaign/ledger.py``) is the standing record future
+PRs regression-gate against with ``cli campaign compare``.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from traceweaver_tpu.campaign import corpus as _corpus
+from traceweaver_tpu.campaign import ledger as _ledger
+from traceweaver_tpu.campaign.plan import CampaignPlan
+
+
+def _knob_profile(plan: CampaignPlan) -> Dict[str, str]:
+    """The env overrides a plan applies (and the artifact records):
+    the plan's own knob dict, plus TW_MESH_DEVICES pinned to the plan's
+    topology so the AOT lattice and the mesh path agree on the device
+    count."""
+    profile = {k: str(v) for k, v in plan.knobs.items()}
+    if plan.devices >= 2:
+        profile.setdefault("TW_MESH_DEVICES", str(plan.devices))
+    return profile
+
+
+def _solve_round(items, mesh, stats: Dict):
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+    quarantined: List[int] = []
+    outs = solve_fleet(items, mesh=mesh, stats=stats,
+                       quarantined=quarantined)
+    return outs, quarantined
+
+
+def _grade(problems: List[Dict], outs) -> Dict:
+    """Accuracy vs the held-out ground truth: per-service exact match,
+    span-weighted per regime, and end-to-end per call-graph store
+    (trace counts weight the corpus-wide aggregate)."""
+    from traceweaver_tpu.metrics import (
+        accuracy_end_to_end,
+        accuracy_for_service,
+    )
+
+    by_store: Dict[int, Dict[str, Dict]] = {}
+    regime_n: Dict[str, float] = {}
+    regime_hits: Dict[str, float] = {}
+    svc_worst = (None, 1.0)
+    for meta, out in zip(problems, outs):
+        pred = out[0]
+        acc = accuracy_for_service(pred, meta["true"],
+                                   meta["prob"].in_span_partitions)
+        n_in = len(next(iter(meta["prob"].in_span_partitions.values())))
+        regime = meta["regime"]["regime"]
+        regime_n[regime] = regime_n.get(regime, 0.0) + n_in
+        regime_hits[regime] = regime_hits.get(regime, 0.0) + acc * n_in
+        if svc_worst[0] is None or acc < svc_worst[1]:
+            svc_worst = (meta["svc"], acc)
+        slot = by_store.setdefault(meta["store"], dict(pred={}, true={}))
+        slot["pred"][meta["svc"]] = pred
+        slot["true"][meta["svc"]] = meta["true"]
+    return dict(by_store=by_store, regime_n=regime_n,
+                regime_hits=regime_hits, svc_worst=svc_worst,
+                accuracy_end_to_end=accuracy_end_to_end)
+
+
+def _accuracy_entry(corpus: _corpus.RungCorpus, outs) -> Dict:
+    g = _grade(corpus.problems, outs)
+    e2e_weighted = 0.0
+    traces_total = 0
+    for si, slot in sorted(g["by_store"].items()):
+        store = corpus.stores[si]
+        _, acc = g["accuracy_end_to_end"](
+            slot["pred"], slot["true"], store.in_spans_by_process)
+        n = len(store.all_processes)
+        e2e_weighted += acc * 100.0 * n
+        traces_total += n
+    per_regime = {
+        r: round(g["regime_hits"][r] / g["regime_n"][r], 4)
+        for r in sorted(g["regime_n"])
+    }
+    worst_svc, worst_acc = g["svc_worst"]
+    return dict(
+        e2e_pct=round(e2e_weighted / max(1, traces_total), 3),
+        per_regime=per_regime,
+        worst_service=worst_svc,
+        worst_service_acc=round(worst_acc, 4),
+    )
+
+
+def _multislice_entry(corpus: _corpus.RungCorpus, outs, n_slices: int,
+                      round_id: int) -> Dict:
+    """Exercise the DCN tier (``parallel/multislice.py``) beyond dryrun:
+    shard the rung's SOLVED per-edge delay statistics across slices
+    (the corpus-level partition of real multi-host runs), allreduce
+    them through the filesystem transport, and assert every slice ends
+    with the identical corpus-wide sufficient statistics."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from traceweaver_tpu.parallel.multislice import (
+        allreduce_stats_files,
+        edge_stats_from_samples,
+        partition_problems,
+    )
+
+    def slice_stats(pid: int):
+        samples: Dict = {}
+        for i in partition_problems(len(corpus.problems), n_slices, pid):
+            meta, out = corpus.problems[i], outs[i]
+            prob = meta["prob"]
+            in_spans = next(iter(prob.in_span_partitions.values()))
+            by_id = {s.GetId(): s
+                     for spans in prob.out_span_partitions.values()
+                     for s in spans}
+            for ep, assign in out[0].items():
+                vals = []
+                for in_span in in_spans:
+                    s_out = by_id.get(assign.get(in_span.GetId()))
+                    if s_out is not None:
+                        vals.append(float(s_out.start_mus)
+                                    - float(in_span.start_mus))
+                if vals:
+                    samples[(meta["svc"], ep)] = vals
+        return edge_stats_from_samples(samples)
+
+    locals_ = [slice_stats(pid) for pid in range(n_slices)]
+    with tempfile.TemporaryDirectory(prefix="tw-campaign-rdv-") as rdv:
+        # the allreduce is a BARRIER (each call publishes its shard then
+        # waits for every peer's file), so the in-process slice stand-ins
+        # must run concurrently exactly like real processes would
+        with ThreadPoolExecutor(max_workers=n_slices) as pool:
+            merged = list(pool.map(
+                lambda pid: allreduce_stats_files(
+                    locals_[pid], rdv, pid, n_slices, round_id=round_id),
+                range(n_slices)))
+    agree = all(m == merged[0] for m in merged[1:])
+    return dict(slices=n_slices, transport="files",
+                edges=len(merged[0]), agree=bool(agree))
+
+
+def run_campaign(plan: CampaignPlan, out_path: Optional[str] = None,
+                 cache_root: Optional[str] = None,
+                 print_fn=None) -> Dict:
+    """Run the whole campaign; returns (and optionally writes) the
+    artifact dict. See the module docstring for the per-rung phases."""
+    import jax
+
+    from traceweaver_tpu.runtime import knobs as _knobs
+    from traceweaver_tpu.runtime.jax_cache import (
+        compile_counters,
+        counters_delta,
+    )
+
+    plan.validate()
+    t_run0 = time.perf_counter()
+    cache_root = cache_root or _corpus.default_cache_root(out_path)
+    profile = _knob_profile(plan)
+    saved_env = {k: os.environ.get(k) for k in profile}
+    os.environ.update(profile)
+    mesh = None
+    try:
+        if plan.devices >= 2:
+            from traceweaver_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(plan.devices)
+        rounds = (plan.timed_rounds if plan.timed_rounds is not None
+                  else _knobs.get_int("TW_CAMPAIGN_ROUNDS"))
+        warmup_max = (plan.warmup_max if plan.warmup_max is not None
+                      else _knobs.get_int("TW_CAMPAIGN_WARMUP_MAX"))
+        _ledger.record_start(plan.name, plan.to_dict())
+        if print_fn:
+            print_fn("[campaign] %s: %d rung(s), devices=%d (mesh %s), "
+                     "slices=%d, %d timed round(s)"
+                     % (plan.name, len(plan.rungs), plan.devices,
+                        "on" if mesh is not None else "off", plan.slices,
+                        rounds))
+
+        from traceweaver_tpu.algorithms.fleet import FleetItem
+
+        rung_entries: List[Dict] = []
+        scrape = None
+        scrape_after = (len(plan.rungs) - 1) // 2
+        registry = _ledger._get_registry()
+        for ri, spec in enumerate(plan.rungs):
+            t0 = time.perf_counter()
+            corpus = _corpus.build_rung(spec, cache_root, print_fn=print_fn)
+            items = [FleetItem(m["svc"], m["prob"].in_span_partitions,
+                               m["prob"].out_span_partitions, m["true"],
+                               m["dag"], store=corpus.stores[m["store"]])
+                     for m in corpus.problems]
+            build_s = time.perf_counter() - t0
+
+            # --- warmup: rounds until one compiles nothing ---------------
+            warmup_compiles: List[int] = []
+            for _ in range(warmup_max):
+                before = compile_counters()
+                _solve_round(items, mesh, {})
+                delta = counters_delta(before)
+                warmup_compiles.append(int(delta.get("backend_compiles", 0)))
+                if warmup_compiles[-1] == 0:
+                    break
+            warmup_incomplete = warmup_compiles[-1] != 0
+            if print_fn:
+                print_fn("[campaign] rung %s: warmup %s%s"
+                         % (spec.name, warmup_compiles,
+                            " INCOMPLETE" if warmup_incomplete else ""))
+
+            # --- timed steady state --------------------------------------
+            snap_before = registry.snapshot()
+            counters_before = compile_counters()
+            acc_stats: Dict[str, float] = {}
+            walls: List[float] = []
+            misses: List[str] = []
+            quarantined_total = 0
+            outs = None
+            for _ in range(rounds):
+                stats: Dict = {}
+                t1 = time.perf_counter()
+                outs, quarantined = _solve_round(items, mesh, stats)
+                walls.append(time.perf_counter() - t1)
+                _ledger.merge_stats(acc_stats, stats)
+                misses.extend(stats.get("aot_misses", []))
+                quarantined_total += len(quarantined)
+            steady = counters_delta(counters_before)
+            snap_after = registry.snapshot()
+            spans_per_s = round(corpus.spans / (sum(walls) / len(walls)), 1)
+
+            accuracy = _accuracy_entry(corpus, outs)
+            multislice = (
+                _multislice_entry(corpus, outs, plan.slices, round_id=ri)
+                if plan.slices > 1 else None)
+            dispatch_pct = _ledger.histogram_percentiles(
+                snap_before, snap_after, "tw_dispatch_seconds")
+            entry = dict(
+                rung=spec.name,
+                manifest={k: v for k, v in corpus.manifest.items()
+                          if k != "per_service"},
+                corpus_cached=corpus.cached,
+                build_s=round(build_s, 3),
+                warmup=dict(rounds=len(warmup_compiles),
+                            backend_compiles=warmup_compiles,
+                            incomplete=warmup_incomplete),
+                steady=dict(
+                    rounds=rounds,
+                    round_wall_s=[round(w, 4) for w in walls],
+                    spans_per_s=spans_per_s,
+                    solved_services=len(items),
+                    quarantined=quarantined_total,
+                    backend_compiles=int(steady.get("backend_compiles", 0)),
+                    persistent_cache_hits=int(
+                        steady.get("persistent_cache_hits", 0)),
+                    aot_misses=sorted(set(misses)),
+                    dispatch_seconds=dispatch_pct,
+                    bytes=_ledger.byte_ledger(acc_stats),
+                    fleet=dict(
+                        dispatches=acc_stats.get("fleet_dispatches", 0.0),
+                        compact_windows_total=acc_stats.get(
+                            "compact_windows_total", 0.0),
+                        compact_windows_redispatched=acc_stats.get(
+                            "compact_windows_redispatched", 0.0),
+                        pipeline_groups=acc_stats.get(
+                            "pipeline_groups", 0.0),
+                    ),
+                ),
+                accuracy=accuracy,
+                multislice=multislice,
+            )
+            rung_entries.append(entry)
+            _ledger.record_rung(plan.name, spec.name, spans_per_s,
+                                accuracy["e2e_pct"],
+                                entry["steady"]["backend_compiles"],
+                                len(entry["steady"]["aot_misses"]))
+            if print_fn:
+                print_fn("[campaign] rung %s: %.0f spans/s sustained "
+                         "(%d rounds), e2e %.2f%%, steady compiles %d, "
+                         "aot misses %d"
+                         % (spec.name, spans_per_s, rounds,
+                            accuracy["e2e_pct"],
+                            entry["steady"]["backend_compiles"],
+                            len(entry["steady"]["aot_misses"])))
+            if ri == scrape_after:
+                # the mid-run /metrics scrape: captured BETWEEN rungs so
+                # it reflects live counters, not a drained end state
+                scrape = _ledger.scrape_snapshot()
+
+        artifact = _ledger.make_artifact(
+            plan.name, dict(plan.to_dict(), applied_knobs=profile),
+            jax.default_backend(), len(jax.devices()),
+            rung_entries, scrape, time.perf_counter() - t_run0)
+        if out_path:
+            _ledger.write_artifact(out_path, artifact)
+        _ledger.record_finish(plan.name, artifact["wall_s"], out_path)
+        if print_fn and out_path:
+            print_fn(f"[campaign] artifact -> {out_path}")
+        return artifact
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

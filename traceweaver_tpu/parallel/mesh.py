@@ -85,6 +85,22 @@ def bucket_rows_per_shard(n_rows: int, n_shards: int) -> int:
     return pow2_bucket(per_shard) * n_shards
 
 
+def coalesce_to_device0(arr, mesh: Mesh):
+    """Gather a mesh-sharded array onto the mesh's first device.
+
+    The compaction flag fetch reads a ``[B]`` bool array the solve left
+    sharded over the mesh; pulling it straight to host costs one D2H
+    round trip PER SHARD (each ~100 ms through the sandbox's remote
+    tunnel — N round trips to learn B bytes). Re-placing it on one
+    device first turns the fan-in into a device-side gather over ICI,
+    so the host pays exactly ONE ledgered transfer per dispatch group
+    (``fleet._fetch_flags`` bills it under ``d2h_bytes_flags`` like the
+    single-device path)."""
+    from jax.sharding import SingleDeviceSharding
+
+    return jax.device_put(arr, SingleDeviceSharding(mesh.devices.flat[0]))
+
+
 def _pad_batch(arrays: Dict[str, np.ndarray], multiple: int) -> Tuple[Dict[str, np.ndarray], int]:
     b = arrays["in_start"].shape[0]
     pad = (-b) % multiple

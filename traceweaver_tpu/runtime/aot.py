@@ -52,10 +52,18 @@ map ``Bmax`` in pow2 spanning [B/P, B]; neighbour-degree statics
 ``max_preds``/``max_succs`` enumerate pow2 <= min(E, D). Static
 hypers (epsilon / n_sinkhorn / n_sweeps / sinkhorn_tol) are the
 serving defaults of ``fleet.solve_fleet``, with the compaction warm
-sweep count (``TW_SWEEP_WARM``) as a second n_sweeps point. The mesh
-path is out of scope (multi-chip dispatches re-place sharded arrays
-per shard — a different program family); its shapes surface in the
-miss ledger like any other escape.
+sweep count (``TW_SWEEP_WARM``) as a second n_sweeps point.
+
+The mesh (multi-chip) family rides the lattice too when a mesh is
+configured (``TW_MESH_DEVICES >= 2``): sharded dispatches are distinct
+programs (the committed NamedSharding is part of the jit aval), and the
+fleet pads mesh batch axes to pow2-rows-per-shard
+(``mesh.bucket_rows_per_shard``), so the family enumerates b*n_mesh row
+counts for per-shard b inside the horizon, with dummies placed exactly
+as ``fleet._dispatch_packed`` places the real batch. A campaign's
+warmup phase (``traceweaver_tpu/campaign``) therefore compiles nothing
+after ``/readyz`` flips even on a multi-device run; unconfigured-mesh
+escapes still land in the miss ledger with an ``xNdev`` marker.
 """
 
 from __future__ import annotations
@@ -163,11 +171,15 @@ def _fleet_key(entry: str, B: int, E: int, W: int, M: int, P: Optional[int],
                bmax: Optional[int], mp: int, ms: int, n_sweeps: int,
                epsilon: float, n_sinkhorn: int, sinkhorn_tol: float,
                precision: str, pallas: bool,
-               confidence: Optional[bool]) -> Tuple:
+               confidence: Optional[bool], shards: int = 1) -> Tuple:
+    # shards rides LAST so the historical 17-element prefix (and every
+    # index the tests pin) is unchanged; a sharded dispatch is a distinct
+    # compiled program (committed NamedSharding is part of the aval), so
+    # it must be a distinct lattice key
     return ("fleet", entry, B, E, W, M, P, bmax, mp, ms, n_sweeps,
             float(epsilon), int(n_sinkhorn), float(sinkhorn_tol),
             precision, bool(pallas),
-            None if confidence is None else bool(confidence))
+            None if confidence is None else bool(confidence), int(shards))
 
 
 def _assemble_key(cap: int, B: int, E: int, W: int, M: int) -> Tuple:
@@ -192,10 +204,10 @@ def _key_str(key: Tuple) -> str:
         return f"ring_append[cap={key[1]},len={key[2]}]"
     if key[0] == "gmm":
         return f"fit_gmm[e={key[1]},n={key[2]}]"
-    if key[0] != "fleet" or len(key) != 17:
+    if key[0] != "fleet" or len(key) != 18:
         return repr(key)  # unknown kind (test stubs): degrade readably
     (_, entry, B, E, W, M, P, bmax, mp, ms, n_sweeps,
-     _eps, _sink, _tol, precision, _pal, conf) = key
+     _eps, _sink, _tol, precision, _pal, conf, shards) = key
     bits = [f"B={B}", f"E={E}", f"W={W}", f"M={M}"]
     if P is not None:
         bits.append(f"P={P}")
@@ -206,6 +218,8 @@ def _key_str(key: Tuple) -> str:
         bits.append(precision)
     if conf:
         bits.append("conf")
+    if shards > 1:
+        bits.append(f"x{shards}dev")
     return f"{entry}[{','.join(bits)}]"
 
 
@@ -312,6 +326,15 @@ def _plan(tier: str, horizon: Dict[str, int],
         return time.perf_counter() - t0
 
     variants: List[_Variant] = []
+    _planned_keys = set()
+
+    def push(v: _Variant) -> None:
+        # one compile per key: the mesh family's refit range overlaps the
+        # single-device enumeration, and a duplicate variant would burn a
+        # (cache-hit) compile plus double-count the progress ledger
+        if v.key not in _planned_keys:
+            _planned_keys.add(v.key)
+            variants.append(v)
 
     def add_fleet(entry_name, fn, B, E, W, M, P, bmax, mp, ms, n_sweeps,
                   with_rows):
@@ -328,7 +351,7 @@ def _plan(tier: str, horizon: Dict[str, int],
                          np.zeros((P, bmax), bool))
             return args + tables_np(P, E)
 
-        variants.append(_Variant(
+        push(_Variant(
             key, lambda: compile_and_seed(fn, make_args, kw)))
 
     def add_refit(B, E, W, M, P, bmax):
@@ -344,7 +367,7 @@ def _plan(tier: str, horizon: Dict[str, int],
                                             np.zeros((P, bmax), bool))
                     + tab[:2] + tab[3:])  # no is_last in the refit
 
-        variants.append(_Variant(
+        push(_Variant(
             key, lambda: compile_and_seed(_wt.refit_fleet_params,
                                           make_args)))
 
@@ -358,7 +381,7 @@ def _plan(tier: str, horizon: Dict[str, int],
             return (batch_np(B, E, W, M)
                     + tuple(a[0] for a in tables_np(1, E)))
 
-        variants.append(_Variant(
+        push(_Variant(
             key, lambda: compile_and_seed(fn, make_args, kw)))
 
     geoms = [(B, E, W, M)
@@ -387,8 +410,8 @@ def _plan(tier: str, horizon: Dict[str, int],
                 return lambda: compile_and_seed(_devcols.assemble_windows,
                                                 make_args)
 
-            variants.append(_Variant(_assemble_key(cap, B, E, W, M),
-                                     make_assemble()))
+            push(_Variant(_assemble_key(cap, B, E, W, M),
+                          make_assemble()))
         for mp, ms in degs:
             for n_sweeps in sweep_points:
                 if n_sweeps != full_sweeps and B < 2:
@@ -425,6 +448,114 @@ def _plan(tier: str, horizon: Dict[str, int],
                 for bmax in _pow2_range(pow2_bucket(max(1, -(-B // P))), B):
                     add_refit(B, E, W, M, P, bmax)
 
+    # --- the mesh (multi-chip) program family ----------------------------
+    # A sharded dispatch is a DISTINCT compiled program: the committed
+    # NamedSharding is part of the jit aval, so a host-fed variant can
+    # never seed the sharded one. The family is finite because the fleet
+    # pads every mesh batch axis with bucket_rows_per_shard — pow2 rows
+    # PER SHARD times the mesh size (algorithms/fleet.py) — so the B
+    # axis enumerates b*n_mesh for per-shard b inside the horizon.
+    # Enumerated only when a mesh is configured (TW_MESH_DEVICES >= 2)
+    # and assemblable on this backend; otherwise the family surfaces in
+    # the miss ledger (shape strings carry an ``xNdev`` marker).
+    n_mesh = _knobs.get_int("TW_MESH_DEVICES")
+    mesh_builder = None
+    if n_mesh >= 2:
+        try:
+            from traceweaver_tpu.parallel.mesh import make_mesh, put_sharded
+
+            make_mesh(n_mesh)
+            mesh_builder = make_mesh
+        except RuntimeError:
+            mesh_builder = None  # too few devices: counted, not compiled
+    if mesh_builder is not None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        batch_names = ("in_start", "in_end", "in_valid", "out_start",
+                       "out_end", "out_valid", "skip_cap", "force_skip")
+
+        def sharded_args(B, E, W, M, P, bmax, with_rows):
+            # dummies placed EXACTLY as fleet._dispatch_packed places the
+            # real batch: window tensors + param_idx sharded over the
+            # mesh axis, tables and refit row maps replicated — the
+            # committed shardings are what key the executable cache
+            mesh = mesh_builder(n_mesh)
+            placed = put_sharded(
+                dict(zip(batch_names, batch_np(B, E, W, M))), mesh)
+            rep = NamedSharding(mesh, PartitionSpec())
+            pidx = jax.device_put(
+                np.zeros((B,), np.int32),
+                NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])))
+            args = tuple(placed[k] for k in batch_names) + (pidx,)
+            if with_rows:
+                args += (jax.device_put(np.zeros((P, bmax), np.int32), rep),
+                         jax.device_put(np.zeros((P, bmax), bool), rep))
+            return args + tuple(jax.device_put(t, rep)
+                                for t in tables_np(P, E))
+
+        def add_mesh_fleet(entry_name, fn, B, E, W, M, P, bmax, mp, ms,
+                           n_sweeps, with_rows):
+            key = _fleet_key(entry_name, B, E, W, M, P, bmax, mp, ms,
+                             n_sweeps, hyp["epsilon"], hyp["n_sinkhorn"],
+                             hyp["sinkhorn_tol"], precision, True,
+                             confidence, shards=n_mesh)
+            kw = dict(statics, n_sweeps=n_sweeps, max_preds=mp,
+                      max_succs=ms, confidence=confidence)
+            push(_Variant(key, lambda: compile_and_seed(
+                fn,
+                lambda: sharded_args(B, E, W, M, P, bmax or 1, with_rows),
+                kw)))
+
+        for b in _pow2_range(1, horizon["B"]):
+            B = b * n_mesh
+            for E in _pow2_range(1, horizon["E"]):
+                degs = [(mp, ms)
+                        for mp in _pow2_range(1, min(E, horizon["D"]))
+                        for ms in _pow2_range(1, min(E, horizon["D"]))]
+                ps = _pow2_range(1, min(B, MAX_LATTICE_P))
+                for W in _pow2_range(8, horizon["W"]):
+                    for M in _pow2_range(8, horizon["M"]):
+                        for mp, ms in degs:
+                            for n_sweeps in sweep_points:
+                                for P in ps:
+                                    add_mesh_fleet(
+                                        "solve_windows_fleet",
+                                        _wt.solve_windows_fleet,
+                                        B, E, W, M, P, None, mp, ms,
+                                        n_sweeps, with_rows=False)
+                            if tier in ("serve", "full"):
+                                if compaction:
+                                    # a mesh group reaches solve_em_fleet
+                                    # only at raw n_rows == 1 (padded to
+                                    # one row per shard): P=1, bmax=1
+                                    if b == 1:
+                                        add_mesh_fleet(
+                                            "solve_em_fleet",
+                                            _wt.solve_em_fleet,
+                                            B, E, W, M, 1, 1, mp, ms,
+                                            full_sweeps, with_rows=True)
+                                else:
+                                    for P in ps:
+                                        for bmax in _pow2_range(1, B):
+                                            add_mesh_fleet(
+                                                "solve_em_fleet",
+                                                _wt.solve_em_fleet,
+                                                B, E, W, M, P, bmax, mp,
+                                                ms, full_sweeps,
+                                                with_rows=True)
+                        if tier in ("serve", "full") and compaction:
+                            # mesh-origin standalone refits run on HOST
+                            # arrays (shards=1 programs — fleet notes
+                            # them so) at the padded mesh row counts;
+                            # raw bmax can sit well under B/P because
+                            # mesh padding rows belong to no service,
+                            # so the bmax floor widens to ~b/P
+                            for P in ps:
+                                lo = pow2_bucket(max(1, b // P))
+                                for bmax in _pow2_range(lo, B):
+                                    add_refit(B, E, W, M, P, bmax)
+
     if use_devcols:
         # ring appends: one tiny dynamic-update-slice program per
         # (capacity, pow2 chunk length) — enumerate to the largest slot
@@ -447,8 +578,7 @@ def _plan(tier: str, horizon: Dict[str, int],
         max_len = min(cap, max(horizon["B"] * horizon["W"],
                                horizon["B"] * horizon["E"] * horizon["M"]))
         for length in _pow2_range(1, max_len):
-            variants.append(_Variant(_ring_key(cap, length),
-                                     make_ring(length)))
+            push(_Variant(_ring_key(cap, length), make_ring(length)))
     # the host-side warm-state GMM refresh (stream/service.py ->
     # timing.fit_edge_gmms -> ops/gmm._fit_gmm_z) runs in EVERY tier's
     # steady state, so its family rides every tier: e = pow2 edge rows
@@ -464,7 +594,7 @@ def _plan(tier: str, horizon: Dict[str, int],
 
     for e in _pow2_range(1, 2 * horizon["E"]):
         for n in _pow2_range(4, horizon["B"] * horizon["W"]):
-            variants.append(_Variant(_gmm_key(e, n), make_gmm(e, n)))
+            push(_Variant(_gmm_key(e, n), make_gmm(e, n)))
     return variants
 
 
@@ -664,10 +794,12 @@ def _record_miss(key: Tuple) -> Optional[str]:
 
 
 def note_fleet(entry: str, common, tables, n_sweeps: int,
-               hypers: Dict, window_rows=None) -> Optional[str]:
+               hypers: Dict, window_rows=None, mesh=None) -> Optional[str]:
     """Miss check for one fleet dispatch: ``common`` is the 9-tuple the
     entry receives (8 window tensors + param_idx), ``tables`` the
-    stacked param tuple, ``hypers`` the static-arg dict. Returns the
+    stacked param tuple, ``hypers`` the static-arg dict. ``mesh`` marks
+    a sharded dispatch — a distinct program family keyed by its shard
+    count (and rendered ``...,xNdev]`` in the miss ledger). Returns the
     escaped shape string (for the caller's per-solve ``aot_misses``
     ledger) or None on a lattice hit. No-op until a warmup arms."""
     if not _ARMED:
@@ -676,6 +808,7 @@ def note_fleet(entry: str, common, tables, n_sweeps: int,
     E, M = common[3].shape[1], common[3].shape[2]
     P = tables[0].shape[0]
     bmax = None if window_rows is None else window_rows.shape[1]
+    shards = int(mesh.devices.size) if mesh is not None else 1
     key = _fleet_key(entry, B, E, W, M, P, bmax,
                      hypers.get("max_preds", 0), hypers.get("max_succs", 0),
                      n_sweeps, hypers.get("epsilon", 1.0),
@@ -683,7 +816,7 @@ def note_fleet(entry: str, common, tables, n_sweeps: int,
                      hypers.get("sinkhorn_tol", 0.0),
                      hypers.get("precision", "f32"),
                      hypers.get("pallas", True),
-                     hypers.get("confidence", False))
+                     hypers.get("confidence", False), shards=shards)
     return _record_miss(key)
 
 

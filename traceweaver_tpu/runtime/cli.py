@@ -427,6 +427,14 @@ def main(argv=None) -> int:
         from traceweaver_tpu.obs.events import tail_main
 
         return tail_main(argv[1:])
+    if argv and argv[0] == "campaign":
+        # Alibaba-scale sustained-throughput campaign harness
+        # (docs/CAMPAIGN.md): run | compare | report. compare/report are
+        # pure host analytics; run owns its backend bring-up (it must
+        # set XLA's virtual-device flags BEFORE jax imports)
+        from traceweaver_tpu.campaign import main as campaign_main
+
+        return campaign_main(argv[1:])
     if argv and argv[0] == "query":
         # offline delay-culprit query (the paper's marquee use case,
         # docs/SERVING.md): no JAX backend needed — pure host analytics

@@ -242,6 +242,24 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        help="per-metric label-cardinality cap: past it, new label-value "
             "sets collapse into one counted overflow=\"1\" series "
             "instead of growing the registry unbounded"),
+    # --- campaign harness (traceweaver_tpu/campaign, docs/CAMPAIGN.md) ---
+    _k("TW_CAMPAIGN_ROUNDS", "int", 3, lo=1, hi=100,
+       help="timed steady-state rounds per campaign rung (after warmup "
+            "reaches zero backend compiles)"),
+    _k("TW_CAMPAIGN_WARMUP_MAX", "int", 5, lo=1, hi=50,
+       help="warmup-round cap per rung: rounds repeat until one costs "
+            "zero backend compiles or this bound is hit (recorded as "
+            "warmup_incomplete)"),
+    _k("TW_CAMPAIGN_CACHE", "str", None,
+       help="corpus-ladder cache root (default: .campaign_corpus next "
+            "to the artifact); rungs are keyed by spec+seed and reused "
+            "across runs"),
+    _k("TW_CAMPAIGN_TOL_PCT", "float", 10.0, lo=0.0,
+       help="campaign compare: allowed per-rung sustained-throughput "
+            "drop (percent) before a regression is flagged"),
+    _k("TW_CAMPAIGN_TOL_ACC", "float", 1.0, lo=0.0,
+       help="campaign compare: allowed per-rung end-to-end accuracy "
+            "drop (percentage points) before a regression is flagged"),
     # --- bench orchestration ---------------------------------------------
     _k("TW_BENCH_SUBSET", "int", 25, lo=1, help="subset spans per service"),
     _k("TW_BENCH_EXACT_ALARM", "int", 95, lo=1,
