@@ -1777,6 +1777,48 @@ def run_campaign_leg(traces_per_graph: int) -> dict:
     return report
 
 
+def run_fleet_wire_leg(seconds: float) -> dict:
+    """bench.py --fleet-wire S: the replica-fleet wire campaign —
+    closed-loop heavy-tailed generators POST Jaeger-JSON through the
+    consistent-hash router to 1 then 2 in-process replicas (real HTTP
+    servers on real sockets), with a live hot-tenant migration in the
+    2-replica chaos phase; reports per-rung accepted spans/s, the
+    zero-loss conservation proof, and a self-compare through the
+    regression gate. Subprocess-replica fleets (rolling restarts
+    included) run via `cli fleet campaign --mode subprocess`
+    (docs/CAMPAIGN.md "Wire-level fleet campaign")."""
+    import jax
+
+    if _knobs.get("TW_BACKEND") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import tempfile
+
+    from traceweaver_tpu.campaign import compare_artifacts
+    from traceweaver_tpu.fleet_serve.campaign import run_fleet_campaign
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="tw-bench-fleet-") as tmp:
+        artifact = run_fleet_campaign(
+            state_root=tmp, replica_counts=(1, 2), seconds=seconds,
+            mode="inproc", verbose=True)
+    self_cmp = compare_artifacts(artifact, artifact)
+    rungs = artifact["rungs"]
+    report = dict(
+        mode="fleet-wire",
+        fleet_wall_s=round(time.perf_counter() - t0, 2),
+        fleet_compare_self_ok=bool(self_cmp["ok"]),
+        fleet_migrations=sum(r["fleet"]["migrations"] for r in rungs),
+        fleet_generator_429s=sum(
+            r["fleet"]["generator_429s"] for r in rungs),
+        fleet_zero_loss=all(r["fleet"]["zero_loss"] for r in rungs),
+        **campaign_fields(artifact))
+    log("fleet-wire leg: %s spans/s per rung; migrations %d, "
+        "zero-loss %s, self-compare ok=%s"
+        % (report["campaign_spans_per_s"], report["fleet_migrations"],
+           report["fleet_zero_loss"], report["fleet_compare_self_ok"]))
+    return report
+
+
 def telemetry_fields(stage_stats: dict, snap_before: dict,
                      snap_after: dict) -> dict:
     """Obs-registry agreement proof -> report fields (unit-tested like
@@ -2706,6 +2748,15 @@ if __name__ == "__main__":
                          "multislice allreduce, and a self-compare "
                          "through the regression gate; N = traces per "
                          "call graph (docs/CAMPAIGN.md)")
+    ap.add_argument("--fleet-wire", type=float, nargs="?", const=6.0,
+                    default=None, metavar="S",
+                    help="standalone replica-fleet wire leg: closed-loop "
+                         "generators POST through the consistent-hash "
+                         "router to 1 then 2 in-process HTTP replicas, "
+                         "live hot-tenant migration in the 2-replica "
+                         "chaos phase, zero-loss gate, self-compare "
+                         "through the regression gate; S = steady-phase "
+                         "drive seconds per rung (docs/CAMPAIGN.md)")
     ap.add_argument("--scorecard", type=int, nargs="?", const=48,
                     default=None, metavar="N",
                     help="standalone per-regime scorecard leg: all five "
@@ -2773,6 +2824,14 @@ if __name__ == "__main__":
     if args.campaign:
         campaign_report = run_campaign_leg(args.campaign)
         line = json.dumps(campaign_report)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        sys.exit(0)
+    if args.fleet_wire:
+        fleet_report = run_fleet_wire_leg(args.fleet_wire)
+        line = json.dumps(fleet_report)
         if args.out:
             with open(args.out, "w") as f:
                 f.write(line + "\n")

@@ -641,6 +641,80 @@ def test_campaign_smoke_mini_ladder_end_to_end(tmp_path):
         {"accuracy_e2e_pct"}
 
 
+@pytest.mark.fleet
+def test_fleet_smoke_migrate_and_rolling_restart_zero_loss(tmp_path):
+    """Tier-1 fleet smoke: TWO real serve subprocesses behind the
+    consistent-hash router — POST windows for three tenants through the
+    router, LIVE-MIGRATE one tenant to the other replica, roll-restart
+    the whole fleet one replica at a time, keep posting, and assert the
+    conservation ledger balances: every ingested trace emitted exactly
+    once, zero drops, across migration and both restarts."""
+    from traceweaver_tpu.fleet_serve.campaign import (
+        _aggregate,
+        _flush_fleet,
+        _settle,
+        fleet_payload,
+    )
+    from traceweaver_tpu.fleet_serve.manager import (
+        FleetManager,
+        ReplicaProcess,
+    )
+    from traceweaver_tpu.fleet_serve.router import http_json
+
+    tenants = ["smoke-x", "smoke-y", "smoke-z"]
+    replicas = [ReplicaProcess(
+        name, str(tmp_path / "fleet" / name), serve_args=["--fix", "2"])
+        for name in ("r0", "r1")]
+    for rep in replicas:
+        rep.start()
+    fleet = FleetManager(replicas, router_port=0)
+    try:
+        def post(tenant, seq):
+            status, out = http_json(
+                "POST",
+                f"{fleet.base_url}/api/v1/tenants/{tenant}/spans",
+                fleet_payload(tenant, seq, n_traces=4), timeout=120)
+            assert status == 200, (status, out)
+
+        for seq in range(2):
+            for tid in tenants:
+                post(tid, seq)
+
+        # live migration: move one tenant onto the OTHER replica while
+        # its first windows are still in flight
+        mover = tenants[0]
+        src = fleet.router.owner(mover)
+        dst = next(n for n in sorted(fleet.router.replicas) if n != src)
+        fleet.migrate(mover, dst)
+        assert mover in fleet.replica_tenants(dst)
+        assert mover not in fleet.replica_tenants(src)
+        post(mover, 2)  # router must follow the pin to the new owner
+
+        # rolling restart: each replica drains its tenants to the
+        # survivor, restarts with --resume, and rejoins on /readyz 200
+        report = fleet.rolling_restart()
+        assert set(report) == {"r0", "r1"}
+        for rep in replicas:
+            assert rep.alive and rep.restarts == 1
+
+        # the fleet must still be INGESTING after the rotation
+        for tid in tenants:
+            post(tid, 3)
+
+        _flush_fleet(fleet, n=2)
+        agg = _settle(fleet)
+        assert agg["ingested_traces"] == len(tenants) * 3 * 4 + 4
+        assert agg["traces_emitted"] == agg["ingested_traces"], agg
+        assert agg["shed_dropped_windows"] == 0
+        assert agg["deadletter_windows"] == 0
+        assert agg["late_dropped"] == 0 and agg["backlog"] == 0
+        counters = agg["router"]["counters"]
+        assert counters["restarts"] == 2
+        assert _aggregate(fleet)["router"]["counters"] is not None
+    finally:
+        fleet.stop()
+
+
 @pytest.mark.adapt
 def test_adapt_smoke_inert_off_and_compile_free_steady_state(
         monkeypatch, tmp_path):

@@ -1,0 +1,329 @@
+"""Replica fleet tier tests (tier-1, CPU): router, migration, campaign.
+
+Contracts covered (ISSUE 16):
+
+- consistent hash ring: process-stable (two ring instances agree),
+  complete preference orders, bounded remap when a replica joins;
+- circuit breaker: consecutive-failure open, cooldown close, success
+  reset;
+- router retry-on-next-replica: a POST whose ring owner is dead lands
+  on the next replica in preference order, gets pinned there, and the
+  dead replica's breaker records the failure;
+- LIVE tenant migration conservation: a tenant killed mid-stream on
+  replica A (open windows, half its traces in flight) resumes on
+  replica B and the final sink is byte-identical to the unmigrated
+  single-replica run — zero lost, zero duplicated windows; the source
+  answers 410 afterwards (and still does after a restart+resume);
+- the checkpoint-transfer surface: CRC verification refuses torn bytes
+  at both ends;
+- every TW_FLEET_* knob is typed + ranged in the registry;
+- the in-process wire campaign emits a ledger-compatible artifact that
+  `campaign compare` passes against itself, with the zero-loss gate on
+  every rung.
+
+All tests here run the REAL wire path (ThreadingHTTPServer end to end)
+with in-process replicas; the subprocess fleet smoke (2 replica
+processes + router + migration + rolling restart) lives in
+test_bench_smoke.py.
+"""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+import traceweaver_tpu.runtime  # noqa: F401  — breaks the serve import cycle
+from traceweaver_tpu.serve import ServeConfig, TenancyError, TenantService
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.fleet
+
+from tests.test_serve import _run_single_tenant, hotel_payload  # noqa: E402
+
+
+def _cfg(**kw):
+    base = dict(fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+                verbose=False, pump_windows=10**9)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _http(method, url, payload=None, timeout=120):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers or {}), json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# hash ring + breaker
+# ---------------------------------------------------------------------------
+
+def test_hash_ring_stable_complete_and_bounded_remap():
+    from traceweaver_tpu.fleet_serve.router import HashRing
+
+    names = ["r0", "r1", "r2"]
+    a = HashRing(names, vnodes=64)
+    b = HashRing(list(reversed(names)), vnodes=64)
+    keys = [f"tenant-{i}" for i in range(300)]
+    for k in keys:
+        # process-stable and construction-order independent: two rings
+        # over the same replica set agree on every preference order
+        assert a.preference(k) == b.preference(k)
+        assert sorted(a.preference(k)) == names  # complete failover order
+        assert a.lookup(k) == a.preference(k)[0]
+    # every replica owns a nontrivial share of the tenant space
+    owners = {n: sum(1 for k in keys if a.lookup(k) == n) for n in names}
+    assert all(v > len(keys) * 0.1 for v in owners.values()), owners
+    # consistent hashing's point: a new replica remaps a bounded slice
+    # of the tenant space, and every move lands ON the new replica
+    grown = HashRing(names + ["r3"], vnodes=64)
+    moved = [k for k in keys if grown.lookup(k) != a.lookup(k)]
+    assert 0 < len(moved) < len(keys) * 0.5, f"{len(moved)} remapped"
+    assert all(grown.lookup(k) == "r3" for k in moved)
+
+
+def test_circuit_breaker_open_cooldown_reset():
+    from traceweaver_tpu.fleet_serve.router import CircuitBreaker
+
+    cb = CircuitBreaker(fail_max=3, cooldown_s=0.15)
+    cb.record(False)
+    cb.record(False)
+    assert not cb.open  # under the threshold
+    cb.record(False)
+    assert cb.open and cb.opened == 1
+    time.sleep(0.2)
+    assert not cb.open  # cooldown elapsed: half-open, probes may flow
+    cb.record(True)
+    assert cb.fails == 0 and not cb.open  # success resets the streak
+    cb.record(False)
+    assert not cb.open  # one failure after reset is under the threshold
+
+
+# ---------------------------------------------------------------------------
+# router proxy: retry-on-next-replica, pins, health surface
+# ---------------------------------------------------------------------------
+
+def test_router_retries_dead_replica_and_pins_fallback(tmp_path):
+    from traceweaver_tpu.fleet_serve.manager import InProcReplica
+    from traceweaver_tpu.fleet_serve.router import FleetRouter, HashRing
+
+    live = InProcReplica("live", _cfg(state_dir=str(tmp_path / "live")))
+    # a replica that answers nothing: a bound-then-closed ephemeral port
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    router = FleetRouter(
+        {"dead": f"http://127.0.0.1:{dead_port}",
+         "live": live.base_url}, port=0).start()
+    try:
+        # pick a tenant the RING assigns to the dead replica, so the 200
+        # can only come from a counted retry onto the next preference
+        ring = HashRing(["dead", "live"])
+        tenant = next(f"t{i}" for i in range(200)
+                      if ring.lookup(f"t{i}") == "dead")
+        code, _, out = _http(
+            "POST", f"{router.base_url}/api/v1/tenants/{tenant}/spans",
+            hotel_payload(n_traces=6, prefix="rt"))
+        assert code == 200 and out["ingested_traces"] == 6, out
+        assert router.counters["retried"] >= 1
+        assert router.counters["rerouted"] >= 1
+        # the failover is sticky: the tenant is pinned to the live
+        # replica so its stream stays on ONE replica
+        assert router.pins[tenant] == "live"
+        assert router.replicas["dead"].breaker.fails >= 1
+        # health/ready surface: the fleet is ready while >=1 routable
+        code, _, out = _http("GET", router.base_url + "/readyz")
+        assert code == 200 and out["ready"] is True
+        code, _, out = _http("GET", router.base_url + "/healthz")
+        assert code == 200
+        assert {r["name"] for r in out["replicas"]} == {"dead", "live"}
+    finally:
+        router.stop()
+        live.stop()
+
+
+# ---------------------------------------------------------------------------
+# live migration: conservation, byte identity, tombstones
+# ---------------------------------------------------------------------------
+
+def test_live_migration_mid_stream_byte_identical(tmp_path):
+    """The tentpole conservation proof: kill a tenant mid-stream on
+    replica A (half its traces posted, window still OPEN), resume on
+    replica B, post the second half there; B's final sink must be
+    byte-identical to the unmigrated single-replica run — zero lost,
+    zero duplicated windows."""
+    from traceweaver_tpu.fleet_serve.manager import (
+        FleetManager,
+        InProcReplica,
+    )
+
+    # both halves land in the SAME event-time window: the open window
+    # itself rides the migration checkpoint
+    pay1 = hotel_payload(n_traces=12, prefix="m")
+    pay2 = hotel_payload(n_traces=12, prefix="n", base_us=9_000_000.0)
+    both = {"data": pay1["data"] + pay2["data"]}
+    base_bytes, _ = _run_single_tenant(tmp_path, "mig", both)
+
+    reps = [InProcReplica(f"r{i}", _cfg(state_dir=str(tmp_path / f"fr{i}")))
+            for i in range(2)]
+    fleet = FleetManager(reps, router_port=0)
+    try:
+        url = fleet.base_url
+        code, _, out = _http("POST", url + "/api/v1/tenants/mig/spans",
+                             pay1)
+        assert code == 200 and out["ingested_traces"] == 12
+        src = fleet.router.owner("mig")
+        dst = "r1" if src == "r0" else "r0"
+        res = fleet.migrate("mig", dst)
+        assert res["src"] == src and res["dst"] == dst
+        assert fleet.router.counters["migrations"] == 1
+        # second half goes through the router to the NEW home (pin)
+        code, _, out = _http("POST", url + "/api/v1/tenants/mig/spans",
+                             pay2)
+        assert code == 200 and out["ingested_traces"] == 12
+        # the old home answers 410 (tombstone), never a forked twin
+        old = fleet.router.replicas[src].base_url
+        code, _, out = _http("POST", old + "/api/v1/tenants/mig/spans",
+                             pay2)
+        assert code == 410 and "migrated out" in out["error"]
+        code, _, _ = _http("POST", url + "/api/v1/flush")
+        assert code == 200
+        dst_rep = next(r for r in reps if r.name == dst)
+        dst_rep.service.flush()
+        # per-tenant conservation on the destination
+        st = dst_rep.service.stats("mig")
+        assert st["counters"]["ingested_traces"] == 24
+        assert st["traces_emitted"] == 24
+        assert st["shed_dropped_windows"] == 0
+        assert st["deadletter_windows"] == 0
+    finally:
+        fleet.stop()
+    with open(tmp_path / f"fr{int(dst[1:])}" / "mig" / "traces.jsonl",
+              "rb") as f:
+        fleet_bytes = f.read()
+    assert fleet_bytes == base_bytes
+
+
+def test_migration_tombstone_survives_resume(tmp_path):
+    """A migrated-out tenant must keep answering "migrated out" on the
+    source even after the source restarts with --resume: the durable
+    tombstone marker re-tombstones it instead of resurrecting a forked
+    twin from leftover files."""
+    cfg_a = _cfg(state_dir=str(tmp_path / "a"))
+    cfg_b = _cfg(state_dir=str(tmp_path / "b"))
+    a, b = TenantService(cfg_a), TenantService(cfg_b)
+    a.ingest("ten", hotel_payload(n_traces=8, prefix="x"))
+    transfer = a.migrate_out("ten")
+    b.migrate_in("ten", transfer)
+    with pytest.raises(TenancyError, match="migrated out"):
+        a.tenant("ten")
+    a.drain()
+    # restart replica A from its state dir: the tombstone must survive
+    a2 = TenantService.resume(cfg_a)
+    assert "ten" in a2.migrated_out
+    assert "ten" not in a2.tenants  # NOT resurrected
+    with pytest.raises(TenancyError, match="migrated out"):
+        a2.tenant("ten")
+    b.flush()
+    assert b.stats("ten")["traces_emitted"] == 8
+    a2.drain()
+    b.drain()
+
+
+def test_checkpoint_transfer_surface_refuses_torn_bytes(tmp_path):
+    from traceweaver_tpu.stream.checkpoint import (
+        CheckpointCorrupt,
+        read_checkpoint_bytes,
+        save_checkpoint,
+        verify_checkpoint_bytes,
+        write_checkpoint_bytes,
+    )
+
+    path = str(tmp_path / "ckpt.pkl")
+    save_checkpoint(path, {"hello": "world"})
+    raw = read_checkpoint_bytes(path)
+    # trailer strips cleanly on intact bytes
+    assert verify_checkpoint_bytes(raw) + raw[-16:] == raw
+    # torn transfer: flip a payload byte -> refused at the destination
+    torn = bytes([raw[0] ^ 0xFF]) + raw[1:]
+    with pytest.raises(CheckpointCorrupt, match="CRC"):
+        write_checkpoint_bytes(str(tmp_path / "out.pkl"), torn)
+    # truncated transfer: trailer length check names the failure
+    with pytest.raises(CheckpointCorrupt, match="truncated"):
+        verify_checkpoint_bytes(raw[:1] + raw[-16:])
+
+
+# ---------------------------------------------------------------------------
+# knobs + wire campaign artifact
+# ---------------------------------------------------------------------------
+
+def test_fleet_knobs_registered_typed_ranged():
+    from traceweaver_tpu.runtime import knobs
+
+    reg = dict(knobs.REGISTRY)
+    expected = {
+        "TW_FLEET_REPLICAS": "int",
+        "TW_FLEET_ROUTER_PORT": "int",
+        "TW_FLEET_MIGRATE_TIMEOUT_S": "float",
+        "TW_FLEET_RETRY_MAX": "int",
+        "TW_FLEET_VNODES": "int",
+        "TW_FLEET_BREAKER_FAILS": "int",
+        "TW_FLEET_BREAKER_COOLDOWN_S": "float",
+        "TW_FLEET_HEALTH_S": "float",
+        "TW_FLEET_PROXY_TIMEOUT_S": "float",
+    }
+    for name, typ in expected.items():
+        assert name in reg, f"{name} missing from the knob registry"
+        k = reg[name]
+        assert k.type == typ, (name, k.type)
+        assert k.help, f"{name} has no help text"
+        assert k.lo is not None and k.hi is not None, name
+    # defaults parse through the typed accessors
+    assert knobs.get_int("TW_FLEET_REPLICAS") >= 1
+    assert knobs.get_float("TW_FLEET_HEALTH_S") > 0
+
+
+def test_inproc_wire_campaign_artifact_and_self_compare(tmp_path):
+    """The wire campaign's artifact rides the PR-15 ledger machinery:
+    ledger-valid shape, zero-loss gate on every rung, format_report
+    renders it, and `campaign compare` is clean against itself."""
+    from traceweaver_tpu.campaign.compare import (
+        compare_artifacts,
+        format_report,
+    )
+    from traceweaver_tpu.campaign.ledger import load_artifact
+    from traceweaver_tpu.fleet_serve.campaign import run_fleet_campaign
+
+    out = str(tmp_path / "CAMPAIGN_fleet_test.json")
+    art = run_fleet_campaign(
+        str(tmp_path / "state"), replica_counts=(1, 2), tenants=2,
+        seconds=1.0, traces_per_post=4, base_period_s=0.1,
+        mode="inproc", out=out)
+    loaded = load_artifact(out)  # validates kind="campaign"
+    assert loaded["backend"] == "wire"
+    assert [r["rung"] for r in loaded["rungs"]] == ["fleet-1", "fleet-2"]
+    for r in loaded["rungs"]:
+        assert r["fleet"]["zero_loss"] is True
+        assert r["accuracy"]["e2e_pct"] == 100.0
+        assert r["steady"]["spans_per_s"] > 0
+        assert r["manifest"]["spans"] == r["manifest"]["traces"] * 5
+    # the N=2 rung exercised at least the chaos-phase live migration
+    # (plus any placement-rebalance moves the hash split required)
+    assert loaded["rungs"][1]["fleet"]["migrations"] >= 1
+    report = format_report(loaded)
+    assert "fleet-1" in report and "fleet-2" in report
+    res = compare_artifacts(art, loaded, tol_pct=10.0, tol_acc=1.0)
+    assert res["ok"], res["regressions"]

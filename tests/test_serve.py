@@ -555,3 +555,137 @@ def test_metrics_scrape_under_load_matches_stats_ledger(tmp_path):
         server.shutdown()
         server.server_close()
     service.drain()
+
+
+# ---------------------------------------------------------------------------
+# fleet-tier serve surface: drain readiness + explicit backpressure
+# ---------------------------------------------------------------------------
+
+def _http_raw(method, url, payload=None, timeout=30):
+    """Like _http but keeps the response headers (Retry-After)."""
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers or {}), json.loads(e.read())
+
+
+def test_readyz_flips_503_when_drain_begins(tmp_path):
+    """The rolling-restart contract (docs/SERVING.md "Replica fleet"):
+    /readyz answers 200 on a live replica and 503 the instant
+    begin_drain() marks it draining — while /healthz (liveness) stays
+    200, because a draining replica is alive and still finishing
+    in-flight work. The fleet router's health loop keys off exactly
+    this split."""
+    from traceweaver_tpu.serve import make_server
+
+    svc = TenantService(_cfg(state_dir=str(tmp_path / "drain")))
+    server = make_server(svc)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        svc.ingest("ten", hotel_payload(n_traces=6))
+        code, _, _ = _http_raw("GET", base + "/readyz")
+        assert code == 200
+        svc.begin_drain()
+        code, _, body = _http_raw("GET", base + "/readyz")
+        assert code == 503
+        assert body["draining"] is True
+        code, _, _ = _http_raw("GET", base + "/healthz")
+        assert code == 200
+        # idempotent: a second begin_drain leaves the same answer
+        svc.begin_drain()
+        code, _, _ = _http_raw("GET", base + "/readyz")
+        assert code == 503
+    finally:
+        server.shutdown()
+        server.server_close()
+    svc.drain()
+
+
+def test_sigterm_handler_drains_before_listener_close(tmp_path,
+                                                      monkeypatch):
+    """The run_server signal path: the registered SIGTERM handler flips
+    draining FIRST (so any probe still landing sees 503), then shuts
+    the listener down and the drain checkpoints every tenant."""
+    import signal as _signal
+    import time as _time
+
+    import traceweaver_tpu.serve.http as serve_http
+
+    handlers = {}
+    monkeypatch.setattr(serve_http.signal, "signal",
+                        lambda sig, h: handlers.setdefault(sig, h))
+    svc = TenantService(_cfg(state_dir=str(tmp_path / "sig")))
+    svc.ingest("ten", hotel_payload(n_traces=6))
+    done = {}
+
+    def _run():
+        done["summary"] = serve_http.run_server(
+            svc, "127.0.0.1", 0, verbose=False)
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    deadline = _time.monotonic() + 30
+    while _signal.SIGTERM not in handlers:
+        assert _time.monotonic() < deadline, "SIGTERM handler never set"
+        _time.sleep(0.01)
+    handlers[_signal.SIGTERM](_signal.SIGTERM, None)
+    assert svc.draining, "handler must flip draining synchronously"
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert done["summary"]["checkpointed"] == 1
+    assert os.path.isfile(tmp_path / "sig" / "ten" / "ckpt.pkl")
+
+
+def test_backpressure_429_sets_retry_after_header(tmp_path):
+    """Saturated per-tenant queues refuse the POST — 429 with a
+    Retry-After header derived from backlog x drain pace — instead of
+    dropping sealed windows. The admission check keeps headroom below
+    the hard pending+spill bound, so the bursty seal that follows an
+    accepted POST (watermark advance can seal several windows at once)
+    never overflows into shed_dropped_windows. After a flush drains
+    the backlog, the refused window POSTs clean — nothing was lost."""
+    from traceweaver_tpu.serve import make_server
+
+    svc = TenantService(_cfg(state_dir=str(tmp_path / "bp"),
+                             max_pending=1, spill_max=2))
+    server = make_server(svc)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{server.port}/api/v1/tenants/bp/spans"
+    try:
+        refused = None
+        for seq in range(12):
+            payload = hotel_payload(n_traces=2, prefix=f"s{seq}-",
+                                    base_us=seq * 60e6 + 10e6)
+            code, headers, body = _http_raw("POST", url, payload)
+            if code == 429:
+                refused = (payload, headers, body)
+                break
+            assert code == 200, body
+        assert refused is not None, "backpressure never fired"
+        payload, headers, body = refused
+        assert int(headers["Retry-After"]) >= 1
+        assert "backpressured" in body["error"]
+        # the headroom contract: refusal came BEFORE any window dropped
+        st = svc.stats("bp")
+        assert st["shed_dropped_windows"] == 0
+        assert svc.stats()["dispatch"]["backpressure_429s"] >= 1
+        # drain, then the refused window retries through unchanged
+        svc.flush()
+        code, _, _ = _http_raw("POST", url, payload)
+        assert code == 200
+    finally:
+        server.shutdown()
+        server.server_close()
+    svc.flush()
+    st = svc.stats("bp")
+    assert st["shed_dropped_windows"] == 0
+    assert st["traces_emitted"] == st["counters"]["ingested_traces"]
+    svc.drain()

@@ -1,0 +1,171 @@
+"""Replica fleet tier: tenant-sharded scale-out serving.
+
+One router process (:mod:`.router`) consistent-hashes tenant ids onto N
+shared-nothing replica serve processes, health-checks them, breaks
+circuits, retries in-flight POSTs onto the next replica in ring order,
+and coordinates LIVE tenant migration (drain → checkpoint transfer →
+resume, byte-identical sink output, zero span loss). The manager
+(:mod:`.manager`) owns replica lifecycle — spawn, migrate, rolling
+restart gated on ``/readyz``. The campaign runner (:mod:`.campaign`)
+drives the whole thing through the real HTTP wire and emits the gated
+``CAMPAIGN_*`` artifact the PR-15 ledger machinery reviews.
+
+Processes stay in their lanes: the ROUTER process never imports JAX —
+mesh, AOT warmup, and the persistent compile cache belong to each
+replica's own interpreter (the ``cli serve`` bring-up). That is what
+makes N replicas scale: N independent runtimes, not N threads behind
+one GIL.
+
+CLI (``python -m traceweaver_tpu.runtime.cli fleet ...``)::
+
+    fleet serve    --replicas N --port P --state-dir D [serve flags...]
+    fleet campaign --replicas 1,2 --seconds S --out CAMPAIGN_fleet.json
+
+docs/SERVING.md (architecture + runbook), docs/CAMPAIGN.md (artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+from typing import List
+
+from traceweaver_tpu.fleet_serve.manager import (
+    FleetManager,
+    InProcReplica,
+    ReplicaError,
+    ReplicaProcess,
+)
+from traceweaver_tpu.fleet_serve.router import (
+    CircuitBreaker,
+    FleetRouter,
+    HashRing,
+    ReplicaRef,
+)
+
+__all__ = [
+    "CircuitBreaker",
+    "FleetManager",
+    "FleetRouter",
+    "HashRing",
+    "InProcReplica",
+    "ReplicaError",
+    "ReplicaProcess",
+    "ReplicaRef",
+    "main",
+]
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    from traceweaver_tpu.runtime import knobs
+
+    p = argparse.ArgumentParser(
+        prog="python -m traceweaver_tpu.runtime.cli fleet",
+        description="Tenant-sharded replica fleet: router + N serve "
+                    "replicas with live migration and rolling restarts "
+                    "(docs/SERVING.md).")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser(
+        "serve", help="spawn N replica serve processes behind one router "
+                      "and serve until SIGTERM/SIGINT")
+    s.add_argument("--replicas", type=int,
+                   default=knobs.get_int("TW_FLEET_REPLICAS"))
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int,
+                   default=knobs.get_int("TW_FLEET_ROUTER_PORT"),
+                   help="router port (0 = ephemeral)")
+    s.add_argument("--state-dir", required=True,
+                   help="fleet state root; replica i keeps its tenants "
+                        "under <state-dir>/r<i>/")
+    s.add_argument("serve_args", nargs="*",
+                   help="flags passed through to every replica's "
+                        "`cli serve` (e.g. --fix 2 --window_s 60)")
+
+    c = sub.add_parser(
+        "campaign", help="wire-level load campaign: 1 vs N replicas "
+                         "through the real HTTP path, gated artifact out")
+    c.add_argument("--replicas", default="1,2",
+                   help="comma-separated rung ladder (default 1,2)")
+    c.add_argument("--tenants", type=int, default=3)
+    c.add_argument("--seconds", type=float, default=6.0,
+                   help="drive seconds per rung")
+    c.add_argument("--traces-per-post", type=int, default=6)
+    c.add_argument("--base-period-s", type=float, default=0.05,
+                   help="hot tenant's closed-loop pacing; tenant i runs "
+                        "at (i+1)x this period (heavy tail)")
+    c.add_argument("--mode", choices=("subprocess", "inproc"),
+                   default="subprocess",
+                   help="subprocess = real replica processes (the "
+                        "committed-artifact mode); inproc = same wire "
+                        "path in one process (the fast test mode)")
+    c.add_argument("--state-dir", required=True)
+    c.add_argument("--out", default=None,
+                   help="write the CAMPAIGN_*.json artifact here")
+    c.add_argument("--quiet", action="store_true")
+    return p
+
+
+def _serve_main(args) -> int:
+    replicas = []
+    try:
+        for i in range(args.replicas):
+            replicas.append(ReplicaProcess(
+                f"r{i}", os.path.join(args.state_dir, f"r{i}"),
+                serve_args=list(args.serve_args)).start())
+        fleet = FleetManager(replicas, router_port=args.port)
+    except ReplicaError as e:
+        for r in replicas:
+            r.stop(timeout_s=10.0)
+        print(f"[fleet] startup failed: {e}")
+        return 1
+    print(f"[fleet] router listening on {fleet.base_url} "
+          f"({args.replicas} replicas: "
+          + ", ".join(r.base_url for r in replicas) + ")")
+    stop = threading.Event()
+
+    def _signal(signum, _frame):
+        print(f"[fleet] signal {signum}: stopping fleet")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _signal)
+    signal.signal(signal.SIGINT, _signal)
+    stop.wait()
+    fleet.stop()
+    print(f"[fleet] stopped: {args.replicas} replicas drained")
+    return 0
+
+
+def _campaign_main(args) -> int:
+    from traceweaver_tpu.fleet_serve.campaign import run_fleet_campaign
+
+    counts = tuple(int(x) for x in str(args.replicas).split(",") if x)
+    artifact = run_fleet_campaign(
+        state_root=args.state_dir,
+        replica_counts=counts,
+        tenants=args.tenants,
+        seconds=args.seconds,
+        traces_per_post=args.traces_per_post,
+        base_period_s=args.base_period_s,
+        mode=args.mode,
+        out=args.out,
+        verbose=not args.quiet,
+    )
+    if not args.quiet:
+        from traceweaver_tpu.campaign.compare import format_report
+
+        print(format_report(artifact))
+    if args.out:
+        print(f"[fleet-campaign] artifact: {args.out}")
+    return 0
+
+
+def main(argv: List[str]) -> int:
+    """``cli fleet`` entry: pure host process (no JAX import here — the
+    replicas own their backends)."""
+    args = _build_parser().parse_args(argv)
+    if args.cmd == "serve":
+        return _serve_main(args)
+    return _campaign_main(args)

@@ -1,0 +1,511 @@
+"""Wire-level fleet load campaign: 1 vs N replicas through real HTTP.
+
+The PR-15 campaign harness measures the solver fleet under a synthetic
+drive that calls the service layer directly. This runner closes the
+remaining gap to production: a CLOSED-LOOP load generator posts
+Jaeger-JSON over the real ingestion wire — generator → fleet router →
+consistent-hash → replica HTTP server → tenant windower — against a
+1-replica and an N-replica fleet, and emits the same gated
+``CAMPAIGN_*.json`` artifact shape the ledger/compare machinery
+(:mod:`traceweaver_tpu.campaign`) already reviews and regression-gates.
+
+Drive shape:
+
+- one generator thread per tenant, **closed loop** (every POST waits
+  for its response before the next — the generator sees real
+  backpressure, honors 429 ``Retry-After``, and retries the SAME
+  payload so nothing is double-ingested);
+- **heavy-tailed tenant rates**: tenant *i* posts at rate ∝ 1/(i+1),
+  so one hot tenant dominates — the Alibaba-shaped skew the hash ring
+  and migration machinery exist for;
+- each POST is one fresh event-time window (trace ids unique per
+  window, spans placed in the window interior clear of the overlap
+  region), so conservation is exact: every ingested trace must emit
+  exactly once;
+- each N>=2 rung runs TWO phases on one fleet: a measured **steady**
+  phase (``spans_per_s`` = closed-loop ACCEPTED spans over the drive
+  wall — the wire capacity replicas scale — gated by a flush + settle
+  that makes every accepted span emit; placement is rebalanced first
+  so a degenerate all-tenants-on-one hash split cannot measure a
+  1-replica fleet twice), then a gated
+  **chaos** phase where the generators resume and the hottest tenant
+  is LIVE-MIGRATED mid-post — plus, subprocess mode, a rolling restart
+  of every replica. The chaos wall (dominated by two full process
+  cold-starts) stays out of the throughput figure, but its spans ride
+  the same rung-wide conservation gate: the failover machinery must be
+  lossless under live load.
+
+Rung accounting (per ``fleet-<n>`` rung): sustained spans/s over the
+steady phase wall, per-tenant seal→emit p99, migration/restart/
+retry counters from the router, and a zero-loss assertion
+(Σ ingested == Σ emitted, zero dropped/dead-lettered/late-dropped
+windows, over BOTH phases) that FAILS the campaign rather than
+shipping a lossy artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+from traceweaver_tpu.campaign import ledger
+from traceweaver_tpu.fleet_serve.manager import (
+    FleetManager,
+    InProcReplica,
+    ReplicaProcess,
+)
+from traceweaver_tpu.fleet_serve.router import http_json
+
+#: spans per handcrafted hotel trace (frontend -> search -> geo)
+SPANS_PER_TRACE = 5
+
+#: serve geometry the corpus is built against (matches the serve
+#: defaults the subprocess replicas boot with)
+WINDOW_US = 60e6
+
+
+def fleet_trace(tid: str, base_us: float, i: int,
+                spacing_us: float = 10_000.0) -> Dict:
+    """One hotel-shaped Jaeger-JSON trace (same 5-span frontend →
+    search → geo skeleton as the tier-1 serve corpus; every 6th trace
+    plants its latency in ``search``)."""
+    T = base_us + i * spacing_us
+    slow = (i % 6) == 5
+    s1_dur = 5000.0 if slow else 600.0
+    c1_dur = s1_dur + 500.0
+    root_dur = c1_dur + 400.0
+
+    def span(sid, start, dur, op, refs, pid, kind):
+        return dict(traceID=tid, spanID=sid, startTime=start, duration=dur,
+                    operationName=op,
+                    references=[{"traceID": tid, "spanID": r} for r in refs],
+                    processID=pid,
+                    tags=[{"key": "span.kind", "value": kind}])
+
+    spans = [
+        span("root", T, root_dur, "HTTP GET /hotels", [], "p1", "server"),
+        span("c1", T + 200, c1_dur, "call-search", ["root"], "p1", "client"),
+        span("s1", T + 300, s1_dur, "search", ["c1"], "p2", "server"),
+        span("c2", T + 400, 300.0, "call-geo", ["s1"], "p2", "client"),
+        span("s2", T + 450, 200.0, "geo", ["c2"], "p3", "server"),
+    ]
+    return dict(traceID=tid, spans=spans,
+                processes=dict(p1={"serviceName": "frontend"},
+                               p2={"serviceName": "search"},
+                               p3={"serviceName": "geo"}))
+
+
+def fleet_payload(tenant: str, seq: int, n_traces: int) -> Dict:
+    """One POST body = one fresh event-time window for this tenant.
+
+    ``base_us`` advances a full window stride per seq and lands 10s into
+    the window interior, clear of the 5s overlap region on both edges —
+    so every trace belongs to exactly one window and the conservation
+    check (ingested == emitted, exactly once) is strict."""
+    base_us = seq * WINDOW_US + 10e6
+    return {"data": [fleet_trace(f"{tenant}w{seq:05d}n{i:03d}",
+                                 base_us, i)
+                     for i in range(n_traces)]}
+
+
+class _TenantDrive(threading.Thread):
+    """Closed-loop generator for one tenant: POST, await response,
+    honor 429 Retry-After (retrying the SAME window payload), pace by
+    the tenant's heavy-tail period."""
+
+    def __init__(self, base_url: str, tenant: str, period_s: float,
+                 n_traces: int, stop_evt: threading.Event,
+                 start_seq: int = 0) -> None:
+        super().__init__(name=f"tw-drive-{tenant}", daemon=True)
+        self.base_url = base_url
+        self.tenant = tenant
+        self.period_s = period_s
+        self.n_traces = n_traces
+        self.stop_evt = stop_evt
+        # window sequence cursor: a later drive phase for the same
+        # tenant resumes here so event time stays monotonic (a reused
+        # seq would land in an already-sealed window as a late span)
+        self.seq = start_seq
+        self.posts = 0
+        self.traces = 0
+        self.retry_after_429s = 0
+        self.errors: List[str] = []
+
+    def _post(self, payload: Dict) -> Tuple[int, Dict, Dict]:
+        data = json.dumps(payload).encode("utf-8")
+        req = urlrequest.Request(
+            f"{self.base_url}/api/v1/tenants/{self.tenant}/spans",
+            data=data, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urlrequest.urlopen(req, timeout=120) as resp:
+                return resp.status, dict(resp.headers), \
+                    json.loads(resp.read() or b"{}")
+        except urlerror.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except (ValueError, OSError):
+                body = {}
+            return e.code, dict(e.headers or {}), body
+
+    def run(self) -> None:
+        while not self.stop_evt.is_set():
+            payload = fleet_payload(self.tenant, self.seq, self.n_traces)
+            while not self.stop_evt.is_set():
+                try:
+                    status, headers, _ = self._post(payload)
+                except (urlerror.URLError, OSError) as e:
+                    # the router retries/fails internally; a transport
+                    # error here means the ROUTER is gone — record, stop
+                    self.errors.append(f"seq {self.seq}: {e}")
+                    return
+                if status == 200:
+                    self.posts += 1
+                    self.traces += self.n_traces
+                    break
+                if status == 429:
+                    self.retry_after_429s += 1
+                    wait = float(headers.get("Retry-After", 1))
+                    self.stop_evt.wait(min(wait, 5.0))
+                    continue  # retry the SAME window — no double ingest
+                self.errors.append(f"seq {self.seq}: HTTP {status}")
+                return
+            else:
+                return  # stopped mid-retry: this window never ingested
+            self.seq += 1
+            self.stop_evt.wait(self.period_s)
+
+
+def _build_fleet(n: int, mode: str, state_root: str,
+                 serve_args: Optional[List[str]],
+                 verbose: bool) -> FleetManager:
+    names = [f"r{i}" for i in range(n)]
+    if mode == "subprocess":
+        replicas = [ReplicaProcess(
+            name, os.path.join(state_root, f"fleet{n}", name),
+            serve_args=serve_args or ["--fix", "2"]).start()
+            for name in names]
+    elif mode == "inproc":
+        from traceweaver_tpu.serve import ServeConfig
+
+        replicas = [InProcReplica(name, ServeConfig(
+            fix=2, window_us=WINDOW_US, overlap_us=5e6, ooo_bound_us=1e6,
+            verbose=False, pump_windows=10 ** 9,
+            state_dir=os.path.join(state_root, f"fleet{n}", name)))
+            for name in names]
+    else:
+        raise ValueError(f"unknown fleet campaign mode {mode!r}")
+    return FleetManager(replicas, router_port=0, verbose=verbose)
+
+
+def _aggregate(fleet: FleetManager) -> Dict[str, object]:
+    """Fleet-wide conservation ledger from the per-replica stats (each
+    live tenant appears on exactly one replica — migration deletes it
+    from the source and tombstones the id)."""
+    stats = fleet.router.fleet_stats(include_replicas=True)
+    agg = dict(ingested_traces=0, ingested_spans=0, traces_emitted=0,
+               spans_emitted=0, shed_dropped_windows=0,
+               deadletter_windows=0, late_dropped=0, quarantined=0,
+               backlog=0, backpressure_429s=0)
+    p99 = {}
+    per_tenant = {}
+    for name, st in stats["replica_stats"].items():
+        if "error" in st:
+            raise RuntimeError(f"replica {name} stats: {st['error']}")
+        agg["backpressure_429s"] += int(
+            st.get("dispatch", {}).get("backpressure_429s", 0))
+        for tid, ts in st.get("tenants", {}).items():
+            c = ts.get("counters", {})
+            agg["ingested_traces"] += int(c.get("ingested_traces", 0))
+            agg["ingested_spans"] += int(c.get("ingested_spans", 0))
+            agg["traces_emitted"] += int(ts.get("traces_emitted", 0))
+            agg["spans_emitted"] += int(ts.get("spans_emitted", 0))
+            agg["shed_dropped_windows"] += int(
+                ts.get("shed_dropped_windows", 0))
+            agg["deadletter_windows"] += int(
+                ts.get("deadletter_windows", 0))
+            agg["late_dropped"] += int(ts.get("late_dropped", 0))
+            agg["quarantined"] += int(ts.get("quarantined_windows", 0))
+            agg["backlog"] += int(ts.get("backlog", 0))
+            p99[tid] = float(ts.get("seal_emit_p99_ms", 0.0))
+            per_tenant[f"{name}/{tid}"] = dict(
+                ingested=int(c.get("ingested_traces", 0)),
+                emitted=int(ts.get("traces_emitted", 0)),
+                backlog=int(ts.get("backlog", 0)),
+                solved_windows=int(ts.get("solved_windows", 0)),
+                spilled=int(ts.get("shed_spilled", 0)),
+            )
+    agg["per_tenant"] = per_tenant
+    agg["seal_emit_p99_ms"] = p99
+    agg["router"] = stats["router"]
+    return agg
+
+
+def _settle(fleet: FleetManager, timeout_s: float = 60.0) -> Dict:
+    """Post-flush quiesce: a replica's continuous dispatcher may still
+    be mid-solve when the flush response lands, so poll the aggregate
+    until the conservation ledger balances (or stops moving)."""
+    deadline = time.monotonic() + timeout_s
+    agg = _aggregate(fleet)
+    while time.monotonic() < deadline:
+        if (agg["traces_emitted"] == agg["ingested_traces"]
+                and agg["backlog"] == 0):
+            break
+        time.sleep(0.25)
+        agg = _aggregate(fleet)
+    return agg
+
+
+def _rebalance(fleet: FleetManager, tenant_ids: List[str],
+               verbose: bool) -> int:
+    """Pre-measurement placement fix: the hash ring can land every
+    tenant on one replica (3 ids, 2 replicas — a 3/0 split is a coin
+    flip), which would measure a 1-replica fleet twice. Live-migrate
+    the hottest tenant from the fullest replica onto each EMPTY one —
+    the load-balancing use of the migration machinery."""
+    moved = 0
+    placement = {name: fleet.replica_tenants(name)
+                 for name in sorted(fleet.router.replicas)}
+    for name in sorted(placement):
+        if placement[name]:
+            continue
+        donor = max(sorted(placement), key=lambda r: len(placement[r]))
+        if len(placement[donor]) < 2:
+            break
+        # hottest tenant present on the donor (drive rate ∝ 1/(i+1))
+        tid = next(t for t in tenant_ids if t in placement[donor])
+        fleet.migrate(tid, name)
+        placement[donor].remove(tid)
+        placement[name] = [tid]
+        moved += 1
+        if verbose:
+            print(f"[fleet-campaign] rebalance: {tid} -> {name}")
+    return moved
+
+
+def _flush_fleet(fleet: FleetManager, n: int) -> None:
+    # the fan-out flush crosses every replica; a connection reset here
+    # (a replica's listener mid-close from a just-finished restart) is
+    # retryable — flush is idempotent, sealing is driven by event time
+    last: Optional[BaseException] = None
+    for _ in range(3):
+        try:
+            status, flush = http_json(
+                "POST", fleet.base_url + "/api/v1/flush", None,
+                timeout=300)
+        except (urlerror.URLError, OSError) as e:
+            last = e
+            time.sleep(0.5)
+            continue
+        if status != 200:
+            raise RuntimeError(f"fleet-{n} flush: HTTP {status} {flush}")
+        return
+    raise RuntimeError(f"fleet-{n} flush failed: {last}")
+
+
+def run_fleet_rung(n: int, mode: str, state_root: str, tenants: int,
+                   seconds: float, traces_per_post: int,
+                   base_period_s: float, serve_args: Optional[List[str]],
+                   verbose: bool) -> Dict[str, object]:
+    """One campaign rung, two phases on one fresh n-replica fleet:
+
+    - **steady** (measured): closed-loop drive through the router for
+      ``seconds`` — ``spans_per_s`` is ACCEPTED spans (200-status
+      POSTs) over the drive wall, the wire capacity the 1-vs-N
+      comparison is about — followed by a flush + settle that forces
+      every accepted span to emit before the phase may end;
+    - **chaos** (n >= 2, gated not measured): the generators resume
+      (continuing their window sequence) while the hot tenant is
+      live-migrated and — subprocess mode — every replica takes a
+      rolling restart; a final flush + settle feeds the rung-wide
+      zero-loss gate, so the failover machinery must be lossless under
+      live load even though its wall cost (two full process restarts)
+      stays out of the throughput figure."""
+    fleet = _build_fleet(n, mode, state_root, serve_args, verbose)
+    tenant_ids = [f"ten{i}" for i in range(tenants)]
+
+    def mk_drives(stop_evt: threading.Event,
+                  seqs: Dict[str, int]) -> List[_TenantDrive]:
+        return [_TenantDrive(fleet.base_url, tid,
+                             period_s=base_period_s * (i + 1),
+                             n_traces=traces_per_post, stop_evt=stop_evt,
+                             start_seq=seqs.get(tid, 0))
+                for i, tid in enumerate(tenant_ids)]
+
+    def drain_drives(drives: List[_TenantDrive]) -> None:
+        for d in drives:
+            d.join(timeout=130.0)
+        errors = [e for d in drives for e in d.errors]
+        if errors:
+            raise RuntimeError(f"fleet-{n} drive errors: {errors[:5]}")
+
+    t0 = time.monotonic()
+    migrated = restarted = rebalanced = 0
+    all_drives: List[_TenantDrive] = []
+    try:
+        # -- steady phase (the measured one) ------------------------------
+        stop_a = threading.Event()
+        drives_a = mk_drives(stop_a, {})
+        all_drives += drives_a
+        for d in drives_a:
+            d.start()
+        if n >= 2:
+            # let first POSTs land so every tenant exists, then fix the
+            # placement the ring happened to mint
+            time.sleep(min(1.0, max(0.3, seconds / 10)))
+            rebalanced = _rebalance(fleet, tenant_ids, verbose)
+        while time.monotonic() < t0 + seconds:
+            time.sleep(0.05)
+        stop_a.set()
+        drain_drives(drives_a)
+        # the wire throughput figure: spans the closed-loop generators
+        # got a 200 for, over the drive wall (including the last POSTs'
+        # response tails). Acceptance is what adding replicas scales on
+        # any host — emitted-spans/s is bounded by total solve cores,
+        # which a 1-core CI host pins to the same ceiling for every N.
+        # The flush + settle below still forces every accepted span to
+        # EMIT exactly once before the rung may return (the zero-loss
+        # gate), so acceptance is never credit for vapor.
+        drive_wall_s = time.monotonic() - t0
+        steady_spans = sum(d.traces for d in drives_a) * SPANS_PER_TRACE
+        _flush_fleet(fleet, n)
+        agg = _settle(fleet)
+        steady_wall_s = time.monotonic() - t0
+
+        # -- chaos phase (gated, unmeasured) ------------------------------
+        chaos_t0 = time.monotonic()
+        if n >= 2:
+            stop_b = threading.Event()
+            drives_b = mk_drives(stop_b, {d.tenant: d.seq
+                                          for d in drives_a})
+            all_drives += drives_b
+            for d in drives_b:
+                d.start()
+            time.sleep(0.3)
+            hot = tenant_ids[0]
+            src = fleet.router.owner(hot)
+            dst = next(name for name in sorted(fleet.router.replicas)
+                       if name != src)
+            fleet.migrate(hot, dst)
+            migrated += 1
+            if mode == "subprocess":
+                fleet.rolling_restart()
+                restarted = len(fleet.replicas)
+            # post-chaos burst: the fleet must still be ingesting after
+            # the migration + restarts, not merely draining
+            time.sleep(max(0.5, seconds / 8))
+            stop_b.set()
+            drain_drives(drives_b)
+            _flush_fleet(fleet, n)
+            agg = _settle(fleet)
+        chaos_wall_s = time.monotonic() - chaos_t0
+        wall_s = time.monotonic() - t0
+    finally:
+        fleet.stop()
+
+    # the zero-loss gate: a lossy fleet does not get an artifact
+    lost = agg["ingested_traces"] - agg["traces_emitted"]
+    if lost != 0 or agg["shed_dropped_windows"] or \
+            agg["deadletter_windows"] or agg["late_dropped"] or \
+            agg["backlog"]:
+        raise RuntimeError(
+            f"fleet-{n} lost traces: ingested {agg['ingested_traces']} "
+            f"emitted {agg['traces_emitted']} (delta {lost}), dropped "
+            f"windows {agg['shed_dropped_windows']}, deadletter "
+            f"{agg['deadletter_windows']}, late_dropped "
+            f"{agg['late_dropped']}, backlog {agg['backlog']}; "
+            f"per-tenant {json.dumps(agg['per_tenant'], sort_keys=True)}")
+    posted = sum(d.traces for d in all_drives)
+    if posted != agg["ingested_traces"]:
+        raise RuntimeError(
+            f"fleet-{n} wire loss: generators got 200 for {posted} "
+            f"traces, replicas ingested {agg['ingested_traces']}")
+    e2e_pct = (100.0 * agg["traces_emitted"] / agg["ingested_traces"]
+               if agg["ingested_traces"] else 0.0)
+    spans_per_s = (steady_spans / drive_wall_s
+                   if drive_wall_s > 0 else 0.0)
+    return dict(
+        rung=f"fleet-{n}",
+        manifest=dict(
+            spans=int(agg["ingested_spans"]),
+            traces=int(agg["ingested_traces"]),
+            tenants=tenants, replicas=n, mode=mode,
+            posts=sum(d.posts for d in all_drives),
+            regime_mix={},
+        ),
+        steady=dict(
+            spans_per_s=round(spans_per_s, 2),
+            backend_compiles=0,
+            aot_misses=[],
+            quarantined=int(agg["quarantined"]),
+        ),
+        accuracy=dict(e2e_pct=round(e2e_pct, 3), per_regime={}),
+        fleet=dict(
+            wall_s=round(wall_s, 3),
+            drive_wall_s=round(drive_wall_s, 3),
+            steady_wall_s=round(steady_wall_s, 3),
+            chaos_wall_s=round(chaos_wall_s, 3),
+            steady_accepted_spans=steady_spans,
+            seal_emit_p99_ms=agg["seal_emit_p99_ms"],
+            router=agg["router"],
+            migrations=migrated + rebalanced,
+            rebalance_migrations=rebalanced,
+            replicas_restarted=restarted,
+            backpressure_429s=int(agg["backpressure_429s"]),
+            generator_429s=sum(d.retry_after_429s for d in all_drives),
+            zero_loss=True,
+        ),
+    )
+
+
+def run_fleet_campaign(state_root: str,
+                       replica_counts: Tuple[int, ...] = (1, 2),
+                       tenants: int = 3,
+                       seconds: float = 6.0,
+                       traces_per_post: int = 6,
+                       base_period_s: float = 0.05,
+                       mode: str = "subprocess",
+                       name: str = "fleet-wire",
+                       out: Optional[str] = None,
+                       serve_args: Optional[List[str]] = None,
+                       verbose: bool = False) -> Dict[str, object]:
+    """Drive the full campaign ladder (one rung per replica count) and
+    return — optionally write — the gated ``CAMPAIGN_*`` artifact."""
+    plan = dict(
+        mode=mode, tenants=tenants, seconds=seconds,
+        traces_per_post=traces_per_post, base_period_s=base_period_s,
+        replica_counts=list(replica_counts),
+        rungs=[dict(name=f"fleet-{n}") for n in replica_counts],
+    )
+    ledger.record_start(name, plan)
+    t0 = time.monotonic()
+    rungs = []
+    for n in replica_counts:
+        rung = run_fleet_rung(
+            n, mode, state_root, tenants, seconds, traces_per_post,
+            base_period_s, serve_args, verbose)
+        ledger.record_rung(name, rung["rung"],
+                           rung["steady"]["spans_per_s"],
+                           rung["accuracy"]["e2e_pct"],
+                           rung["steady"]["backend_compiles"],
+                           len(rung["steady"]["aot_misses"]))
+        if verbose:
+            print(f"[fleet-campaign] {rung['rung']}: "
+                  f"{rung['steady']['spans_per_s']:.1f} spans/s, "
+                  f"e2e {rung['accuracy']['e2e_pct']:.1f}%, "
+                  f"migrations {rung['fleet']['migrations']}, "
+                  f"restarts {rung['fleet']['replicas_restarted']}")
+        rungs.append(rung)
+    wall_s = time.monotonic() - t0
+    artifact = ledger.make_artifact(
+        name=name, plan=plan, backend="wire", devices_visible=0,
+        rungs=rungs, scrape=ledger.scrape_snapshot(), wall_s=wall_s)
+    if out:
+        ledger.write_artifact(out, artifact)
+    ledger.record_finish(name, wall_s, out)
+    return artifact

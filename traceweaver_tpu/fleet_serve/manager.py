@@ -1,0 +1,269 @@
+"""Replica fleet lifecycle: spawn, watch, migrate, rolling-restart.
+
+Two replica flavors behind one duck-typed surface (``name``,
+``base_url``, ``stop()``):
+
+- :class:`ReplicaProcess` — the REAL thing: one
+  ``python -m traceweaver_tpu.runtime.cli serve`` subprocess per
+  replica, shared-nothing (own state dir, own interpreter, own
+  mesh/AOT bring-up), port parsed from its startup line. This is what
+  the committed campaign artifact and the tier-1 fleet smoke drive —
+  true process parallelism, so N=2 replicas can actually out-ingest
+  N=1 on a multi-core host.
+- :class:`InProcReplica` — a full :class:`TenantService` behind a real
+  ``ThreadingHTTPServer`` in this process. Same wire path, same
+  handlers, no interpreter spawn — the fast harness for router unit
+  tests where subprocess startup cost would dominate.
+
+:class:`FleetManager` composes N replicas with a
+:class:`~traceweaver_tpu.fleet_serve.router.FleetRouter` and owns the
+two fleet-wide operations:
+
+- ``migrate(tenant, dst)`` — delegates to the router (hold → out → in
+  → re-pin), counted on both sides.
+- ``rolling_restart()`` — the zero-downtime runbook, one replica at a
+  time: migrate its tenants onto the survivors, mark it draining in the
+  router (out of rotation BEFORE the kill), SIGTERM (serve checkpoints
+  every remaining tenant in the drain budget), respawn with
+  ``--resume``, poll ``/readyz`` until the new process answers 200,
+  restore routing. The router keeps serving throughout — at most one
+  replica is down at any instant.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from traceweaver_tpu.fleet_serve.router import FleetRouter, http_json
+from traceweaver_tpu.obs import events as _events
+
+_LISTEN_RE = re.compile(r"listening on (http://[\d.]+:\d+)")
+
+
+class ReplicaError(RuntimeError):
+    """A replica process failed to start, stop, or come back ready."""
+
+
+class ReplicaProcess:
+    """One ``cli serve`` subprocess: spawn, parse the listen line, tail
+    stdout on a thread (the log rides ``self.log`` for post-mortems),
+    SIGTERM-stop, respawn with ``--resume``."""
+
+    def __init__(self, name: str, state_dir: str,
+                 serve_args: Optional[List[str]] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 startup_timeout_s: float = 180.0) -> None:
+        self.name = name
+        self.state_dir = state_dir
+        self.serve_args = list(serve_args or [])
+        self.env = dict(env) if env is not None else dict(
+            os.environ, JAX_PLATFORMS="cpu", TW_BACKEND="cpu")
+        self.startup_timeout_s = startup_timeout_s
+        self.base_url = ""
+        self.log: List[str] = []
+        self.restarts = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self._reader: Optional[threading.Thread] = None
+        self._listen = threading.Event()
+
+    def start(self, resume: bool = False) -> "ReplicaProcess":
+        if self.proc is not None and self.proc.poll() is None:
+            raise ReplicaError(f"replica {self.name} already running")
+        cmd = [sys.executable, "-m", "traceweaver_tpu.runtime.cli",
+               "serve", "--port", "0", "--state-dir", self.state_dir]
+        if resume:
+            cmd.append("--resume")
+        cmd += self.serve_args
+        self._listen.clear()
+        self.proc = subprocess.Popen(
+            cmd, env=self.env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        self._reader = threading.Thread(
+            target=self._tail, name=f"tw-replica-{self.name}-log",
+            daemon=True)
+        self._reader.start()
+        if not self._listen.wait(timeout=self.startup_timeout_s):
+            tail = "\n".join(self.log[-20:])
+            self.stop(timeout_s=5.0)
+            raise ReplicaError(
+                f"replica {self.name} never printed its listen line "
+                f"within {self.startup_timeout_s:.0f}s; log tail:\n{tail}")
+        return self
+
+    def _tail(self) -> None:
+        proc = self.proc
+        assert proc is not None and proc.stdout is not None
+        for line in proc.stdout:
+            self.log.append(line.rstrip("\n"))
+            m = _LISTEN_RE.search(line)
+            if m:
+                self.base_url = m.group(1)
+                self._listen.set()
+        # EOF: the process exited. If it died before ever listening,
+        # release the waiter so start() can report the log instead of
+        # burning the whole startup timeout.
+        self._listen.set()
+        if not self.base_url:
+            return
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def stop(self, timeout_s: float = 120.0) -> None:
+        """SIGTERM → graceful drain (serve checkpoints every tenant) →
+        wait; SIGKILL only if the drain budget blows."""
+        proc = self.proc
+        if proc is None:
+            return
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10.0)
+        if self._reader is not None:
+            self._reader.join(timeout=5.0)
+
+    def restart(self, timeout_s: float = 120.0) -> str:
+        """Graceful stop + ``--resume`` respawn; returns the NEW base
+        url (port 0 means the port changes — the caller re-points the
+        router slot)."""
+        self.stop(timeout_s=timeout_s)
+        self.base_url = ""
+        self.start(resume=True)
+        self.restarts += 1
+        return self.base_url
+
+
+class InProcReplica:
+    """A full serve replica (TenantService + threaded HTTP server) in
+    this process — the real wire path without the subprocess cost."""
+
+    def __init__(self, name: str, cfg) -> None:
+        # deferred import: the router process stays JAX-free; only
+        # replica construction pulls the serve/stream stack in
+        from traceweaver_tpu.serve import TenantService, make_server
+
+        self.name = name
+        self.service = TenantService(cfg)
+        self.server = make_server(self.service, host="127.0.0.1", port=0)
+        self.base_url = f"http://127.0.0.1:{self.server.port}"
+        self._thread = threading.Thread(
+            target=self.server.serve_forever,
+            name=f"tw-replica-{name}", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        self._thread.join(timeout=timeout_s)
+        self.service.drain()
+
+
+class FleetManager:
+    """N replicas + one router, started together, torn down together."""
+
+    def __init__(self, replicas: List, router_port: Optional[int] = 0,
+                 verbose: bool = False) -> None:
+        self.replicas: Dict[str, object] = {r.name: r for r in replicas}
+        self.router = FleetRouter(
+            {r.name: r.base_url for r in replicas},
+            port=router_port, verbose=verbose).start()
+        self.verbose = verbose
+
+    @property
+    def base_url(self) -> str:
+        return self.router.base_url
+
+    def migrate(self, tenant: str, dst: str) -> Dict[str, object]:
+        return self.router.migrate(tenant, dst)
+
+    def replica_tenants(self, name: str) -> List[str]:
+        ref = self.router.replicas[name]
+        status, out = http_json("GET", ref.base_url + "/api/v1/tenants",
+                                timeout=self.router.proxy_timeout_s)
+        if status != 200:
+            raise ReplicaError(
+                f"replica {name}: /api/v1/tenants HTTP {status}")
+        return list(out.get("tenants", []))
+
+    def _drain_target(self, exclude: str) -> str:
+        """Pick the migration destination for a draining replica's
+        tenants: the routable survivor with the fewest tenants."""
+        best, best_n = None, None
+        for name, ref in self.router.replicas.items():
+            if name == exclude or not ref.routable:
+                continue
+            n = len(self.replica_tenants(name))
+            if best_n is None or n < best_n:
+                best, best_n = name, n
+        if best is None:
+            raise ReplicaError(
+                f"rolling restart of {exclude}: no routable survivor to "
+                f"migrate its tenants to")
+        return best
+
+    def rolling_restart(self,
+                        ready_timeout_s: float = 180.0) -> Dict[str, object]:
+        """Restart every replica, one at a time, with zero request loss:
+        tenants are migrated off FIRST, the replica leaves routing
+        before its SIGTERM, and rotation only moves on once ``/readyz``
+        answers 200 from the respawned process."""
+        report: Dict[str, object] = {}
+        for name in sorted(self.replicas):
+            rep = self.replicas[name]
+            if not isinstance(rep, ReplicaProcess):
+                raise ReplicaError(
+                    f"rolling restart needs subprocess replicas; "
+                    f"{name} is {type(rep).__name__}")
+            moved = []
+            for tenant in self.replica_tenants(name):
+                dst = self._drain_target(exclude=name)
+                self.migrate(tenant, dst)
+                moved.append((tenant, dst))
+            # out of rotation BEFORE the kill: the router stops offering
+            # this replica while the socket is still up, so no POST
+            # races the teardown
+            self.router.set_draining(name, True)
+            try:
+                new_url = rep.restart()
+                self.router.update_replica(name, new_url)
+                self._wait_ready(name, timeout_s=ready_timeout_s)
+            finally:
+                self.router.set_draining(name, False)
+            self.router.bump("restarts")
+            _events.emit("fleet", "rolling_restart", replica=name,
+                         moved=len(moved), new_url=new_url)
+            report[name] = dict(moved=moved, base_url=new_url)
+        return report
+
+    def _wait_ready(self, name: str, timeout_s: float) -> None:
+        ref = self.router.replicas[name]
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                status, _ = http_json("GET", ref.base_url + "/readyz",
+                                      timeout=5.0)
+            except OSError:
+                status = None
+            if status == 200:
+                ref.ready = True
+                return
+            time.sleep(0.2)
+        raise ReplicaError(
+            f"replica {name} did not become ready within "
+            f"{timeout_s:.0f}s after restart")
+
+    def stop(self) -> None:
+        self.router.stop()
+        for rep in self.replicas.values():
+            rep.stop()  # type: ignore[attr-defined]

@@ -1,0 +1,635 @@
+"""Tenant-sharded HTTP router: the fleet tier's front door.
+
+Stdlib only, and deliberately JAX-free — the router is a pure host
+process; every device decision (mesh, AOT warmup, persistent compile
+cache) belongs to the replicas it fronts. One router process consistent-
+hashes tenant ids onto N replica serve processes (each a full
+:mod:`traceweaver_tpu.serve` server, shared-nothing: its own state dir,
+its own mesh) and owns the fleet's availability story:
+
+- **consistent hashing** (:class:`HashRing`): tenant -> replica via
+  SHA-1 points with ``TW_FLEET_VNODES`` virtual nodes per replica, so
+  adding/removing a replica remaps ~1/N of the tenants, not all of
+  them. The ring also defines each tenant's *preference order* — the
+  retry-on-next-replica sequence.
+- **health-checked routing**: a background loop probes each replica's
+  ``/readyz`` every ``TW_FLEET_HEALTH_S``; a draining or cold replica
+  (503 — serve flips readiness the instant SIGTERM lands) drops out of
+  routing before its socket does.
+- **circuit breaking** (:class:`CircuitBreaker`): ``TW_FLEET_BREAKER_
+  FAILS`` consecutive proxy failures open a replica's circuit for
+  ``TW_FLEET_BREAKER_COOLDOWN_S``; an open circuit is skipped exactly
+  like a failed health check.
+- **counted retries**: a failed in-flight POST moves to the next
+  replica in ring order, at most ``TW_FLEET_RETRY_MAX`` extra attempts,
+  every hop counted (``tw_fleet_router_total{outcome=...}``) — and a
+  tenant POST that lands on a fallback replica PINS the tenant there so
+  its stream stays on one replica.
+- **migration pins**: live tenant migration (:meth:`FleetRouter.
+  migrate`) holds the tenant's requests, runs the replica-side
+  ``migrate_out``/``migrate_in`` pair, then pins the tenant to its new
+  home. A 410 from a replica ("tenant migrated out") re-resolves the
+  pin instead of failing the client.
+
+Router endpoints (everything else proxies to the owning replica)::
+
+    GET  /healthz               router liveness + replica table
+    GET  /readyz                200 while >=1 replica is routable
+    GET  /metrics               router-process Prometheus exposition
+    GET  /api/v1/stats          per-replica /api/v1/stats + router view
+    GET  /api/v1/tenants        union of replica tenant lists
+    POST /api/v1/flush          fan-out seal+solve on every replica
+    GET  /api/v1/fleet/stats    ring, pins, breaker/health states
+    POST /api/v1/fleet/migrate  {"tenant": ..., "to": "<replica>"}
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import hashlib
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib import error as urlerror
+from urllib import request as urlrequest
+from urllib.parse import urlparse
+
+from traceweaver_tpu.obs import events as _events
+from traceweaver_tpu.obs.registry import get_registry as _get_registry
+from traceweaver_tpu.runtime import knobs
+
+_TENANT_PATH = re.compile(r"^/api/v1/tenants/([^/]+)(/.*)?$")
+
+#: same runaway-POST cap as the replica front door
+MAX_BODY_BYTES = 64 << 20
+
+_OBS_ROUTER = _get_registry().counter(
+    "tw_fleet_router_total",
+    "router request outcomes (proxied/rerouted/retried/failed/held/"
+    "rejected) and fleet operations (migrations/restarts)",
+    labels=("outcome",))
+_OBS_READY = _get_registry().gauge(
+    "tw_fleet_replicas_ready",
+    "replicas currently routable (ready, not draining, breaker closed)")
+
+
+def _stable_hash(key: str) -> int:
+    """Process-stable 64-bit hash (Python's ``hash()`` is salted per
+    process — useless for a ring two processes must agree on)."""
+    return int.from_bytes(hashlib.sha1(key.encode("utf-8")).digest()[:8],
+                          "big")
+
+
+def http_json(method: str, url: str, payload: Optional[dict] = None,
+              timeout: float = 30.0) -> Tuple[int, dict]:
+    """One JSON request/response round trip (4xx/5xx return, never
+    raise — connection-level failures do raise ``URLError``/``OSError``,
+    the retry/breaker signal)."""
+    data = (json.dumps(payload).encode("utf-8")
+            if payload is not None else None)
+    headers = {"Content-Type": "application/json"} if data else {}
+    req = urlrequest.Request(url, data=data, method=method,
+                             headers=headers)
+    try:
+        with urlrequest.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urlerror.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"{}")
+        except (ValueError, OSError):
+            body = {}
+        return e.code, body
+
+
+def _http_raw(method: str, url: str, body: Optional[bytes],
+              content_type: Optional[str],
+              timeout: float) -> Tuple[int, Dict[str, str], bytes]:
+    """Proxy-side round trip preserving bytes and headers. HTTP errors
+    are responses (forwarded as-is); only connection-level failures
+    raise."""
+    headers = {}
+    if content_type:
+        headers["Content-Type"] = content_type
+    req = urlrequest.Request(url, data=body, method=method,
+                             headers=headers)
+    try:
+        with urlrequest.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urlerror.HTTPError as e:
+        return e.code, dict(e.headers or {}), e.read()
+
+
+class HashRing:
+    """Consistent hash ring over replica names (SHA-1 points,
+    ``vnodes`` virtual nodes per replica). ``preference(key)`` walks the
+    ring clockwise from the key's point and yields each replica once —
+    element 0 is the owner, the rest are the failover order."""
+
+    def __init__(self, names: List[str],
+                 vnodes: Optional[int] = None) -> None:
+        self.vnodes = (vnodes if vnodes is not None
+                       else knobs.get_int("TW_FLEET_VNODES"))
+        self.names = sorted(set(names))
+        self._points = sorted(
+            (_stable_hash(f"{name}#{v}"), name)
+            for name in self.names for v in range(self.vnodes))
+        self._keys = [p[0] for p in self._points]
+
+    def preference(self, key: str) -> List[str]:
+        if not self._points:
+            return []
+        out: List[str] = []
+        seen = set()
+        start = bisect.bisect_right(self._keys, _stable_hash(key))
+        for j in range(len(self._points)):
+            name = self._points[(start + j) % len(self._points)][1]
+            if name not in seen:
+                seen.add(name)
+                out.append(name)
+                if len(out) == len(self.names):
+                    break
+        return out
+
+    def lookup(self, key: str) -> str:
+        return self.preference(key)[0]
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker: ``fail_max`` straight failures open
+    the circuit for ``cooldown_s``; any success closes it."""
+
+    def __init__(self, fail_max: Optional[int] = None,
+                 cooldown_s: Optional[float] = None) -> None:
+        self.fail_max = (fail_max if fail_max is not None
+                         else knobs.get_int("TW_FLEET_BREAKER_FAILS"))
+        self.cooldown_s = (cooldown_s if cooldown_s is not None else
+                           knobs.get_float("TW_FLEET_BREAKER_COOLDOWN_S"))
+        self.fails = 0
+        self.opened = 0          # lifetime open transitions (stats)
+        self._open_until = 0.0
+
+    def record(self, ok: bool) -> None:
+        if ok:
+            self.fails = 0
+            self._open_until = 0.0
+            return
+        self.fails += 1
+        if self.fails >= self.fail_max:
+            self._open_until = time.monotonic() + self.cooldown_s
+            self.opened += 1
+
+    @property
+    def open(self) -> bool:
+        return time.monotonic() < self._open_until
+
+
+class ReplicaRef:
+    """The router's view of one replica process."""
+
+    def __init__(self, name: str, base_url: str) -> None:
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        # optimistic until the first health probe answers — a fleet
+        # boots routable, and the probe loop corrects within one period
+        self.ready = True
+        self.draining = False     # set during rolling restarts
+        self.breaker = CircuitBreaker()
+        self.requests = 0
+        self.failures = 0
+
+    @property
+    def routable(self) -> bool:
+        return self.ready and not self.draining and not self.breaker.open
+
+    def view(self) -> Dict[str, object]:
+        return dict(name=self.name, base_url=self.base_url,
+                    ready=self.ready, draining=self.draining,
+                    breaker_open=self.breaker.open,
+                    breaker_opened=self.breaker.opened,
+                    requests=self.requests, failures=self.failures)
+
+
+class RouterHandler(BaseHTTPRequestHandler):
+    """Routes requests onto the owning :class:`FleetRouter`."""
+
+    server_version = "traceweaver-fleet-router/1.0"
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def router(self) -> "FleetRouter":
+        return self.server  # type: ignore[return-value]
+
+    def log_message(self, fmt, *args):  # noqa: D102 — quiet by default
+        if self.router.verbose:
+            super().log_message(fmt, *args)
+
+    def _reply(self, code: int, payload: dict,
+               headers: Optional[dict] = None) -> None:
+        body = json.dumps(payload, sort_keys=True).encode("utf-8")
+        self._reply_bytes(code, body, "application/json", headers)
+
+    def _reply_bytes(self, code: int, body: bytes, content_type: str,
+                     headers: Optional[dict] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, str(value))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._reply(code, {"error": message})
+
+    def _read_body(self) -> Optional[bytes]:
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            self._error(400, "bad Content-Length")
+            return None
+        if length > MAX_BODY_BYTES:
+            self._error(413, f"body exceeds {MAX_BODY_BYTES} bytes")
+            return None
+        return self.rfile.read(length) if length else b""
+
+    # -- verbs ------------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        r = self.router
+        path = urlparse(self.path).path
+        m = _TENANT_PATH.match(path)
+        try:
+            if m:
+                body = self._read_body()
+                if body is None:
+                    return
+                self._proxy_tenant("POST", m.group(1), body)
+            elif path == "/api/v1/flush":
+                self._reply(200, r.flush_all())
+            elif path == "/api/v1/fleet/migrate":
+                body = self._read_body()
+                if body is None:
+                    return
+                try:
+                    req = json.loads(body or b"{}")
+                except json.JSONDecodeError as e:
+                    self._error(400, f"invalid JSON: {e}")
+                    return
+                tenant, dst = req.get("tenant"), req.get("to")
+                if not tenant or not dst:
+                    self._error(400, 'expected {"tenant": ..., "to": ...}')
+                    return
+                if dst not in r.replicas:
+                    self._error(404, f"no such replica {dst!r}")
+                    return
+                self._reply(200, r.migrate(tenant, dst))
+            else:
+                self._error(404, f"no such endpoint: POST {path}")
+        except (urlerror.URLError, OSError, RuntimeError) as e:
+            self._error(502, f"{type(e).__name__}: {e}")
+
+    def do_GET(self) -> None:  # noqa: N802
+        r = self.router
+        path = urlparse(self.path).path
+        try:
+            if path == "/healthz":
+                self._reply(200, {"ok": True,
+                                  "replicas": [ref.view()
+                                               for ref in r.refs()]})
+            elif path == "/readyz":
+                n = sum(ref.routable for ref in r.refs())
+                self._reply(200 if n else 503,
+                            {"ready": n > 0, "routable_replicas": n})
+            elif path == "/metrics":
+                from traceweaver_tpu.obs.exposition import (
+                    CONTENT_TYPE,
+                    render_metrics,
+                )
+
+                self._reply_bytes(200,
+                                  render_metrics().encode("utf-8"),
+                                  CONTENT_TYPE)
+            elif path == "/api/v1/stats":
+                self._reply(200, r.fleet_stats(include_replicas=True))
+            elif path == "/api/v1/tenants":
+                self._reply(200, {"tenants": r.tenant_union()})
+            elif path == "/api/v1/fleet/stats":
+                self._reply(200, r.fleet_stats())
+            else:
+                m = _TENANT_PATH.match(path)
+                if m:
+                    self._proxy_tenant("GET", m.group(1), None)
+                else:
+                    self._error(404, f"no such endpoint: GET {path}")
+        except (urlerror.URLError, OSError, RuntimeError) as e:
+            self._error(502, f"{type(e).__name__}: {e}")
+
+    # -- the proxy path ---------------------------------------------------
+    def _proxy_tenant(self, method: str, tenant: str,
+                      body: Optional[bytes]) -> None:
+        """Forward one tenant request to its replica, walking the ring's
+        preference order on connection failure (POSTs pin the tenant to
+        a fallback replica so its stream stays in one place) and
+        re-resolving the pin once on a 410 (migration landed between
+        routing and dispatch)."""
+        r = self.router
+        target = self.path  # full path incl. query, verbatim
+        content_type = self.headers.get("Content-Type")
+        r.wait_routable(tenant)
+        last_err: Optional[Exception] = None
+        for round_ in range(2):  # second round only after a 410
+            cands = r.candidates(tenant)
+            if not cands:
+                r.bump("rejected")
+                self._error(503, "no routable replicas")
+                return
+            attempts_left = 1 + (r.retry_max if method == "POST" else 1)
+            for k, ref in enumerate(cands):
+                if attempts_left <= 0:
+                    break
+                attempts_left -= 1
+                try:
+                    status, headers, payload = _http_raw(
+                        method, ref.base_url + target, body, content_type,
+                        timeout=r.proxy_timeout_s)
+                except (urlerror.URLError, OSError) as e:
+                    ref.breaker.record(False)
+                    ref.failures += 1
+                    last_err = e
+                    r.bump("retried")
+                    continue
+                ref.breaker.record(True)
+                ref.requests += 1
+                if status == 410 and round_ == 0:
+                    # the tenant migrated off this replica mid-flight:
+                    # the pin table already knows its new home
+                    r.bump("rerouted")
+                    break
+                if k > 0 and method == "POST":
+                    # landed on a fallback replica: pin the tenant there
+                    # so its stream stays on ONE replica
+                    r.pin(tenant, ref.name)
+                    r.bump("rerouted")
+                r.bump("proxied")
+                fwd = {}
+                if "Retry-After" in headers:
+                    fwd["Retry-After"] = headers["Retry-After"]
+                self._reply_bytes(
+                    status, payload,
+                    headers.get("Content-Type", "application/json"), fwd)
+                return
+            else:
+                break  # candidates exhausted without a 410 — give up
+        r.bump("failed")
+        self._error(502, f"all replicas failed for tenant {tenant!r}"
+                         + (f": {type(last_err).__name__}: {last_err}"
+                            if last_err else " (migration loop)"))
+
+
+class FleetRouter(ThreadingHTTPServer):
+    """The fleet front door: hash ring + pins + health loop + breaker
+    state, bound to a :class:`RouterHandler` pool. ``start()`` spins the
+    serve and health threads and returns self; ``stop()`` tears both
+    down."""
+
+    daemon_threads = True
+
+    def __init__(self, replicas: Dict[str, str], host: str = "127.0.0.1",
+                 port: Optional[int] = None,
+                 verbose: bool = False) -> None:
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.replicas: Dict[str, ReplicaRef] = {
+            name: ReplicaRef(name, url)
+            for name, url in sorted(replicas.items())}
+        self.ring = HashRing(list(self.replicas))
+        self.pins: Dict[str, str] = {}
+        self.verbose = verbose
+        self.retry_max = knobs.get_int("TW_FLEET_RETRY_MAX")
+        self.proxy_timeout_s = knobs.get_float("TW_FLEET_PROXY_TIMEOUT_S")
+        self.health_period_s = knobs.get_float("TW_FLEET_HEALTH_S")
+        self.migrate_timeout_s = knobs.get_float(
+            "TW_FLEET_MIGRATE_TIMEOUT_S")
+        self.counters: Dict[str, int] = dict(
+            proxied=0, rerouted=0, retried=0, failed=0, rejected=0,
+            held=0, migrations=0, restarts=0)
+        self._lock = threading.RLock()
+        self._migrating: Dict[str, threading.Event] = {}
+        self._stop = threading.Event()
+        self._own_threads: List[threading.Thread] = []
+        if port is None:
+            port = knobs.get_int("TW_FLEET_ROUTER_PORT")
+        super().__init__((host, port), RouterHandler)
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    @property
+    def base_url(self) -> str:
+        host = self.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "FleetRouter":
+        for name, fn in (("tw-fleet-router", self.serve_forever),
+                         ("tw-fleet-health", self._health_loop)):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._own_threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.shutdown()
+        self.server_close()
+
+    # -- routing state ----------------------------------------------------
+    def refs(self) -> List[ReplicaRef]:
+        with self._lock:
+            return list(self.replicas.values())
+
+    def bump(self, outcome: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[outcome] = self.counters.get(outcome, 0) + n
+        _OBS_ROUTER.inc(n, outcome=outcome)
+
+    def candidates(self, tenant: str) -> List[ReplicaRef]:
+        """Routable replicas for a tenant in preference order: its pin
+        (if any) first, then the hash ring walk."""
+        with self._lock:
+            order = self.ring.preference(tenant)
+            pin = self.pins.get(tenant)
+            if pin and pin in self.replicas:
+                order = [pin] + [n for n in order if n != pin]
+            return [self.replicas[n] for n in order
+                    if self.replicas[n].routable]
+
+    def pin(self, tenant: str, replica: str) -> None:
+        with self._lock:
+            self.pins[tenant] = replica
+
+    def owner(self, tenant: str) -> str:
+        """The replica currently responsible for a tenant (pin wins,
+        else the ring)."""
+        with self._lock:
+            return self.pins.get(tenant) or self.ring.lookup(tenant)
+
+    def set_draining(self, name: str, flag: bool) -> None:
+        with self._lock:
+            self.replicas[name].draining = flag
+
+    def update_replica(self, name: str, base_url: str) -> None:
+        """Point a replica slot at a restarted process (new ephemeral
+        port); resets its breaker — the fresh process owes no failures."""
+        with self._lock:
+            ref = self.replicas[name]
+            ref.base_url = base_url.rstrip("/")
+            ref.breaker = CircuitBreaker()
+            ref.ready = True
+
+    # -- migration --------------------------------------------------------
+    @contextlib.contextmanager
+    def hold_tenant(self, tenant: str):
+        """Hold (don't fail) the tenant's requests while its state is in
+        flight between replicas; released (and counted) on exit."""
+        ev = threading.Event()
+        with self._lock:
+            self._migrating[tenant] = ev
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._migrating.pop(tenant, None)
+            ev.set()
+
+    def wait_routable(self, tenant: str) -> None:
+        with self._lock:
+            ev = self._migrating.get(tenant)
+        if ev is not None:
+            self.bump("held")
+            ev.wait(timeout=self.migrate_timeout_s)
+
+    def migrate(self, tenant: str, dst: str) -> Dict[str, object]:
+        """Live tenant migration, router-coordinated: hold the tenant's
+        requests, ``migrate_out`` on its current replica, ``migrate_in``
+        on ``dst`` (checkpoint + sink bytes, CRC-verified at both ends),
+        pin the tenant to its new home, release. Zero span loss: open
+        windows ride the checkpoint, requests in the hold window proceed
+        against the new home."""
+        src = self.owner(tenant)
+        if src == dst:
+            return dict(tenant=tenant, src=src, dst=dst, noop=True)
+        with self._lock:
+            src_url = self.replicas[src].base_url
+            dst_url = self.replicas[dst].base_url
+        t0 = time.monotonic()
+        with self.hold_tenant(tenant):
+            status, out = http_json(
+                "POST", f"{src_url}/api/v1/tenants/{tenant}/migrate_out",
+                {}, timeout=self.migrate_timeout_s)
+            if status != 200:
+                raise RuntimeError(
+                    f"migrate_out {tenant!r} on {src}: HTTP {status} "
+                    f"{out.get('error', '')}")
+            status, res = http_json(
+                "POST", f"{dst_url}/api/v1/tenants/{tenant}/migrate_in",
+                out, timeout=self.migrate_timeout_s)
+            if status != 200:
+                raise RuntimeError(
+                    f"migrate_in {tenant!r} on {dst}: HTTP {status} "
+                    f"{res.get('error', '')} — checkpoint bytes remain "
+                    f"on {src}'s disk ({src_url})")
+            self.pin(tenant, dst)
+        self.bump("migrations")
+        wall_s = time.monotonic() - t0
+        _events.emit("fleet", "migrate", tenant=tenant, src=src, dst=dst,
+                     wall_s=round(wall_s, 3),
+                     backlog=res.get("backlog"))
+        out = dict(res)
+        out.update(tenant=tenant, src=src, dst=dst,
+                   wall_s=round(wall_s, 3))
+        return out
+
+    # -- aggregate views --------------------------------------------------
+    def fleet_stats(self, include_replicas: bool = False) -> Dict:
+        with self._lock:
+            out: Dict[str, object] = dict(
+                router=dict(counters=dict(self.counters),
+                            pins=dict(self.pins),
+                            vnodes=self.ring.vnodes,
+                            retry_max=self.retry_max),
+                replicas={name: ref.view()
+                          for name, ref in self.replicas.items()},
+            )
+            refs = list(self.replicas.items())
+        if include_replicas:
+            per_replica = {}
+            for name, ref in refs:
+                try:
+                    status, st = http_json(
+                        "GET", ref.base_url + "/api/v1/stats",
+                        timeout=self.proxy_timeout_s)
+                    per_replica[name] = st if status == 200 else dict(
+                        error=f"HTTP {status}")
+                except (urlerror.URLError, OSError) as e:
+                    per_replica[name] = dict(error=str(e))
+            out["replica_stats"] = per_replica
+        return out
+
+    def tenant_union(self) -> List[str]:
+        tenants = set()
+        for ref in self.refs():
+            if not ref.routable:
+                continue
+            try:
+                status, out = http_json(
+                    "GET", ref.base_url + "/api/v1/tenants",
+                    timeout=self.proxy_timeout_s)
+            except (urlerror.URLError, OSError):
+                continue
+            if status == 200:
+                tenants.update(out.get("tenants", []))
+        return sorted(tenants)
+
+    def flush_all(self) -> Dict[str, object]:
+        """Fan-out seal+solve: POST /api/v1/flush on every routable
+        replica, summed."""
+        sealed = solved = 0
+        per = {}
+        for ref in self.refs():
+            if not ref.routable:
+                continue
+            try:
+                status, out = http_json(
+                    "POST", ref.base_url + "/api/v1/flush", None,
+                    timeout=self.proxy_timeout_s)
+            except (urlerror.URLError, OSError) as e:
+                per[ref.name] = dict(status=0, error=str(e))
+                continue
+            if status == 200:
+                sealed += int(out.get("sealed_windows", 0))
+                solved += int(out.get("solved_windows", 0))
+            per[ref.name] = dict(status=status, **out)
+        return dict(sealed_windows=sealed, solved_windows=solved,
+                    replicas=per)
+
+    # -- health loop ------------------------------------------------------
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_period_s):
+            for ref in self.refs():
+                try:
+                    status, _ = http_json(
+                        "GET", ref.base_url + "/readyz",
+                        timeout=max(0.5, self.health_period_s))
+                    now_ready = status == 200
+                except (urlerror.URLError, OSError):
+                    now_ready = False
+                if now_ready != ref.ready:
+                    _events.emit("fleet", "replica_health",
+                                 replica=ref.name, ready=now_ready)
+                ref.ready = now_ready
+            _OBS_READY.set(float(sum(r.routable for r in self.refs())))

@@ -80,6 +80,8 @@ KNOWN_KINDS = (
     "adapt",              # adaptation-ladder actuations (adapt/)
     "slo_breach",         # seal→emit p99 excursions (stream/serve)
     "serve",              # serve-layer lifecycle (dispatcher degradation)
+    "fleet",              # fleet tier: router health/breaker, tenant
+                          # migrations, rolling restarts (fleet_serve/)
     "campaign",           # campaign harness start/rung/finish (campaign/)
     "capture_loss",       # capture ingress losses per reason
     "capture_churn",      # connection re-keying (collector/source.py)

@@ -459,6 +459,15 @@ def main(argv=None) -> int:
         from traceweaver_tpu.metrics.scorecard import main as scorecard_main
 
         return scorecard_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # replica fleet tier (docs/SERVING.md): router + N replica serve
+        # subprocesses, live migration, rolling restarts, wire campaign.
+        # Pure host here — NO jax import in the router process; each
+        # replica subprocess owns its own backend bring-up (mesh, AOT
+        # warmup, persistent cache) through its own `serve` dispatch
+        from traceweaver_tpu.fleet_serve import main as fleet_main
+
+        return fleet_main(argv[1:])
     if argv and argv[0] == "serve":
         # network service mode: same backend discipline as `stream`
         import jax

@@ -187,6 +187,36 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        help="per-tenant seal→emit latency SLO (p99, milliseconds): the "
             "continuous-batching scheduler admits SLO-at-risk windows "
             "ahead of batch-fill efficiency"),
+    # --- fleet serve tier (traceweaver_tpu/fleet_serve, docs/SERVING.md) -
+    _k("TW_FLEET_REPLICAS", "int", 2, lo=1, hi=64,
+       help="replica count for `cli fleet`: serve processes the router "
+            "consistent-hashes tenants onto (each with its own mesh/AOT "
+            "warmup and state dir)"),
+    _k("TW_FLEET_ROUTER_PORT", "int", 8320, lo=0, hi=65535,
+       help="fleet router listen port (0 = ephemeral, the test mode)"),
+    _k("TW_FLEET_MIGRATE_TIMEOUT_S", "float", 60.0, lo=0.1, hi=3600.0,
+       help="live tenant migration budget: checkpoint-transfer-resume "
+            "must land inside it, and requests for the migrating tenant "
+            "are held at the router at most this long"),
+    _k("TW_FLEET_RETRY_MAX", "int", 2, lo=0, hi=16,
+       help="router retry bound: a failed in-flight POST is retried on "
+            "the next replica in ring order at most this many times "
+            "(counted, never silent)"),
+    _k("TW_FLEET_VNODES", "int", 64, lo=1, hi=4096,
+       help="consistent-hash virtual nodes per replica (more = smoother "
+            "tenant spread, larger ring)"),
+    _k("TW_FLEET_BREAKER_FAILS", "int", 3, lo=1, hi=100,
+       help="consecutive proxy failures that open a replica's circuit "
+            "breaker (the replica drops out of routing)"),
+    _k("TW_FLEET_BREAKER_COOLDOWN_S", "float", 5.0, lo=0.1, hi=600.0,
+       help="circuit-open cooldown before a tripped replica re-enters "
+            "routing"),
+    _k("TW_FLEET_HEALTH_S", "float", 1.0, lo=0.05, hi=60.0,
+       help="router health-check period: each replica's /readyz is "
+            "probed this often"),
+    _k("TW_FLEET_PROXY_TIMEOUT_S", "float", 120.0, lo=0.1, hi=3600.0,
+       help="per-attempt proxy timeout for requests forwarded to a "
+            "replica (a cold first solve can be slow on CPU)"),
     # --- online adaptation (traceweaver_tpu/adapt, docs/ROBUSTNESS.md) ---
     _k("TW_ADAPT", "bool", False,
        help="1 arms the drift→adapt controller: PSI/low-confidence "

@@ -10,6 +10,8 @@ Endpoint reference (full table + curl quickstart in docs/SERVING.md)::
     POST /api/v1/tenants/<id>/spans                Jaeger-JSON {"data": [...]}
     POST /api/v1/tenants/<id>/capture              raw strace log (?source=)
     POST /api/v1/tenants/<id>/flush                seal+solve now (one tenant)
+    POST /api/v1/tenants/<id>/migrate_out          live migration, source half
+    POST /api/v1/tenants/<id>/migrate_in           live migration, dest half
     POST /api/v1/flush                             seal+solve now (all)
     GET  /api/v1/tenants                           tenant list
     GET  /api/v1/tenants/<id>/traces               recent trace ids (ring)
@@ -29,8 +31,11 @@ Endpoint reference (full table + curl quickstart in docs/SERVING.md)::
 
 Error mapping: bad JSON / malformed payloads (strict mode) -> 400,
 unknown tenant or trace -> 404, tenant cap / invalid tenant id -> 429 /
-400 (:class:`TenancyError`), everything else -> 500 with the exception
-name (never a silent hang).
+400 (:class:`TenancyError`), tenant migrated off this replica -> 410
+(the fleet router re-resolves its pin), saturated per-tenant queues ->
+429 with a ``Retry-After`` header derived from the backlog and drain
+pace, everything else -> 500 with the exception name (never a silent
+hang).
 """
 
 from __future__ import annotations
@@ -67,11 +72,14 @@ class ServeHandler(BaseHTTPRequestHandler):
         if self.service.cfg.verbose:
             super().log_message(fmt, *args)
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict,
+               headers: Optional[dict] = None) -> None:
         body = json.dumps(payload, sort_keys=True).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, str(value))
         self.end_headers()
         self.wfile.write(body)
 
@@ -83,8 +91,19 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _error(self, code: int, message: str) -> None:
-        self._reply(code, {"error": message})
+    def _error(self, code: int, message: str,
+               headers: Optional[dict] = None) -> None:
+        self._reply(code, {"error": message}, headers=headers)
+
+    def _tenancy_error(self, e: TenancyError) -> None:
+        """TenancyError -> status: migrated-out tenants are 410 Gone
+        (the fleet router re-resolves the tenant's pin), the tenant cap
+        is 429, everything else (bad id, bad transfer) is 400."""
+        msg = str(e)
+        if "migrated out" in msg:
+            self._error(410, msg)
+        else:
+            self._error(429 if "cap" in msg else 400, msg)
 
     def _read_body(self, expected: str) -> Optional[bytes]:
         try:
@@ -124,6 +143,21 @@ class ServeHandler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
         tenant_id, sub, query = self._tenant_route()
         try:
+            if tenant_id is not None and sub in ("/spans", "/capture"):
+                # explicit backpressure (docs/SERVING.md): a tenant whose
+                # pending+spill queues are saturated would DROP the next
+                # sealed window — refuse the POST instead, with a
+                # Retry-After derived from the backlog and the tenant's
+                # observed drain pace, so closed-loop clients back off
+                wait_s = self.service.retry_after(tenant_id)
+                if wait_s is not None:
+                    self._error(
+                        429,
+                        f"tenant {tenant_id!r} backpressured: sealed-"
+                        "window queues full; retry after "
+                        f"{wait_s:.0f}s",
+                        headers={"Retry-After": max(1, int(round(wait_s)))})
+                    return
             if tenant_id is not None and sub == "/spans":
                 payload = self._read_json()
                 if payload is None:
@@ -160,12 +194,22 @@ class ServeHandler(BaseHTTPRequestHandler):
             elif tenant_id is not None and sub == "/flush":
                 self.service.tenant(tenant_id, create=False)
                 self._reply(200, self.service.flush(tenant_id))
+            elif tenant_id is not None and sub == "/migrate_out":
+                # live tenant migration, source half (fleet_serve/):
+                # checkpoint + sink bytes out, tenant tombstoned here
+                self._reply(200, self.service.migrate_out(tenant_id))
+            elif tenant_id is not None and sub == "/migrate_in":
+                transfer = self._read_json()
+                if transfer is None:
+                    return
+                self._reply(200, self.service.migrate_in(
+                    tenant_id, transfer))
             elif tenant_id is None and sub == "/api/v1/flush":
                 self._reply(200, self.service.flush())
             else:
                 self._error(404, f"no such endpoint: POST {sub or self.path}")
         except TenancyError as e:
-            self._error(429 if "cap" in str(e) else 400, str(e))
+            self._tenancy_error(e)
         except MalformedSpan as e:
             self._error(400, f"malformed payload: {e}")
         except KeyError:
@@ -186,7 +230,15 @@ class ServeHandler(BaseHTTPRequestHandler):
                     # until this flips to 200 — i.e. until the AOT shape
                     # lattice tier is compiled and the first real solve
                     # cannot stall on a cold jit. TW_AOT=off = always
-                    # ready (nothing is gated).
+                    # ready (nothing is gated). A DRAINING server is
+                    # never ready: the SIGTERM handler flips
+                    # service.draining before the listener closes, so
+                    # routers stop sending to a dying replica instead of
+                    # racing its socket teardown.
+                    if self.service.draining:
+                        self._reply(503, {"ready": False, "draining": True,
+                                          "reason": "drain in progress"})
+                        return
                     from traceweaver_tpu.runtime import aot as _aot
 
                     ready, detail = _aot.readiness()
@@ -249,6 +301,8 @@ class ServeHandler(BaseHTTPRequestHandler):
                 self._error(404, f"no such endpoint: GET {sub}")
         except KeyError:
             self._error(404, f"unknown tenant {tenant_id!r}")
+        except TenancyError as e:
+            self._tenancy_error(e)
         except ValueError as e:
             self._error(400, str(e))
         except Exception as e:  # noqa: BLE001
@@ -290,6 +344,11 @@ def run_server(service: TenantService, host: str, port: int,
         if verbose:
             print(f"[serve] signal {signum}: draining "
                   f"({service.cfg.drain_timeout_s:.0f}s budget)")
+        # readiness flips FIRST: /readyz answers 503 for every request
+        # that still lands while the listener winds down, so a router's
+        # health probe (or a rolling-restart gate) stops routing here
+        # before the socket disappears
+        service.begin_drain()
         stop.set()
         # shutdown() must run off the serve_forever thread
         threading.Thread(target=server.shutdown, daemon=True).start()

@@ -44,6 +44,8 @@ See docs/SERVING.md for the operator view and the HTTP surface
 
 from __future__ import annotations
 
+import base64
+import json
 import os
 import re
 import threading
@@ -59,7 +61,12 @@ from traceweaver_tpu.ops.precision import precision_from_env
 from traceweaver_tpu.query.delay_culprit import live_delay_culprit
 from traceweaver_tpu.runtime import knobs
 from traceweaver_tpu.serve.ring import TraceRing, build_trace_records
-from traceweaver_tpu.stream.checkpoint import load_checkpoint, save_checkpoint
+from traceweaver_tpu.stream.checkpoint import (
+    load_checkpoint,
+    read_checkpoint_bytes,
+    save_checkpoint,
+    write_checkpoint_bytes,
+)
 from traceweaver_tpu.stream.service import (
     StreamConfig,
     StreamingReconstructor,
@@ -68,6 +75,11 @@ from traceweaver_tpu.stream.service import (
 from traceweaver_tpu.stream.sources import SpanEvent
 
 _TENANT_ID_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
+
+#: durable migration tombstone, one per moved-out tenant dir: survives a
+#: restart so ``TenantService.resume`` re-tombstones instead of minting
+#: a forked twin from whatever files the tenant left behind
+MIGRATED_MARKER = "migrated_out.json"
 
 # obs registry mirrors (docs/OBSERVABILITY.md): per-tenant counters and
 # the service-wide pump ledger. /metrics does NOT scrape these mirrors
@@ -548,6 +560,18 @@ class TenantService:
         self.tenants: Dict[str, Tenant] = {}
         self._lock = threading.RLock()
         self.precision = precision_from_env()
+        # drain-aware readiness (the rolling-restart contract): flipped
+        # by begin_drain() the instant a SIGTERM drain starts, so
+        # /readyz stops advertising a dying replica BEFORE the listener
+        # closes — the fleet router routes around it with zero failed
+        # POSTs instead of racing the socket teardown
+        self.draining = False
+        # live-migration tombstones (fleet_serve): a tenant moved off
+        # this replica must not silently resurrect here on a late POST —
+        # that would fork its stream state across replicas. Requests for
+        # a tombstoned tenant get a TenancyError the HTTP layer maps to
+        # 410 so the router re-resolves the tenant's pin.
+        self.migrated_out: Dict[str, float] = {}
         # shared-dispatch ledger: every healthy tenant's windows ride the
         # solve_fleet calls accounted here; the tenant id column breaks
         # the totals down per tenant (tenant_windows_* buckets)
@@ -583,6 +607,10 @@ class TenantService:
         with self._lock:
             t = self.tenants.get(tenant_id)
             if t is None:
+                if tenant_id in self.migrated_out:
+                    raise TenancyError(
+                        f"tenant {tenant_id!r} migrated out of this "
+                        "replica (route to its new home)")
                 if not create:
                     raise KeyError(tenant_id)
                 if len(self.tenants) >= self.cfg.max_tenants:
@@ -747,6 +775,12 @@ class TenantService:
             shared: List[Tuple[Tenant, List]] = []
             isolated: List[Tuple[Tenant, List]] = []
             for t, bufs in plan:
+                if self.tenants.get(t.id) is not t:
+                    # admitted, then migrated out (or evicted) before the
+                    # take: the windows rode the transfer checkpoint to
+                    # the destination replica — solving them here would
+                    # double-emit into a closed tenant
+                    continue
                 taken = t.svc.scheduler.take(bufs)
                 if taken:
                     (isolated if t.fault_spec else shared).append((t, taken))
@@ -916,6 +950,191 @@ class TenantService:
         return dict(checkpointed=done, skipped=skipped,
                     timed_out=timed_out)
 
+    def begin_drain(self) -> None:
+        """Mark the service draining: ``/readyz`` answers 503 from this
+        instant on. Called by the SIGTERM handler BEFORE the listener
+        shuts down (and by :meth:`drain` itself for direct callers), so
+        orchestrators and the fleet router stop routing to a dying
+        replica while it is still serving in-flight requests."""
+        with self._lock:
+            if self.draining:
+                return
+            self.draining = True
+        _events.emit("serve", "draining")
+
+    # -- live tenant migration (the fleet tier, fleet_serve/) -------------
+    def retry_after(self, tenant_id: str) -> Optional[float]:
+        """Suggested client back-off (seconds) when this tenant's
+        sealed-window queues are SATURATED — within a small headroom of
+        the hard pending+spill bound — else ``None``. The headroom
+        exists because sealing is bursty: one accepted POST can advance
+        the watermark past several open windows (window/overlap
+        geometry), and a flush force-seals every open window, so an
+        admission check against the exact bound lets the burst overflow
+        into dropped windows. Derived from the backlog depth and the
+        tenant's observed seal→emit latency, so the ``Retry-After``
+        header tracks real drain time instead of a constant. Kicks the
+        continuous dispatcher so the advertised wait is actually in
+        motion."""
+        with self._lock:
+            t = self.tenants.get(tenant_id)
+            if t is None:
+                return None
+            sched = t.svc.scheduler
+            # headroom ≥ the worst-case seal burst (a monotonic stream
+            # keeps ≤2 windows open — owner + overlap neighbor — so one
+            # accepted POST can seal 2), capped so the threshold never
+            # drops below one queued window
+            bound = sched.max_pending + sched.spill_max
+            headroom = min(4, bound - 1)
+            if sched.backlog < bound - headroom:
+                return None
+            self._bump("backpressure_429s")
+            # per-window drain pace from the tenant's own latency ledger
+            # (1s floor before any window has solved)
+            pace_s = max(0.05, (t.svc.seal_emit_p99_ms() or 1000.0)
+                         / 1000.0)
+            wait = max(1.0, min(sched.backlog * pace_s,
+                                self.cfg.drain_timeout_s))
+        if self.dispatcher is not None:
+            self.dispatcher.kick()
+        return round(wait, 1)
+
+    def migrate_out(self, tenant_id: str) -> Dict[str, object]:
+        """Source half of live tenant migration: checkpoint the tenant
+        (open windows, ring, counters — the SIGTERM-drain durability
+        story, nothing sealed early), read back the CRC-verified
+        checkpoint plus the sink/dead-letter bytes the checkpoint's
+        byte-offset splice refers to, then remove and tombstone the
+        tenant here. Returns the JSON-safe transfer payload
+        ``migrate_in`` installs on the destination replica.
+
+        Zero loss by construction: every ingested-but-unsolved window
+        rides the checkpoint; every emitted byte rides the sink copy;
+        the tombstone stops this replica minting a forked twin. Windows
+        a continuous dispatch has TAKEN but not yet retired sit in
+        neither scheduler queue (solve_admitted drops the lock around
+        the device dispatch), so checkpointing mid-dispatch would lose
+        them — the wait below holds the migration until the tenant's
+        in-flight set is empty (the dispatch's consume/emit runs under
+        the lock and clears it), bounded by the drain budget."""
+        deadline = time.monotonic() + self.cfg.drain_timeout_s
+        while True:
+            with self._lock:
+                t = self.tenant(tenant_id, create=False)  # KeyError -> 404
+                if not t.in_flight:
+                    return self._migrate_out_locked(tenant_id, t)
+            if time.monotonic() >= deadline:
+                raise TenancyError(
+                    f"tenant {tenant_id!r}: in-flight dispatch did not "
+                    f"retire within the drain budget "
+                    f"({self.cfg.drain_timeout_s:.0f}s, TW_SERVE_DRAIN_S)"
+                    "; migration aborted (tenant stays live here)")
+            time.sleep(0.02)
+
+    def _migrate_out_locked(self, tenant_id: str,
+                            t: "Tenant") -> Dict[str, object]:
+        """The checkpoint-and-tombstone half of :meth:`migrate_out`.
+        Caller holds the service lock and has verified ``t.in_flight``
+        is empty (nothing taken off the queues mid-solve)."""
+        if not t.ckpt_path:
+            raise TenancyError(
+                "live migration requires a state dir (per-tenant "
+                "checkpoints are the transfer unit); restart serve "
+                "with --state-dir")
+        if not t.checkpoint():
+            raise RuntimeError(
+                f"tenant {tenant_id!r}: checkpoint write failed; "
+                "migration aborted (tenant stays live here)")
+        ckpt = read_checkpoint_bytes(t.ckpt_path)
+        sink_b = b""
+        if t.svc.sink is not None:
+            with open(t.svc.sink.path, "rb") as f:
+                sink_b = f.read()
+        dlq_b = b""
+        if (t.svc.deadletter is not None
+                and os.path.exists(t.svc.deadletter.path)):
+            with open(t.svc.deadletter.path, "rb") as f:
+                dlq_b = f.read()
+        t.close()
+        del self.tenants[tenant_id]
+        now = time.time()
+        # twlint: disable=TW005 — caller (migrate_out) holds the
+        # service lock across this whole helper
+        self.migrated_out[tenant_id] = now
+        # neutralize the on-disk state: a restart with --resume must
+        # NOT resurrect the moved tenant from its leftover checkpoint
+        # (a forked twin of the stream now live elsewhere). The
+        # checkpoint generations go; a durable tombstone marker stays
+        # so resume() re-tombstones instead of forgetting.
+        for path in (t.ckpt_path, t.ckpt_path + ".prev"):
+            if os.path.exists(path):
+                os.remove(path)
+        with open(os.path.join(t.dir, MIGRATED_MARKER), "w") as f:
+            json.dump({"tenant": tenant_id, "migrated_unix": now}, f)
+        self._bump("migrations_out")
+        _events.emit("fleet", "migrate_out", tenant=tenant_id,
+                     checkpoint_bytes=len(ckpt), sink_bytes=len(sink_b))
+        return dict(
+            tenant=tenant_id,
+            checkpoint_b64=base64.b64encode(ckpt).decode("ascii"),
+            sink_b64=base64.b64encode(sink_b).decode("ascii"),
+            deadletter_b64=base64.b64encode(dlq_b).decode("ascii"),
+        )
+
+    def migrate_in(self, tenant_id: str,
+                   transfer: Dict[str, object]) -> Dict[str, object]:
+        """Destination half: install the transferred sink/dead-letter
+        bytes and the CRC-verified checkpoint under this replica's state
+        dir, then resume the tenant exactly like a restart would — the
+        checkpoint's offset splice truncates the sink back to the
+        checkpointed byte, so the migrated tenant's emitted output stays
+        byte-identical to an unmigrated run."""
+        if not self.cfg.state_dir:
+            raise TenancyError(
+                "live migration requires a state dir on the destination "
+                "replica too; restart serve with --state-dir")
+        try:
+            ckpt = base64.b64decode(transfer["checkpoint_b64"])
+            sink_b = base64.b64decode(transfer.get("sink_b64", "") or "")
+            dlq_b = base64.b64decode(
+                transfer.get("deadletter_b64", "") or "")
+        except (KeyError, TypeError, ValueError) as e:
+            raise TenancyError(f"malformed migration transfer: {e}")
+        with self._lock:
+            if tenant_id in self.tenants:
+                raise TenancyError(
+                    f"tenant {tenant_id!r} already live on this replica: "
+                    "refusing migrate_in (forked state)")
+            if len(self.tenants) >= self.cfg.max_tenants:
+                raise TenancyError(
+                    f"tenant cap reached ({self.cfg.max_tenants}, "
+                    "TW_SERVE_MAX_TENANTS): refusing migrated tenant "
+                    f"{tenant_id!r}")
+            tdir = os.path.join(self.cfg.state_dir, tenant_id)
+            os.makedirs(tdir, exist_ok=True)
+            sink_path = os.path.join(tdir, "traces.jsonl")
+            with open(sink_path, "wb") as f:
+                f.write(sink_b)
+            with open(sink_path + ".deadletter.jsonl", "wb") as f:
+                f.write(dlq_b)
+            write_checkpoint_bytes(os.path.join(tdir, "ckpt.pkl"), ckpt)
+            # a returning tenant clears any tombstone it left behind here
+            marker = os.path.join(tdir, MIGRATED_MARKER)
+            if os.path.exists(marker):
+                os.remove(marker)
+            t = Tenant.resume(tenant_id, self.cfg)
+            self.tenants[tenant_id] = t
+            self.migrated_out.pop(tenant_id, None)
+            self._bump("migrations_in")
+            backlog = t.backlog
+        if self.dispatcher is not None:
+            self.dispatcher.kick()
+        _events.emit("fleet", "migrate_in", tenant=tenant_id,
+                     backlog=backlog)
+        return dict(tenant=tenant_id, backlog=backlog,
+                    ring_traces=len(t.ring))
+
     def drain(self) -> Dict[str, int]:
         """Graceful drain (the SIGTERM path): stop the continuous
         dispatcher (no new admissions), checkpoint every tenant within
@@ -923,6 +1142,7 @@ class TenantService:
         checkpoints — a restart resumes every tenant with zero lost
         windows (tests/test_stream.py pins byte-identical per-tenant
         resume)."""
+        self.begin_drain()
         if self.dispatcher is not None:
             self.dispatcher.stop()
         with self._lock:
@@ -939,9 +1159,22 @@ class TenantService:
         if cfg.state_dir and os.path.isdir(cfg.state_dir):
             for name in sorted(os.listdir(cfg.state_dir)):
                 ckpt = os.path.join(cfg.state_dir, name, "ckpt.pkl")
+                marker = os.path.join(cfg.state_dir, name, MIGRATED_MARKER)
                 if os.path.isfile(ckpt):
                     with svc._lock:
                         svc.tenants[name] = Tenant.resume(name, cfg)
+                elif os.path.isfile(marker):
+                    # migrated-out tombstone survives restarts: the
+                    # tenant lives on another replica now — requests
+                    # here must keep answering 410, not mint a twin
+                    try:
+                        with open(marker) as f:
+                            ts = float(json.load(f).get(
+                                "migrated_unix", 0.0))
+                    except (ValueError, OSError):
+                        ts = 0.0
+                    with svc._lock:
+                        svc.migrated_out[name] = ts
         return svc
 
     # -- query surface ----------------------------------------------------
@@ -1071,7 +1304,15 @@ class TenantService:
                         self.stats_counters.get("adapt_refits", 0)),
                     dispatcher_crashes=int(
                         self.stats_counters.get("dispatcher_crashes", 0)),
+                    migrations_out=int(
+                        self.stats_counters.get("migrations_out", 0)),
+                    migrations_in=int(
+                        self.stats_counters.get("migrations_in", 0)),
+                    backpressure_429s=int(
+                        self.stats_counters.get("backpressure_429s", 0)),
                 ),
+                draining=self.draining,
+                migrated_out=sorted(self.migrated_out),
                 dispatcher_degraded=self.dispatcher_degraded,
                 continuous=(self.dispatcher.stats()
                             if self.dispatcher is not None else None),

@@ -99,26 +99,63 @@ def save_checkpoint(path: str, state: Dict) -> None:
     os.replace(tmp, path)
 
 
-def _load_one(path: str) -> Dict:
-    """Read + verify one checkpoint file (v2 trailer or bare v1 pickle).
-    Raises :class:`CheckpointCorrupt` on any integrity failure."""
-    with open(path, "rb") as f:
-        raw = f.read()
+def verify_checkpoint_bytes(raw: bytes, label: str = "<bytes>") -> bytes:
+    """Trailer integrity check over in-memory checkpoint bytes; returns
+    the pickle payload (trailer stripped). The checkpoint-transfer
+    surface for live tenant migration (``traceweaver_tpu/fleet_serve``):
+    both ends of a cross-process checkpoint copy run this, so a torn
+    read is refused at the SOURCE and a torn transfer at the
+    DESTINATION — never installed as a replica's resume state.
+    Version-1 bytes (no trailer) pass through unverified, same as
+    :func:`load_checkpoint`."""
     if len(raw) >= _TRAILER.size and raw[-_TRAILER.size:][:4] == _MAGIC:
         magic, crc, length = _TRAILER.unpack(raw[-_TRAILER.size:])
         payload = raw[:-_TRAILER.size]
         if length != len(payload):
             raise CheckpointCorrupt(
-                f"checkpoint {path}: trailer says {length} payload bytes, "
-                f"file has {len(payload)} (truncated or overwritten)")
+                f"checkpoint {label}: trailer says {length} payload bytes, "
+                f"got {len(payload)} (truncated or overwritten)")
         if zlib.crc32(payload) != crc:
             raise CheckpointCorrupt(
-                f"checkpoint {path}: CRC mismatch (bit rot or torn write)")
-    else:
-        # no trailer: either a version-1 checkpoint (legal, pre-integrity
-        # format) or a truncation that ate the trailer — the pickle load
-        # below distinguishes (a truncated pickle cannot load)
-        payload = raw
+                f"checkpoint {label}: CRC mismatch (bit rot or torn write)")
+        return payload
+    # no trailer: either a version-1 checkpoint (legal, pre-integrity
+    # format) or a truncation that ate the trailer — a pickle load
+    # distinguishes (a truncated pickle cannot load)
+    return raw
+
+
+def read_checkpoint_bytes(path: str) -> bytes:
+    """Read a checkpoint file verbatim for transfer, verifying the CRC
+    trailer first (the migrate_out half of the transfer surface)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    verify_checkpoint_bytes(raw, label=path)
+    return raw
+
+
+def write_checkpoint_bytes(path: str, raw: bytes) -> None:
+    """Install transferred checkpoint bytes (the migrate_in half):
+    verify the trailer, then the same fsync + keep-last-good rotation +
+    atomic rename discipline as :func:`save_checkpoint`."""
+    verify_checkpoint_bytes(raw, label=path)
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, "wb") as f:
+        f.write(raw)
+        f.flush()
+        os.fsync(f.fileno())
+    if os.path.exists(path):
+        os.replace(path, path + ".prev")
+    os.replace(tmp, path)
+
+
+def _load_one(path: str) -> Dict:
+    """Read + verify one checkpoint file (v2 trailer or bare v1 pickle).
+    Raises :class:`CheckpointCorrupt` on any integrity failure."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    payload = verify_checkpoint_bytes(raw, label=path)
     try:
         state = pickle.loads(payload)
     except Exception as e:
