@@ -422,7 +422,8 @@ def run_solver_child(bundle_path: str, out_path: str) -> None:
         "accuracy_per_service": {k: round(v, 4) for k, v in accs.items()},
         "stage_seconds": {
             k: round(stage_stats.get(k, 0.0), 3)
-            for k in ("pack_s", "dispatch_s", "wait_s", "decode_s", "refit_s")
+            for k in ("pack_s", "dispatch_s", "wait_s", "decode_s",
+                      "refit_s", "plan_fit_s")
         },
         "fused_em_dispatches": int(stage_stats.get("fused_em_applied", 0)),
         # recompile accounting (runtime/jax_cache counters): the timed

@@ -354,6 +354,39 @@ def test_pipelined_fleet_runs_and_second_solve_is_compile_free():
         assert a[0] == b[0] and a[1] == b[1] and a[2:] == b[2:]
 
 
+@pytest.mark.plan
+def test_warm_plan_cache_solve_is_compile_free():
+    """Tier-1 plan-cache smoke (ISSUE 17 acceptance pin): with a warm
+    plan cache the fleet solve skips the host fit AND the first EM pass
+    (single warm-pass dispatch), and a second warm solve costs zero
+    backend compiles — the cached plan must ride the same pow2-bucketed
+    AOT shape classes as the cold path, not mint new program variants.
+    Output stays bit-identical to the cold two-pass solve (the cached
+    plan IS the decoded on-device refit table that pass already used)."""
+    from test_pipeline import _mixed_items
+
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+    from traceweaver_tpu.algorithms.plancache import PlanCache
+
+    pc = PlanCache()
+    cold = solve_fleet(_mixed_items(), stats={}, plan_cache=pc)
+    assert pc.counters()["admissions"] == 3
+
+    # first warm solve may compile the single-pass variant once; the
+    # measured second warm solve must dispatch entirely from cache
+    warm1 = solve_fleet(_mixed_items(), stats={}, plan_cache=pc)
+    before = compile_counters()
+    warm2 = solve_fleet(_mixed_items(), stats={}, plan_cache=pc)
+    delta = counters_delta(before)
+    assert delta["backend_compiles"] == 0, (
+        "warm plan-cache solve recompiled — the cached plan is escaping "
+        f"the AOT shape lattice: {delta}")
+    assert pc.counters()["hits"] == 6
+    for a, b, c in zip(cold, warm1, warm2):
+        assert a[0] == b[0] == c[0] and a[1] == b[1] == c[1]
+        assert a[2:] == b[2:] == c[2:]
+
+
 @pytest.mark.collector
 def test_capture_smoke_strace_to_traces_roundtrip(tmp_path):
     """Tier-1 capture smoke (ISSUE 13 acceptance pin): a recorded

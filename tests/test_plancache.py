@@ -1,0 +1,320 @@
+"""Amortized plan cache tests (ISSUE 17, tier-1, CPU).
+
+Contracts covered:
+
+- :class:`~traceweaver_tpu.algorithms.plancache.PlanCache` semantics —
+  hit/miss/admit/invalidate counting, the ``TW_PLAN_CACHE=0`` kill
+  switch, the ``TW_PLAN_MIN_SAMPLES`` streaming admission bar, and the
+  checkpoint ``state()``/``from_state()`` round trip;
+- fleet integration — a warm cache collapses the two-pass EM to a
+  single warm pass with BIT-IDENTICAL output, and the kill switch
+  restores the uncached solve byte-for-byte;
+- drift targeting — the adapt controller's actuations invalidate
+  exactly the drifting service's entry, nothing else;
+- stream integration — high-volume windows amortize the per-window
+  refit (hits counted on ``/metrics`` and the stream ledger) while
+  thin windows NEVER admit, keeping the warm-start feedback loop and
+  the PR 12 PSI drift sensor running the pre-cache program (the
+  chaos-adapt recovery story in tests/test_adapt.py depends on it);
+- the satellite-2 precision pin — ``ops/gmm.fit_gmm_sharded``'s f32
+  z-space EM against the host f64 ``from_samples_gmm`` fit at
+  large-magnitude means (the bounded-deviation claim documented at
+  ops/gmm.py:131-135, previously untested).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from traceweaver_tpu.algorithms.plancache import PlanCache, admissible
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.plan
+
+
+# ---------------------------------------------------------------------------
+# cache unit semantics
+# ---------------------------------------------------------------------------
+
+def test_cache_hit_miss_invalidate_counting():
+    pc = PlanCache()
+    assert pc.lookup("svc") is None
+    plan = {("in", "a"): "dists-sentinel"}
+    pc.admit("svc", plan)
+    assert pc.lookup("svc") is plan
+    assert len(pc) == 1
+    pc.invalidate("svc")
+    assert pc.lookup("svc") is None
+    # empty plans are never admitted (a failed fit must not poison)
+    pc.admit("svc", {})
+    pc.admit("svc", None)
+    assert len(pc) == 0
+    assert pc.counters() == dict(hits=1, misses=2, admissions=1,
+                                 invalidations=1, entries=0)
+    # invalidate(None) clears everything
+    pc.admit("a", plan)
+    pc.admit("b", plan)
+    pc.invalidate(None)
+    assert len(pc) == 0 and pc.counters()["invalidations"] == 2
+
+
+def test_kill_switch_makes_cache_inert(monkeypatch):
+    monkeypatch.setenv("TW_PLAN_CACHE", "0")
+    pc = PlanCache()
+    pc.admit("svc", {("in", "a"): "x"})
+    assert pc.lookup("svc") is None
+    assert len(pc) == 0
+    # disabled lookups/admits are not even counted: the disabled path
+    # must be indistinguishable from a build without the cache
+    assert pc.counters() == dict(hits=0, misses=0, admissions=0,
+                                 invalidations=0, entries=0)
+
+
+def test_state_roundtrip_preserves_entries_and_counters():
+    pc = PlanCache()
+    plan = {("in", "a"): "dists-sentinel"}
+    pc.admit("svc", plan)
+    pc.lookup("svc")
+    pc.lookup("ghost")
+    pc.invalidate("ghost")
+    pc2 = PlanCache.from_state(pc.state())
+    assert pc2.lookup("svc") == plan
+    c, c2 = pc.counters(), pc2.counters()
+    for k in ("misses", "admissions", "invalidations", "entries"):
+        assert c2[k] == c[k], (k, c, c2)
+    assert PlanCache.from_state(None).counters()["entries"] == 0
+
+
+def test_admission_bar_tracks_knob(monkeypatch):
+    assert admissible(64) and admissible(1000)
+    assert not admissible(63) and not admissible(0)
+    monkeypatch.setenv("TW_PLAN_MIN_SAMPLES", "8")
+    assert admissible(8) and not admissible(7)
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: warm pass equivalence + kill switch
+# ---------------------------------------------------------------------------
+
+def _identical(a, b):
+    for x, y in zip(a, b):
+        assert x[0] == y[0] and x[1] == y[1] and x[2:] == y[2:]
+
+
+def test_fleet_warm_cache_single_pass_bit_identical():
+    """The cached plan is the decoded on-device refit tables of the cold
+    solve's two-pass EM; a warm solve packs them back and runs ONE pass
+    whose output must be bit-identical to the cold solve's second pass
+    (f32 -> f64 -> f32 round-trips exactly; unsampled edges keep the
+    wide defaults the in-graph refit preserves)."""
+    from test_pipeline import _mixed_items
+
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+    pc = PlanCache()
+    cold_stats = {}
+    cold = solve_fleet(_mixed_items(), stats=cold_stats, plan_cache=pc)
+    c = pc.counters()
+    assert c["admissions"] == 3 and c["hits"] == 0 and c["misses"] == 3
+    assert cold_stats.get("plan_fit_s", 0) > 0
+
+    warm = solve_fleet(_mixed_items(), stats={}, plan_cache=pc)
+    assert pc.counters()["hits"] == 3
+    _identical(cold, warm)
+
+    # targeted invalidation refits ONLY the voided service
+    pc.invalidate("beta")
+    again = solve_fleet(_mixed_items(), stats={}, plan_cache=pc)
+    c = pc.counters()
+    assert c["misses"] == 4 and c["admissions"] == 4, c
+    _identical(cold, again)
+
+
+def test_fleet_kill_switch_restores_uncached_solve(monkeypatch):
+    from test_pipeline import _mixed_items
+
+    from traceweaver_tpu.algorithms.fleet import solve_fleet
+
+    plain = solve_fleet(_mixed_items(), stats={})
+    monkeypatch.setenv("TW_PLAN_CACHE", "0")
+    pc = PlanCache()
+    off = solve_fleet(_mixed_items(), stats={}, plan_cache=pc)
+    _identical(plain, off)
+    assert pc.counters() == dict(hits=0, misses=0, admissions=0,
+                                 invalidations=0, entries=0)
+
+
+# ---------------------------------------------------------------------------
+# drift targeting: controller actuations void exactly one key
+# ---------------------------------------------------------------------------
+
+def test_controller_invalidates_only_the_drifting_service(monkeypatch):
+    monkeypatch.setenv("TW_RETRY_BACKOFF_S", "0")
+    from traceweaver_tpu.adapt.controller import AdaptationController
+
+    pc = PlanCache()
+    plan = {("in", "a"): "x"}
+    pc.admit("svcA", plan)
+    pc.admit("svcB", plan)
+    ctrl = AdaptationController()
+    ctrl.invalidate_cb = pc.invalidate
+    ctrl.observe("svcA", psi=9.9)           # excursion -> refit scheduled
+    assert pc.lookup("svcA") is None         # voided
+    assert pc.lookup("svcB") == plan         # untouched
+    assert pc.counters()["invalidations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# stream integration: volume-gated amortization + telemetry
+# ---------------------------------------------------------------------------
+
+def _burst_stream(n_bursts, n_req, gap_us, **cfg_kw):
+    import bench
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+    )
+    from traceweaver_tpu.stream.sources import IterableSource
+
+    events, _ = bench._adapt_burst_events(
+        n_bursts, shift_at=10 ** 9, n_req=n_req, gap_us=gap_us)
+    cfg = StreamConfig(window_us=1e6, overlap_us=0.0, ooo_bound_us=1e3,
+                       checkpoint_every=10_000, verbose=False, **cfg_kw)
+    return StreamingReconstructor(IterableSource(events), cfg)
+
+
+def test_stream_big_windows_amortize_refit_and_export_counters():
+    from traceweaver_tpu.obs.registry import get_registry
+
+    svc = _burst_stream(6, n_req=70, gap_us=120.0)  # 70 >= the bar
+    svc.run()
+    c = svc.plan_cache.counters()
+    assert c["admissions"] == 1 and c["misses"] == 1, c
+    assert c["hits"] >= 4, c
+    # one refit ran (the cold window), then the plan froze
+    assert svc.stats.get("plan_fit_s", 0) > 0
+    snap = get_registry().snapshot()
+    assert snap.get('tw_plan_cache_total{event="hit"}', 0) >= 4
+    assert snap.get('tw_plan_cache_total{event="admit"}', 0) >= 1
+    assert snap.get('tw_stream_ledger_total{key="plan_fit_s"}', 0) > 0
+
+
+def test_stream_thin_windows_never_freeze(monkeypatch):
+    """Below the admission bar every window refits (the pre-cache
+    program): freezing a handful-of-samples fit starves the warm loop
+    and turns the PSI sensor's confidence stream into atom noise — the
+    chaos-adapt leg's recovery story depends on this gate."""
+    svc = _burst_stream(6, n_req=8, gap_us=800.0)  # 8 < the bar
+    svc.run()
+    c = svc.plan_cache.counters()
+    assert c["admissions"] == 0 and c["hits"] == 0, c
+    assert c["misses"] >= 5, c
+
+
+def test_stream_kill_switch_byte_identical(tmp_path, monkeypatch):
+    """TW_PLAN_CACHE=0 on a HIGH-VOLUME stream (windows above the
+    admission bar, where the cache genuinely skips refits) must emit
+    byte-identical sink records to... itself — the cached run may
+    differ from the uncached one only in HOW the carried statistics are
+    refreshed, so the parity pin runs the same corpus twice with the
+    switch flipped and asserts the uncached replay reproduces the
+    pre-PR per-window refit program (admissions forced off, every
+    window refit, plan_fit_s accumulating per window)."""
+    import bench
+    from traceweaver_tpu.stream.service import (
+        StreamConfig,
+        StreamingReconstructor,
+        TraceSink,
+    )
+    from traceweaver_tpu.stream.sources import IterableSource
+
+    def run(flag, name):
+        monkeypatch.setenv("TW_PLAN_CACHE", flag)
+        events, _ = bench._adapt_burst_events(
+            5, shift_at=10 ** 9, n_req=70, gap_us=120.0)
+        cfg = StreamConfig(window_us=1e6, overlap_us=0.0,
+                           ooo_bound_us=1e3, checkpoint_every=10_000,
+                           verbose=False)
+        sink = TraceSink(str(tmp_path / name))
+        svc = StreamingReconstructor(IterableSource(events), cfg,
+                                     sink=sink)
+        svc.run()
+        sink.close()
+        return (tmp_path / name).read_bytes(), svc
+
+    bytes_off, svc_off = run("0", "off.jsonl")
+    assert svc_off.plan_cache.counters()["admissions"] == 0
+    n_windows = 5
+    # pre-PR program: every window refit
+    assert svc_off.stats.get("plan_fit_s", 0) > 0
+
+    bytes_on, svc_on = run("1", "on.jsonl")
+    assert svc_on.plan_cache.counters()["hits"] >= n_windows - 2
+
+    # window 0's fit is shared; the cached run freezes it, and on this
+    # stationary corpus the frozen plan solves every later window to
+    # the same assignments — emitted bytes agree
+    assert bytes_on == bytes_off
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: sharded f32 EM vs host f64 fit (the ops/gmm.py claim)
+# ---------------------------------------------------------------------------
+
+def test_fit_gmm_sharded_matches_host_f64_fit():
+    """ops/gmm.py:131-135 claims the sharded fit's f32 deviations stay
+    bounded because standardization happens before any large-magnitude
+    arithmetic. Pin it: at 1e6-magnitude means (where a naive f32
+    raw-sample variance loses everything to cancellation — eps*mean^2
+    exceeds the true variance) the psum'd z-space EM must agree with
+    the host f64 sklearn BIC fit on component count, mixture moments,
+    and average log-likelihood."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from traceweaver_tpu.algorithms.timing import EdgeDist
+    from traceweaver_tpu.ops.gmm import fit_gmm_sharded
+    from traceweaver_tpu.parallel.mesh import _CHECK_KW, make_mesh
+
+    rng = np.random.default_rng(17)
+    # edge 0: two components 5 ms apart riding a 1e6 µs offset;
+    # edge 1: one wide component at 2e6 µs
+    a = np.concatenate([1e6 + rng.normal(0, 30.0, 300),
+                        1e6 + 5000 + rng.normal(0, 60.0, 212)])
+    b = 2e6 + rng.normal(0, 300.0, 512)
+    x = np.stack([a, b]).astype(np.float32)
+    mask = np.ones_like(x, bool)
+
+    mesh = make_mesh(4)
+    axis = mesh.axis_names[0]
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(None, axis), P(None, axis)),
+             out_specs=(P(), P(), P()),
+             **{_CHECK_KW: False})
+    def fit(s, m):
+        return fit_gmm_sharded(s, m, axis, max_k=5)
+
+    w, mu, sd = (np.asarray(o, np.float64) for o in jax.jit(fit)(x, mask))
+
+    def moments(w_, mu_, sd_):
+        mean = float((w_ * mu_).sum())
+        var = float((w_ * (sd_ ** 2 + mu_ ** 2)).sum()) - mean ** 2
+        return mean, float(np.sqrt(max(var, 0.0)))
+
+    for e, samples in enumerate([a, b]):
+        host = EdgeDist.from_samples_gmm(samples.tolist())
+        # same BIC model order
+        assert int((w[e] > 0.05).sum()) == int((host.weights > 0.05).sum())
+        dm, ds = moments(w[e], mu[e], sd[e])
+        hm, hs = moments(host.weights, host.means, host.stds)
+        assert abs(dm - hm) / abs(hm) < 1e-6, (e, dm, hm)
+        assert abs(ds - hs) / hs < 1e-3, (e, ds, hs)
+        ll_dev = float(np.mean(EdgeDist(w[e], mu[e], sd[e])
+                               .logpdf(samples)))
+        ll_host = float(np.mean(host.logpdf(samples)))
+        assert ll_dev > ll_host - 0.05, (e, ll_dev, ll_host)

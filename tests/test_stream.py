@@ -288,6 +288,69 @@ def test_checkpoint_kill_resume_no_loss_no_double_emit(synth_store, tmp_path):
     assert summary["accuracy"] == uninterrupted["accuracy"]
 
 
+@pytest.mark.plan
+def test_checkpoint_kill_resume_with_warm_plan_cache(tmp_path):
+    """ISSUE 17 satellite: kill/resume byte-identity must extend to the
+    checkpointed plan-cache state. On a high-volume corpus (windows
+    above the TW_PLAN_MIN_SAMPLES admission bar, so the cache genuinely
+    freezes window 0's plan and skips later refits) a run killed with a
+    WARM cache and resumed from the checkpoint must re-emit exactly the
+    uninterrupted run's bytes — the frozen plan rides state_dict, so
+    the resumed windows solve with the SAME carried statistics the
+    killed run would have used, not a re-fit that could drift them."""
+    import bench
+    from traceweaver_tpu.stream import StreamingReconstructor, TraceSink
+    from traceweaver_tpu.stream.service import StreamConfig
+    from traceweaver_tpu.stream.sources import IterableSource
+
+    def events():
+        return bench._adapt_burst_events(
+            6, shift_at=10 ** 9, n_req=70, gap_us=120.0)[0]
+
+    def cfg(**kw):
+        return StreamConfig(window_us=1e6, overlap_us=0.0,
+                            ooo_bound_us=1e3, verbose=False, **kw)
+
+    golden_path = str(tmp_path / "golden.jsonl")
+    sink = TraceSink(golden_path)
+    svc = StreamingReconstructor(IterableSource(events()), cfg(
+        checkpoint_every=10_000), sink=sink)
+    svc.run()
+    sink.close()
+    c_gold = svc.plan_cache.counters()
+    assert c_gold["admissions"] == 1 and c_gold["hits"] >= 4, c_gold
+    with open(golden_path, "rb") as f:
+        golden = f.read()
+
+    ckpt = str(tmp_path / "ckpt.pkl")
+    out_path = str(tmp_path / "out.jsonl")
+    sink = TraceSink(out_path)
+    svc = StreamingReconstructor(IterableSource(events()), cfg(
+        checkpoint_path=ckpt, checkpoint_every=2), sink=sink)
+    # kill after 3 windows: the cache is warm (window 0 admitted,
+    # windows 1-2 hit) and the last checkpoint carries the frozen plan
+    partial = svc.run(max_windows=3)
+    assert not partial["final"]
+    assert svc.plan_cache.counters()["entries"] == 1
+    sink.close()
+
+    resumed = StreamingReconstructor.resume(ckpt, IterableSource(events()))
+    # the checkpointed cache came back warm — the resumed run must NOT
+    # re-fit the frozen plan from scratch
+    assert resumed.plan_cache.counters()["entries"] == 1
+    summary = resumed.run()
+    resumed.sink.close()
+    assert summary["final"]
+    c_res = resumed.plan_cache.counters()
+    assert c_res["admissions"] == 1, c_res  # no re-fit after resume
+    with open(out_path, "rb") as f:
+        assert f.read() == golden
+    # drift invalidation still bites on the resumed cache (the hook the
+    # resume path re-attaches for the adapt controller)
+    resumed._plan_invalidate("frontend")
+    assert resumed.plan_cache.counters()["entries"] == 0
+
+
 @pytest.mark.precision
 def test_checkpoint_is_precision_portable(synth_store, tmp_path, monkeypatch):
     """A checkpoint written under one score precision must resume

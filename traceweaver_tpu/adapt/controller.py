@@ -114,6 +114,12 @@ class AdaptationController:
         self.fallbacks = 0
         self.restores = 0
         self.recoveries = 0
+        # plan-cache invalidation hook (algorithms/plancache.py): the
+        # owning service attaches a callable taking the controller key;
+        # fired on the actuations that void a cached fitted plan. Never
+        # rides state()/from_state (it closes over the live service) —
+        # the resume path re-attaches it.
+        self.invalidate_cb = None
 
     # -- the evented ledger: EVERY actuation passes through here ---------
     def _act(self, rung: str, key: str, **fields) -> None:
@@ -122,6 +128,13 @@ class AdaptationController:
         no-silent-state-transitions contract (twlint TW010)."""
         _OBS_ACTIONS.inc(1.0, service=key, rung=rung)
         _events.emit("adapt", rung, key=key, **fields)
+        if self.invalidate_cb is not None and rung in (
+                "refit", "fallback", "refit_failed"):
+            # these rungs mean the fitted plan is suspect: a scheduled
+            # refit (drift excursion), a drop to wide priors, or a refit
+            # that failed to land — each voids the cached plan for
+            # exactly this key (targeted, not cadence, invalidation)
+            self.invalidate_cb(key)
 
     # -- sensor input -----------------------------------------------------
     def _excursion(self, psi: Optional[float],

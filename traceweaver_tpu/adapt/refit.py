@@ -114,6 +114,15 @@ def execute_refit(svc, key: str) -> bool:
         # install the fresh statistics as the carried warm state: the
         # next window for this service solves under post-shift priors
         svc.carried.update(service, dists)
+        # and re-admit the fresh plan (the drift excursion's scheduling
+        # actuation invalidated the stale entry) when the retained
+        # window carries enough evidence to freeze — the hot path's
+        # per-window refit then stays skipped under post-shift
+        # statistics; a thin window keeps re-teaching instead
+        # (plancache.admissible)
+        from traceweaver_tpu.algorithms import plancache as _plancache
+        if _plancache.admissible(len(material.in_spans)):
+            svc.plan_cache.admit(service, dists)
     ctrl.refit_done(key, ok=bool(dists),
                     solve_s=round(time.perf_counter() - t0, 3),
                     n_spans=len(material.in_spans))

@@ -360,7 +360,7 @@ class FleetItem:
                  true_assignments, dag=None,
                  method="MaxScoreBatchSubsetWithSkips", store=None,
                  warm_dists=None, tenant=None, in_cols=None, out_cols=None,
-                 trace_key=None):
+                 trace_key=None, plan_key=None):
         self.svc = svc
         self.in_span_partitions = in_span_partitions
         self.out_span_partitions = out_span_partitions
@@ -398,9 +398,19 @@ class FleetItem:
         # default) with no tracer installed costs one global read per
         # hook site.
         self.trace_key = trace_key
+        # optional plan-cache identity (algorithms/plancache.py). Service
+        # names repeat across call graphs in campaign corpora, so callers
+        # that solve several graphs against ONE cache must disambiguate
+        # (the campaign runner keys "store:svc"); None falls back to svc.
+        self.plan_key = plan_key
 
 
-def _prepare(item: FleetItem, solver: WeaverTPU):
+def _plan_key(item: FleetItem) -> str:
+    return item.plan_key if item.plan_key is not None else item.svc
+
+
+def _prepare(item: FleetItem, solver: WeaverTPU,
+             cached_dists=None):
     """Host preamble of FindAssignments for one item (sort, topo order,
     skip budget, distributions). Returns None when the item needs a code
     path the fleet does not cover (no DAG, KDE scoring, true-dist oracle).
@@ -427,6 +437,9 @@ def _prepare(item: FleetItem, solver: WeaverTPU):
         item.in_span_partitions, item.out_span_partitions, out_eps,
         item.dag, item.true_assignments, score_mode=solver.score_mode,
         true_skips=(item.method == "MaxScoreBatchSubsetWithTrueSkips"),
+        # the fit is dead computation when warm/cached dists override it
+        # below — same plan otherwise (budgets, dynamism, iterations)
+        skip_fit=(item.warm_dists is not None or cached_dists is not None),
     )
     dists, n_passes = plan["dists"], plan["iterations"]
     if item.warm_dists is not None:
@@ -435,6 +448,11 @@ def _prepare(item: FleetItem, solver: WeaverTPU):
         # joins the single-pass dispatch groups (unseen edges fall back
         # to pack_problem's near-flat wide Gaussian)
         dists, n_passes = item.warm_dists, 1
+    elif cached_dists is not None:
+        # plan-cache hit (algorithms/plancache.py): the previous round's
+        # fitted plan — a cold fit or the decoded on-device refit tables —
+        # replaces the fit AND the refit pass, exactly the warm contract
+        dists, n_passes = cached_dists, 1
     # columnar handoff (TW_COLUMNAR, default): reuse the item's pre-built
     # columns (stream/serve hand their sorted window slices over) or
     # convert ONCE here — downstream windowing/ranges/pack is array work
@@ -540,6 +558,7 @@ def solve_fleet(
     precision: Optional[str] = None,
     quarantined: Optional[List[int]] = None,
     confidences: Optional[List[Optional[Dict]]] = None,
+    plan_cache=None,
 ) -> List[Tuple]:
     """Solve every item, fusing eligible ones into one device dispatch.
 
@@ -598,6 +617,18 @@ def solve_fleet(
     channels sharpen the score; at default settings the device programs
     are byte-identical to the pre-quality ones.
 
+    ``plan_cache`` (an :class:`traceweaver_tpu.algorithms.plancache.PlanCache`)
+    amortizes the host plan fit across repeated solves of the same
+    services: hits skip the per-item distribution fit AND collapse the
+    two-pass EM to a single warm pass (the ``warm_dists`` contract);
+    misses are admitted back — single-pass items from their prepared
+    dists, two-pass items from the decoded on-device refit tables
+    (:func:`traceweaver_tpu.algorithms.weaver_tpu.dists_from_tables`),
+    so the next solve starts where this one's EM ended. Host plan time
+    is ledgered under ``plan_fit_s`` either way. Items carrying
+    ``warm_dists`` bypass the cache entirely (the stream layer owns its
+    own carried state).
+
     Returns one FindAssignments-style 6-tuple per item, in order:
     ``(all_assignments, all_topk, not_best_count, n_spans,
     per_span_candidates, cnt_unassigned)``.
@@ -620,15 +651,25 @@ def solve_fleet(
 
     prepared = []
     fallback_entries = []
+    t_plan = time.perf_counter()
     for i, item in enumerate(items):
-        prep = _prepare(item, solver)
+        cached = (plan_cache.lookup(_plan_key(item))
+                  if plan_cache is not None and item.warm_dists is None
+                  else None)
+        prep = _prepare(item, solver, cached_dists=cached)
         if prep is None:
             # host-in-the-loop configuration: per-service path
             fallback_entries.append((i, item))
             if item_cells is not None:
                 item_cells[i] = _raw_cells(item, max_window)
         else:
+            if (plan_cache is not None and cached is None
+                    and item.warm_dists is None and prep["n_passes"] == 1):
+                # single-pass miss (dynamism): there is no refit to admit
+                # later, so the bootstrap fit that just ran IS the plan
+                plan_cache.admit(_plan_key(item), prep["dists"])
             prepared.append((i, item, prep))
+    st.add("plan_fit_s", time.perf_counter() - t_plan)
     if fallback_entries:
         _run_fallback(fallback_entries, results, all_spans, all_processes,
                       solver_kwargs, st, confidences=confidences)
@@ -736,7 +777,11 @@ def solve_fleet(
                          n_sweeps=n_sweeps, sinkhorn_tol=sinkhorn_tol,
                          precision=precision, confidence=conf_device,
                          devcols=(_devcols.devcols_enabled()
-                                  and columnar_enabled() and mesh is None))
+                                  and columnar_enabled() and mesh is None),
+                         # host-only (like devcols): the dispatcher admits
+                         # two-pass refit tables back into the plan cache;
+                         # never forwarded to a device program
+                         plan_cache=plan_cache)
     itemsize = score_itemsize(precision)
     # supervisor context: what the degradation ladder needs to route a
     # failing singleton to the per-service host fallback, where it
@@ -1459,13 +1504,20 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
     # dispatch_s below stays pure launch/host time even when several
     # flows bill wait_s to the shared dict concurrently
     flow_wait = [0.0]
+    # plan-cache admission sink: the compacted two-pass flow surfaces its
+    # between-pass refit tables here so the fitted plan the device just
+    # computed is kept for the next solve (admitted below, after the
+    # dispatch accounting closes — decode work, not launch time)
+    plan_cache = hypers_common.get("plan_cache")
+    refit_sink = [] if (plan_cache is not None and n_passes == 2) else None
     if use_compact:
         out = _solve_group_compacted(
             batch, pidx, params, _tables_of(params), window_rows,
             window_valid, n_passes, n_sweeps, warm, hypers, st,
             mesh=mesh, flow_wait=flow_wait,
             tenant_col=tenant_col, tenant_table=tenant_table,
-            trace_keys=trace_keys, assemble=assemble)
+            trace_keys=trace_keys, assemble=assemble,
+            refit_sink=refit_sink)
     elif assemble is not None:
         # device-resident path: window tensors are assembled on device
         # from the rings; only index arrays + skip/force shipped. The
@@ -1538,6 +1590,25 @@ def _dispatch_packed(pg, spec: _GroupSpec, st: _Stats, hypers_common,
     _OBS_DISPATCH_S.observe(dispatch_s)
     _trace_stage(trace_keys, "dispatch", w0)
     _copy_async(out)
+    if refit_sink:
+        # two-pass admission: decode the refit tables the device already
+        # computed back into per-service dists and keep them — the next
+        # solve's cache hit repacks them bit-exactly and runs single-pass
+        # (= this solve's pass 1). Billed to plan_fit_s: this is residual
+        # host planning riding the flow worker, not launch time. Under
+        # the pipeline it overlaps the next group's pack/dispatch — the
+        # overlapped-residual-planning contract.
+        t_admit = time.perf_counter()
+        tables9 = tuple(np.asarray(t) for t in refit_sink[0])
+        from traceweaver_tpu.algorithms.weaver_tpu import dists_from_tables
+        for p, (_, item, prep, _, _) in enumerate(pg["per_item_pack"]):
+            if item.warm_dists is not None:
+                continue
+            plan_cache.admit(
+                _plan_key(item),
+                dists_from_tables(prep["out_eps"], prep["in_ep"],
+                                  *(t[p] for t in tables9)))
+        st.add("plan_fit_s", time.perf_counter() - t_admit)
     # the decode ticket carries the program-variant flag so the decode
     # worker splits the packed channels by the layout the dispatch used
     return pg["per_item_pack"], out, hypers.get("confidence", False)
@@ -1794,7 +1865,7 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
                            window_valid, n_passes, n_sweeps, warm, hypers,
                            stats, mesh=None, flow_wait=None,
                            tenant_col=None, tenant_table=None,
-                           trace_keys=(), assemble=None):
+                           trace_keys=(), assemble=None, refit_sink=None):
     """Compacted replacement for one fused group dispatch: per-pass
     warm/redispatch compaction, with the two-pass EM's on-device refit as
     its own dispatch between the passes (same refit program
@@ -1844,6 +1915,11 @@ def _solve_group_compacted(batch, pidx, params, tables, window_rows,
         # LEDGERED fetch (the refit tables are small, but the block on
         # the refit program's execution is real device wait)
         new_tables = tuple(_fetch(t, st, flow_wait) for t in new_tables)
+    if refit_sink is not None:
+        # plan-cache admission material: the dispatcher decodes these
+        # AFTER its dispatch accounting closes (device handles are fine —
+        # by then pass 1 has long since forced the refit's execution)
+        refit_sink.append(new_tables)
     return _compacted_pass(batch, pidx, tables[:3] + tuple(new_tables),
                            n_sweeps, warm, hypers, st, mesh=mesh,
                            flow_wait=flow_wait,

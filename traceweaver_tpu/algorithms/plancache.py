@@ -1,0 +1,172 @@
+"""Amortized plan cache: fitted GMM/plan parameters carried across rounds.
+
+Every solve round used to pay a serial host stage before the first device
+dispatch: per-service distribution fitting (``timing.from_samples_gmm``
+BIC sweeps inside ``estimate_edge_params`` / ``bootstrap_distributions``,
+and the per-micro-batch ``refit_from_assignments`` carried-dist update in
+the streaming hot path). The fitted result is a pure function of the
+observed spans, which change slowly — so recomputing it every round is
+the one remaining host hot path (ROADMAP item 2, PROFILE_r05 0.39% MFU).
+
+:class:`PlanCache` makes the fitted plan a first-class artifact:
+
+- **keyed** per service (``FleetItem.plan_key`` — the campaign runner
+  uses ``"store:svc"`` because service names repeat across graphs);
+- **admitted** from whatever fit ran anyway: a cold ``_prepare`` fit, a
+  stream refit, an out-of-band adapt refit, or the decoded on-device
+  refit tables of a two-pass EM dispatch (``dists_from_tables`` — the
+  device already computed the refit; the cache just keeps it);
+- **consulted** before the next fit: a hit skips the host fit entirely
+  (``plan_find_assignments(skip_fit=True)``) and collapses a two-pass
+  EM solve to a single warm pass, same as the existing ``warm_dists``
+  contract;
+- **invalidated** by the drift watcher: the adapt controller's rung
+  transitions (refit scheduled / fallback / refit failed) fire
+  ``invalidate_cb`` for exactly the drifting service — targeted refit,
+  not cadence refit;
+- **admission-gated** in the stream: only a plan fitted from a full
+  window of evidence freezes (:func:`admissible`,
+  ``TW_PLAN_MIN_SAMPLES``) — thin windows keep their per-window refit
+  so the warm-start feedback loop and the PSI drift sensor stay
+  stationary;
+- **checkpointed**: ``state()``/``from_state()`` ride the service
+  ``state_dict`` through the PR 1 checkpoint path, so kill/resume with
+  a warm cache stays byte-identical.
+
+``TW_PLAN_CACHE=0`` is the kill switch: ``lookup`` always misses and
+``admit`` is a no-op, restoring pre-cache behavior byte-identically.
+
+Counters are attribute increments on the instance (lint-exempt under
+TW007) mirrored to ``tw_plan_cache_total{event}`` so ``/metrics`` and
+the campaign ledger both see hit/miss/admit/invalidate rates.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from traceweaver_tpu.obs.registry import get_registry as _get_registry
+from traceweaver_tpu.runtime import knobs as _knobs
+
+_OBS_PLAN = _get_registry().counter(
+    "tw_plan_cache_total",
+    "plan-cache events (hit/miss/admit/invalidate)",
+    labels=("event",))
+
+
+def enabled() -> bool:
+    """Master switch (``TW_PLAN_CACHE``, default on). Off = every lookup
+    misses and every admit drops, byte-identical to pre-cache behavior."""
+    return _knobs.get_bool("TW_PLAN_CACHE")
+
+
+def admissible(n_samples: int) -> bool:
+    """Is a plan fitted from ``n_samples`` window spans trustworthy
+    enough to FREEZE? The streaming admission bar
+    (``TW_PLAN_MIN_SAMPLES``, default 64): a small-sample fit frozen in
+    place starves the warm-start feedback loop (the carried statistics
+    stop tracking per-window jitter) and quantizes the solver's
+    confidence stream into a handful of atoms — with only a few
+    confidence values per window, the PR 12 drift watcher's rolling PSI
+    over those atoms is sampling noise, and the chaos-adapt leg
+    reproduces the resulting false excursions walking the controller
+    into fallback BEFORE the real shift. Fits from a full window of
+    evidence are both accurate enough to hold and smooth enough for the
+    PSI sensor to stay stationary, so only those amortize."""
+    return int(n_samples) >= _knobs.get_int("TW_PLAN_MIN_SAMPLES")
+
+
+class PlanCache:
+    """Per-service fitted-plan store with hit/miss/invalidate telemetry.
+
+    Values are the solver's ``dists`` dicts — ``{(parent_ep, child_ep):
+    EdgeDist}`` with plain numpy arrays inside — exactly what
+    ``plan_find_assignments`` fits and ``solve_fleet`` packs, and plain
+    pickle material for checkpoints. The cache never mutates a stored
+    dict; admission replaces the entry wholesale, so a concurrent reader
+    of the old plan keeps a consistent snapshot."""
+
+    def __init__(self):
+        self._dists: Dict[str, Dict] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.admissions = 0
+        self.invalidations = 0
+
+    def lookup(self, key: str) -> Optional[Dict]:
+        """Fitted dists for ``key``, or None (miss / disabled)."""
+        if not enabled():
+            return None
+        with self._lock:
+            dists = self._dists.get(key)
+            if dists is None:
+                self.misses += 1
+                _OBS_PLAN.inc(1.0, event="miss")
+                return None
+            self.hits += 1
+            _OBS_PLAN.inc(1.0, event="hit")
+            return dists
+
+    def admit(self, key: str, dists: Optional[Dict]) -> None:
+        """Store a freshly fitted plan (no-op when disabled or empty)."""
+        if not enabled() or not dists:
+            return
+        with self._lock:
+            self._dists[key] = dists
+            self.admissions += 1
+            _OBS_PLAN.inc(1.0, event="admit")
+
+    def invalidate(self, key: Optional[str] = None) -> None:
+        """Drop one service's plan (or everything when ``key`` is None).
+        Counted even when the key was absent — the drift watcher's
+        intent to refit is the signal being measured."""
+        with self._lock:
+            if key is None:
+                self._dists.clear()
+            else:
+                self._dists.pop(key, None)
+            self.invalidations += 1
+            _OBS_PLAN.inc(1.0, event="invalidate")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dists)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "admissions": self.admissions,
+                "invalidations": self.invalidations,
+                "entries": len(self._dists),
+            }
+
+    # -- checkpoint surface (stream/checkpoint.py: plain pickle material)
+
+    def state(self) -> Dict:
+        with self._lock:
+            return {
+                "dists": dict(self._dists),
+                "counters": {
+                    "hits": self.hits,
+                    "misses": self.misses,
+                    "admissions": self.admissions,
+                    "invalidations": self.invalidations,
+                },
+            }
+
+    @classmethod
+    def from_state(cls, state: Optional[Dict]) -> "PlanCache":
+        cache = cls()
+        if not state:
+            return cache
+        cache._dists = dict(state.get("dists", {}))
+        c = state.get("counters", {})
+        cache.hits = int(c.get("hits", 0))
+        cache.misses = int(c.get("misses", 0))
+        cache.admissions = int(c.get("admissions", 0))
+        cache.invalidations = int(c.get("invalidations", 0))
+        return cache

@@ -428,6 +428,11 @@ def fit_edge_gmms(samples_by_edge: Dict[EdgeKey, List[float]],
         n = max(len(a) for a in device_samples)
         n_pad = pow2_bucket(n)
         e_pad = pow2_bucket(len(device_keys))
+        # AOT lattice audit (runtime/aot.py): a plan-fit GMM block outside
+        # the precompiled [e, n] lattice is a steady-state compile escape
+        from traceweaver_tpu.runtime import aot as _aot
+
+        _aot.note_gmm(e_pad, n_pad)
         # f64 all the way to fit_gmm_batched's host-side standardization —
         # packing in f32 here would forfeit the precision it preserves
         x = np.zeros((e_pad, n_pad), dtype=np.float64)

@@ -1158,6 +1158,38 @@ def _problem_tables(out_eps: List[str], E_pad: int,
     )
 
 
+def dists_from_tables(out_eps: List[str], in_ep: str,
+                      edge_wt, edge_mu, edge_sd,
+                      in_wt, in_mu, in_sd,
+                      ret_wt, ret_mu, ret_sd
+                      ) -> Dict[Tuple[str, str], EdgeDist]:
+    """Inverse of the ``_problem_tables`` packing: fitted param tables
+    (one service's rows, refit order — the nine-tuple
+    :func:`refit_fleet_params` returns) back into the solver's
+    ``{(parent_ep, child_ep): EdgeDist}`` dict.
+
+    Decodes EVERY family row over the true edges ``e < len(out_eps)``,
+    including edges the refit saw no samples for — the in-graph fit
+    keeps the prior params for empty rows (ops/gmm.fit_gmm_in_graph), so
+    repacking the decoded dict through ``_problem_tables`` reproduces
+    the device tables bit-exactly (f32 -> f64 -> f32 round-trips
+    losslessly). That exactness is what lets the plan cache admit
+    on-device refit results and stay byte-identical on the next solve."""
+    def mk(w, m, s) -> EdgeDist:
+        return EdgeDist(np.asarray(w, dtype=np.float64),
+                        np.asarray(m, dtype=np.float64),
+                        np.asarray(s, dtype=np.float64))
+
+    dists: Dict[Tuple[str, str], EdgeDist] = {}
+    for e, ep in enumerate(out_eps):
+        dists[(in_ep, ep)] = mk(in_wt[e], in_mu[e], in_sd[e])
+        dists[(ep, in_ep)] = mk(ret_wt[e], ret_mu[e], ret_sd[e])
+        for p, pep in enumerate(out_eps):
+            dists[(pep, ep)] = mk(edge_wt[e, p], edge_mu[e, p],
+                                  edge_sd[e, p])
+    return dists
+
+
 def pack_problem(
     in_spans: List[Span],
     out_span_partitions: Dict[str, List[Span]],
@@ -1533,6 +1565,7 @@ def plan_find_assignments(
     true_skips: bool = False,
     true_dist: bool = False,
     parallel_mode: bool = False,
+    skip_fit: bool = False,
 ) -> Dict:
     """The solve plan shared by the per-service entry point
     (:meth:`WeaverTPU.FindAssignments`) and the fleet packer
@@ -1542,6 +1575,13 @@ def plan_find_assignments(
     (bootstrap under dynamism / missing DAG, graph-aware batch means
     otherwise, oracle truth under true_dist) and the iteration count.
     ONE definition so the two production paths cannot drift.
+
+    ``skip_fit=True`` skips ONLY the distribution fit (``dists`` comes
+    back empty) — for callers that will override dists anyway (a warm
+    carried state or a plan-cache hit), where the host BIC sweeps are
+    the round's dominant serial stage and pure dead computation.
+    Budgets, dynamism, forced skips and the iteration count are computed
+    identically, so the plan is otherwise byte-for-byte the same.
     """
     in_ep = next(iter(in_span_partitions))
     n_in = len(in_span_partitions[in_ep])
@@ -1560,7 +1600,9 @@ def plan_find_assignments(
             for ep in out_eps
         }
 
-    if true_dist:
+    if skip_fit:
+        dists = {}
+    elif true_dist:
         dists = timing.true_distributions(
             in_span_partitions, out_span_partitions, out_eps,
             true_assignments, score_mode=score_mode,

@@ -53,6 +53,25 @@ def compare_artifacts(baseline: Dict, candidate: Dict,
                                 candidate=cand, tolerance=tolerance,
                                 detail=detail))
 
+    # environment gate: a CPU baseline diffed against a TPU run (or a
+    # different device count) produces throughput deltas that measure
+    # the hardware, not the change — refuse the comparison outright
+    # rather than let it pass or fail on meaningless numbers
+    for field in ("backend", "devices_visible"):
+        b_env, c_env = baseline.get(field), candidate.get(field)
+        if b_env != c_env:
+            flag("-", "environment_%s" % field, b_env, c_env,
+                 "identical environment",
+                 "artifacts ran on different %s — comparison refused"
+                 % field)
+    if regressions:
+        return dict(
+            ok=False,
+            tolerances=dict(throughput_pct=tol_pct, accuracy_pts=tol_acc),
+            rungs=rows,
+            regressions=regressions,
+        )
+
     for name, b in base_rungs.items():
         c = cand_rungs.get(name)
         if c is None:

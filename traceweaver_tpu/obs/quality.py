@@ -369,6 +369,15 @@ class ConfidenceDrift:
             return None
         return psi(self._ref[key], _bin_counts(cur))
 
+    def in_excursion(self, key: str) -> bool:
+        """Is ``key``'s alert currently armed (last PSI above the
+        threshold, not yet re-armed)? Consumers that suspend
+        amortization while a shift is in progress — the stream
+        service's plan-cache gate — read this: during an excursion the
+        per-window refit must keep re-teaching the carried statistics
+        until the PSI falls back under the threshold."""
+        return bool(self._alerted.get(key))
+
     def mature(self, key: str) -> bool:
         """Is the rolling current window for ``key`` fully populated?
         Right after the reference freezes, the rolling distribution is

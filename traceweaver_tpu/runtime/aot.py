@@ -854,6 +854,15 @@ def note_assemble(cap: int, in_idx, out_idx) -> Optional[str]:
     return _record_miss(_assemble_key(cap, B, E, W, M))
 
 
+def note_gmm(e: int, n: int) -> Optional[str]:
+    """Miss check for one batched host-side GMM fit dispatch
+    (``ops/gmm._fit_gmm_z`` via ``timing.fit_edge_gmms`` — the plan-fit
+    path; shapes are the pow2-bucketed ``[e, n]`` sample block)."""
+    if not _ARMED:
+        return None
+    return _record_miss(_gmm_key(int(e), int(n)))
+
+
 def reset_for_tests() -> None:
     """Disarm and clear all module state (test isolation only)."""
     global _ARMED, _LATTICE, _THREAD

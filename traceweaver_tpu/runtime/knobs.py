@@ -51,6 +51,16 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     # --- solver/fleet ----------------------------------------------------
     _k("TW_PIPELINE", "bool", True,
        help="0 kills the pipelined fleet dispatcher (serial flow)"),
+    _k("TW_PLAN_CACHE", "bool", True,
+       help="0 kills the amortized plan cache (per-service fitted "
+            "GMM/plan params carried across rounds; 0 restores per-round "
+            "host fits byte-identically — algorithms/plancache.py)"),
+    _k("TW_PLAN_MIN_SAMPLES", "int", 64,
+       help="streaming plan-cache admission bar: a window's fitted plan "
+            "is frozen only when estimated from at least this many "
+            "window spans (small-sample fits keep the per-window refit "
+            "so the warm loop and the PSI drift sensor stay stationary "
+            "— plancache.admissible)"),
     _k("TW_COMPACT", "bool", True,
        help="0 disables convergence compaction"),
     _k("TW_SWEEP_WARM", "int", 2, lo=1,

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from traceweaver_tpu import adapt as _adapt
+from traceweaver_tpu.algorithms import plancache as _plancache
 from traceweaver_tpu.obs import events as _events
 from traceweaver_tpu.obs import quality as _quality
 from traceweaver_tpu.obs import selftrace as _selftrace
@@ -227,6 +228,16 @@ class StreamingReconstructor:
         self.adapt = (_adapt.AdaptationController()
                       if self.drift is not None
                       and _adapt.adapt_enabled() else None)
+        # amortized plan cache (algorithms/plancache.py, TW_PLAN_CACHE):
+        # the per-micro-batch carried-dist refit is the stream's residual
+        # host plan stage — a cache hit skips it entirely; admissions
+        # happen on every refit that runs anyway (hot path + out-of-band
+        # adapt refits), and the drift controller's actuations invalidate
+        # exactly the drifting service. Rides state_dict/apply_state so
+        # kill/resume with a warm cache stays byte-identical.
+        self.plan_cache = _plancache.PlanCache()
+        if self.adapt is not None:
+            self.adapt.invalidate_cb = self._plan_invalidate
         # per-service refit material: the most recently SOLVED window
         # problem, retained so an out-of-band refit has a post-shift
         # window to re-fit from (one window per service — bounded;
@@ -461,9 +472,37 @@ class StreamingReconstructor:
                     # when this service's drift excursion fires)
                     self.adapt_material[wp.service] = wp
                 if self.cfg.warm_start:
-                    self.carried.update(wp.service, timing.refit_from_assignments(
-                        {wp.in_ep: wp.in_spans}, wp.out_parts, wp.dag,
-                        amap, self.live.all_spans))
+                    # amortized plan refit: a cache hit means this
+                    # service's carried plan is current (admitted by an
+                    # earlier refit, not yet drift-invalidated) — skip
+                    # the per-micro-batch host refit entirely. Three
+                    # guards keep the adaptation dynamics intact:
+                    # fallback services re-teach every window (that is
+                    # what earns the restore, adapt/controller.py),
+                    # services in a live drift EXCURSION keep refitting
+                    # until the PSI re-arms under the threshold, and
+                    # only a plan fitted from a full window of evidence
+                    # is ever admitted (plancache.admissible — freezing
+                    # a handful-of-samples fit starves the warm loop
+                    # and turns the PSI sensor into atom noise; the
+                    # chaos-adapt leg reproduces both). The cache
+                    # amortizes the high-volume quiet steady state only.
+                    akey = self.trace_prefix + wp.service
+                    on_fallback = (self.adapt is not None
+                                   and self.adapt.fallback_active(akey))
+                    in_excursion = (self.drift is not None
+                                    and self.drift.in_excursion(akey))
+                    if (on_fallback or in_excursion
+                            or self.plan_cache.lookup(wp.service) is None):
+                        t_fit = time.perf_counter()
+                        dists = timing.refit_from_assignments(
+                            {wp.in_ep: wp.in_spans}, wp.out_parts, wp.dag,
+                            amap, self.live.all_spans)
+                        self.carried.update(wp.service, dists)
+                        self._bump("plan_fit_s",
+                                   time.perf_counter() - t_fit)
+                        if _plancache.admissible(len(wp.in_spans)):
+                            self.plan_cache.admit(wp.service, dists)
                 if self.grader is not None and not quarantined_svcs:
                     owned = [s for s in wp.in_spans
                              if s.GetId() in buf.owned_ids]
@@ -802,6 +841,17 @@ class StreamingReconstructor:
         _OBS_STREAM.inc(n, key=key)
         self.stats[key] = self.stats.get(key, 0) + n
 
+    def _plan_invalidate(self, key: str) -> None:
+        """Adapt-controller actuation hook: a drift excursion scheduling
+        a refit (or a fallback/failed-refit transition) voids exactly
+        that service's cached plan, so the next micro-batch refits it —
+        targeted invalidation, not cadence refit. ``key`` is the
+        controller's key (``trace_prefix + service``)."""
+        svc = key
+        if self.trace_prefix and key.startswith(self.trace_prefix):
+            svc = key[len(self.trace_prefix):]
+        self.plan_cache.invalidate(svc)
+
     def seal_emit_p99_ms(self) -> Optional[float]:
         """p99 of the recent seal→emit latencies (ms; None before the
         first emission) — the number the continuous-batching SLO
@@ -878,6 +928,7 @@ class StreamingReconstructor:
             grader=self.grader,
             conf_drift=self.drift.state() if self.drift else None,
             adapt=self.adapt.state() if self.adapt else None,
+            plan_cache=self.plan_cache.state(),
             stats=self.stats,
             fleet_stats=self.fleet_stats,
             pending=list(self.scheduler.pending),
@@ -980,6 +1031,16 @@ class StreamingReconstructor:
         if state.get("adapt") and svc.adapt is not None:
             svc.adapt = _adapt.AdaptationController.from_state(
                 state["adapt"])
+            # callbacks never ride checkpoint state (they close over the
+            # dead process's service): re-attach the invalidation hook
+            svc.adapt.invalidate_cb = svc._plan_invalidate
+        # warm plan cache survives kill/resume (pre-plan-cache
+        # checkpoints carry no key and keep the fresh empty cache), so
+        # the resumed run's refit-or-skip decisions — and therefore its
+        # emitted bytes — match the uninterrupted run's exactly
+        if state.get("plan_cache"):
+            svc.plan_cache = _plancache.PlanCache.from_state(
+                state["plan_cache"])
         svc.stats = state["stats"]
         svc.fleet_stats = state["fleet_stats"]
         # checkpointed seal stamps are time.monotonic() values from the
