@@ -2029,6 +2029,176 @@ def run_ingest_leg(n_spans: int) -> dict:
     return report
 
 
+def wire_fields(n_spans: int, n_traces: int, wire_s: float,
+                python_s: float, obj_s: float) -> dict:
+    """Wire-ingest leg ledger -> report fields (unit-tested like
+    ingest_fields, tests/test_bench.py).
+
+    ``wire_s``/``python_s``/``obj_s`` are the wall seconds of one
+    payload-bytes -> columnar-store pass (parsed, validated, root-op
+    filtered wire-trace slices — Span materialization is the LAZY stage
+    and is timed separately) under the columnar wire parse (native
+    front end), the same parse with ``TW_DISABLE_NATIVE=1`` (pure-
+    Python front end), and the object pipeline (``TW_WIRE_COLUMNAR=0``:
+    json.loads + parse_trace_payload, whose store IS the Span objects)
+    on identical bytes. The headline ``wire_spans_per_s`` is the
+    columnar number; the r18 acceptance bar is ``wire_speedup >= 5``."""
+    def rate(s):
+        return round(n_spans / s, 1) if s and s > 0 else None
+
+    return {
+        "wire_spans": int(n_spans),
+        "wire_traces": int(n_traces),
+        "wire_spans_per_s": rate(wire_s),
+        "wire_spans_per_s_python": rate(python_s),
+        "wire_spans_per_s_object": rate(obj_s),
+        "wire_speedup": (round(obj_s / wire_s, 2)
+                         if wire_s and wire_s > 0 and obj_s else None),
+        "wire_speedup_python": (round(obj_s / python_s, 2)
+                                if python_s and python_s > 0 and obj_s
+                                else None),
+    }
+
+
+def run_wire_ingest_leg(n_spans: int) -> dict:
+    """bench.py --wire-ingest N: serve-path payload parse throughput —
+    no device, no windowing. Times the exact accepted-POST front half of
+    ``Tenant.ingest_payload`` (payload bytes -> root-op-filtered,
+    materialized traces) on ~N spans of fix=2 hotel traces, under all
+    three parse paths on identical payload bytes:
+
+    - **wire/native** (the ``TW_WIRE_COLUMNAR`` default): byte-level
+      native field extraction (ingest/wire.py), Span objects built only
+      for accepted traces;
+    - **wire/python** (``TW_DISABLE_NATIVE=1``): the same columnar
+      front end on the pure-Python field walk — the fallback a
+      container without the toolchain runs;
+    - **object** (``TW_WIRE_COLUMNAR=0``): ``json.loads`` +
+      :func:`parse_trace_payload`, one ``Span`` per posted span before
+      any filtering — the pre-r18 serve flow.
+
+    The accepted traces of all three passes are canonicalized and
+    compared (``wire_parity_ok``), along with the dead-letter counters,
+    so the reported speedup can never come from diverging accept/reject
+    work.
+    """
+    from traceweaver_tpu import native as native_mod
+    from traceweaver_tpu.ingest import wire as wire_mod
+    from traceweaver_tpu.ingest.jaeger import (
+        FIX_ROOT_OPS,
+        parse_trace_payload,
+    )
+
+    FIX = 2
+    root_op = FIX_ROOT_OPS[FIX]
+    n_traces = max(8, n_spans // 5)
+    payload = {"data": [_serve_trace(i, "w", 1_000_000.0)
+                        for i in range(n_traces)]}
+    raw = json.dumps(payload).encode("utf-8")
+    log(f"wire leg: {n_traces * 5} posted spans, {n_traces} traces, "
+        f"{len(raw) >> 10} KiB payload")
+
+    def object_pass():
+        t0 = time.perf_counter()
+        counters = {}
+        parsed = parse_trace_payload(json.loads(raw), FIX, {}, {},
+                                     strict=False, counters=counters)
+        accepted = []
+        for entry in parsed:
+            if entry is None:
+                continue
+            _tid, spans, _procs = entry
+            root = next((s for s in spans.values() if s.IsRoot()), None)
+            if root is None or (root_op is not None
+                                and root.op_name != root_op):
+                continue
+            accepted.append(entry)
+        return accepted, counters, time.perf_counter() - t0
+
+    def wire_pass():
+        t0 = time.perf_counter()
+        counters = {}
+        entries = wire_mod.parse_payload_wire(raw, FIX, {}, strict=False,
+                                              counters=counters)
+        assert entries is not None, "wire path unexpectedly ineligible"
+        kept = [w for w in entries
+                if w is not None
+                and not (root_op is not None and w.root_op != root_op)]
+        parse_s = time.perf_counter() - t0
+        # the lazy stage, timed apart: Span objects exist only past the
+        # accept filter (and only because the window feed still consumes
+        # objects) — the store -> object conversion is not parse cost
+        t1 = time.perf_counter()
+        accepted = [w.materialize() for w in kept]
+        mat_s = time.perf_counter() - t1
+        return accepted, counters, parse_s, mat_s
+
+    def canon(entries):
+        # engine-invariant view of the accepted traces: the native front
+        # end parses JSON numbers as floats where the object path keeps
+        # ints, so times are float()-coerced; tags ride only the object
+        # path (the wire contract materializes tags=None) and are
+        # excluded — nothing downstream of ingest reads them
+        out = []
+        for tid, spans, procs in entries:
+            rows = tuple(sorted(
+                (s.sid, float(s.start_mus), float(s.duration_mus),
+                 s.op_name, repr(s.references), repr(s.process_id),
+                 s.span_kind) for s in spans.values()))
+            prows = tuple(sorted(
+                (str(k), str(v.get("serviceName")
+                             if isinstance(v, dict) else v))
+                for k, v in (procs or {}).items()))
+            out.append((tid, rows, prows))
+        return out
+
+    # twlint: disable=TW001 — raw save/restore of the literal env string
+    # (not a parsed knob read): the finally block must put back exactly
+    # what was set, including "unset"
+    saved = os.environ.get("TW_DISABLE_NATIVE")
+    try:
+        # two timed passes per path, best-of (first pass pays warmup);
+        # object first so any shared warmup favors IT — the reported
+        # speedup is the conservative one
+        acc_obj, cnt_obj, s_obj = object_pass()
+        _, _, s_obj2 = object_pass()
+        os.environ["TW_DISABLE_NATIVE"] = "1"
+        acc_py, cnt_py, s_py, _ = wire_pass()
+        _, _, s_py2, _ = wire_pass()
+        if saved is None:
+            os.environ.pop("TW_DISABLE_NATIVE", None)
+        else:
+            os.environ["TW_DISABLE_NATIVE"] = saved
+        native_ok = native_mod.get_lib() is not None
+        acc_nat, cnt_nat, s_nat, m_nat = wire_pass()
+        _, _, s_nat2, m_nat2 = wire_pass()
+    finally:
+        if saved is None:
+            os.environ.pop("TW_DISABLE_NATIVE", None)
+        else:
+            os.environ["TW_DISABLE_NATIVE"] = saved
+    obj_s, py_s, nat_s = (min(s_obj, s_obj2), min(s_py, s_py2),
+                          min(s_nat, s_nat2))
+    mat_s = min(m_nat, m_nat2)
+    ref = canon(acc_obj)
+    parity = (ref == canon(acc_py) == canon(acc_nat)
+              and cnt_obj == cnt_py == cnt_nat)
+    n_acc = sum(len(spans) for _, spans, _ in acc_obj)
+    report = dict(mode="wire", wire_parity_ok=bool(parity),
+                  wire_native_loaded=bool(native_ok),
+                  wire_materialize_s=round(mat_s, 6),
+                  wire_spans_per_s_e2e=(round(n_acc / (nat_s + mat_s), 1)
+                                        if nat_s + mat_s > 0 else None),
+                  **wire_fields(n_acc, len(acc_obj), nat_s, py_s, obj_s))
+    log(f"wire leg: columnar {report['wire_spans_per_s']} spans/s "
+        f"(python {report['wire_spans_per_s_python']}, e2e w/ lazy "
+        f"materialize {report['wire_spans_per_s_e2e']}), object "
+        f"{report['wire_spans_per_s_object']} spans/s "
+        f"({report['wire_speedup']}x native / "
+        f"{report['wire_speedup_python']}x python, parity={parity})")
+    return report
+
+
 def _serve_trace(i, prefix, base_us, spacing_us=10_000.0, slow_every=6):
     """One synthetic frontend->search->geo Jaeger trace (fix=2 root op);
     every ``slow_every``-th trace plants its latency in search."""
@@ -2703,6 +2873,16 @@ if __name__ == "__main__":
                          "TW_COLUMNAR settings on identical inputs "
                          "(reports pack_spans_per_s, pack_s_per_window, "
                          "and the columnar-vs-object speedup)")
+    ap.add_argument("--wire-ingest", type=int, nargs="?", const=100000,
+                    default=None, metavar="N",
+                    help="standalone serve-path parse leg: ~N spans of "
+                         "fix=2 payload bytes through the accepted-POST "
+                         "front half of ingest_payload, timed under the "
+                         "columnar wire parse (native + pure-Python "
+                         "front ends) and the object pipeline "
+                         "(TW_WIRE_COLUMNAR=0) with canonicalized "
+                         "accept-set parity (reports wire_spans_per_s "
+                         "and the wire-vs-object speedup; r18 bar >= 5x)")
     ap.add_argument("--serve-tenants", type=int, default=None, metavar="N",
                     help="standalone multi-tenant service leg: N "
                          "synthetic tenants at mixed rates through one "
@@ -2785,6 +2965,14 @@ if __name__ == "__main__":
     if args.ingest_only:
         ingest_report = run_ingest_leg(args.ingest_only)
         line = json.dumps(ingest_report)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        print(line)
+        sys.exit(0)
+    if args.wire_ingest:
+        wire_report = run_wire_ingest_leg(args.wire_ingest)
+        line = json.dumps(wire_report)
         if args.out:
             with open(args.out, "w") as f:
                 f.write(line + "\n")

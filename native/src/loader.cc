@@ -238,6 +238,36 @@ tw::Corpus* tw_parse_files(const char* const* paths, long n) {
   return corpus;
 }
 
+// Parse one Jaeger-JSON POST body (already in memory — the serve path's
+// accepted wire bytes) into a corpus. Same extraction/interning semantics
+// as tw_parse_files with a single "file" at index 0; fail-fast on any
+// malformed trace/span — the Python caller falls back to its own wire
+// parser, which owns skip-and-count dead-letter accounting. Returns
+// nullptr (see tw_last_error) on failure.
+tw::Corpus* tw_parse_payload(const char* data, long n) {
+  tw::Json doc;
+  tw::JsonParser parser(data, static_cast<size_t>(n));
+  if (!parser.parse(&doc)) {
+    tw::g_last_error = std::string("payload: ") + parser.error();
+    return nullptr;
+  }
+  const tw::Json* entries = doc.find("data");
+  if (!entries || !entries->is_arr()) {
+    tw::g_last_error = "payload: no data[] array";
+    return nullptr;
+  }
+  auto* corpus = new tw::Corpus();
+  corpus->intern("");
+  for (const tw::Json& trace : entries->arr) {
+    if (!tw::extract_trace(trace, 0, corpus)) {
+      tw::g_last_error = std::string("payload: ") + corpus->error;
+      delete corpus;
+      return nullptr;
+    }
+  }
+  return corpus;
+}
+
 void tw_corpus_free(tw::Corpus* c) { delete c; }
 
 long tw_num_spans(const tw::Corpus* c) {

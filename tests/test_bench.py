@@ -424,6 +424,26 @@ def test_ingest_fields_ledger_and_ratio(bench):
     assert empty["pack_columnar_speedup"] is None
 
 
+def test_wire_fields_ledger_and_speedups(bench):
+    """The --wire-ingest leg's report builder: payload->store parse
+    timings under the native, pure-Python, and object front ends -> the
+    wire_* field set (the r18 >=5x acceptance bar reads wire_speedup)."""
+    out = bench.wire_fields(100_000, 20_000, wire_s=0.5, python_s=2.0,
+                            obj_s=2.5)
+    assert out["wire_spans"] == 100_000
+    assert out["wire_traces"] == 20_000
+    assert out["wire_spans_per_s"] == 200_000.0
+    assert out["wire_spans_per_s_python"] == 50_000.0
+    assert out["wire_spans_per_s_object"] == 40_000.0
+    assert out["wire_speedup"] == 5.0
+    assert out["wire_speedup_python"] == 1.25
+    # empty/zero inputs degrade to None, never divide-by-zero
+    empty = bench.wire_fields(0, 0, 0.0, 0.0, 0.0)
+    assert empty["wire_spans_per_s"] is None
+    assert empty["wire_speedup"] is None
+    assert empty["wire_speedup_python"] is None
+
+
 def test_ingest_leg_small_run_parity_and_fields(bench, monkeypatch):
     """A tiny end-to-end --ingest-only run: both paths pack byte-identical
     blocks and every ledger field lands in the report."""

@@ -320,6 +320,11 @@ def test_inproc_wire_campaign_artifact_and_self_compare(tmp_path):
         assert r["accuracy"]["e2e_pct"] == 100.0
         assert r["steady"]["spans_per_s"] > 0
         assert r["manifest"]["spans"] == r["manifest"]["traces"] * 5
+        # r18: the wire stage ledgers ride the fleet block (parse ran —
+        # spans were ingested — so its sum must be positive)
+        assert r["fleet"]["parse_s"] > 0.0
+        assert r["fleet"]["stitch_s"] >= 0.0
+        assert r["fleet"]["emit_s"] >= 0.0
     # the N=2 rung exercised at least the chaos-phase live migration
     # (plus any placement-rebalance moves the hash split required)
     assert loaded["rungs"][1]["fleet"]["migrations"] >= 1

@@ -1,0 +1,471 @@
+"""Zero-object wire-ingest tests (tier-1, CPU) — ISSUE 18.
+
+Contracts covered:
+
+- the DEFAULT POST path is columnar: an eligible Jaeger-JSON body over
+  real HTTP never touches the object parser (a spy on
+  ``parse_trace_payload`` must not fire), and the tenant's ledger
+  counts the post under ``tw_wire_ingest_total{path="columnar"}``;
+- ``TW_WIRE_COLUMNAR=0`` byte parity: the same posted bytes produce a
+  byte-identical ``traces.jsonl`` under both knob settings (the knob
+  moves time, never output);
+- front-end parity: the pure-Python wire front end (``TW_DISABLE_NATIVE
+  =1``) and the native loader agree with the object parser on
+  randomized adversarial payloads — accepted spans, dead-letter
+  counters, AND raised exceptions;
+- malformed dead-letter accounting is preserved on the columnar path
+  (skip-and-count non-strict, ``MalformedSpan`` under strict — strict
+  falls back to the object parser by design);
+- stitch equivalence: the batched array BFS (``_stitch_arrays``) equals
+  the per-root object DFS (``_stitch_objects``) on randomized DAGs with
+  phantom out-ids, NA/SKIP assignments, and shared subgraphs;
+- the native-loads-or-fallback contract: every wire parse increments
+  ``tw_wire_parse_total{engine=native|python}``, so a build where the
+  native loader failed to load is visible on /metrics, never silent;
+- ``TraceSink.write_lines`` is byte-identical to the equivalent
+  ``write_line`` sequence (the batched emitter's storage contract);
+- kill/resume byte identity holds with the batched emitter: a drain
+  (checkpoint) mid-stream followed by a resume emits the same bytes as
+  the uninterrupted run.
+
+Corpus: the handcrafted fix=2 hotel traces shared with test_serve.py
+(fully deterministic; the randomized trials use seeded ``random``).
+"""
+
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+import jax
+
+from traceweaver_tpu.serve import ServeConfig, TenantService
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.wire
+
+
+# ---------------------------------------------------------------------------
+# corpus (the test_serve.py hotel skeleton: frontend -> search -> geo)
+# ---------------------------------------------------------------------------
+
+def hotel_trace(i, prefix, base_us=1_000_000.0, spacing_us=10_000.0):
+    T = base_us + i * spacing_us
+    slow = (i % 6) == 5
+    s1_dur = 5000.0 if slow else 600.0
+    c1_dur = s1_dur + 500.0
+    tid = f"{prefix}{i:03d}"
+
+    def span(sid, start, dur, op, refs, pid, kind):
+        return dict(traceID=tid, spanID=sid, startTime=start, duration=dur,
+                    operationName=op,
+                    references=[{"traceID": tid, "spanID": r} for r in refs],
+                    processID=pid,
+                    tags=[{"key": "span.kind", "value": kind}])
+
+    spans = [
+        span("root", T, c1_dur + 400.0, "HTTP GET /hotels", [], "p1",
+             "server"),
+        span("c1", T + 200, c1_dur, "call-search", ["root"], "p1", "client"),
+        span("s1", T + 300, s1_dur, "search", ["c1"], "p2", "server"),
+        span("c2", T + 400, 300.0, "call-geo", ["s1"], "p2", "client"),
+        span("s2", T + 450, 200.0, "geo", ["c2"], "p3", "server"),
+    ]
+    return dict(traceID=tid, spans=spans,
+                processes=dict(p1={"serviceName": "frontend"},
+                               p2={"serviceName": "search"},
+                               p3={"serviceName": "geo"}))
+
+
+def hotel_payload(n_traces=24, prefix="t", base_us=1_000_000.0):
+    return {"data": [hotel_trace(i, prefix, base_us)
+                     for i in range(n_traces)]}
+
+
+def _cfg(**kw):
+    base = dict(fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+                verbose=False, pump_windows=10**9)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _http(method, url, payload=None, timeout=120):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# the default POST path is columnar — the object parser never fires
+# ---------------------------------------------------------------------------
+
+def test_default_post_is_columnar_object_parser_never_fires(
+        tmp_path, monkeypatch):
+    from traceweaver_tpu.serve import make_server
+    import traceweaver_tpu.serve.tenancy as tenancy
+
+    calls = []
+
+    def spy(*a, **k):
+        calls.append(a)
+        raise AssertionError("object parser fired on the default wire path")
+
+    monkeypatch.setattr(tenancy, "parse_trace_payload", spy)
+    service = TenantService(_cfg(state_dir=str(tmp_path / "wp")))
+    server = make_server(service, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        code, out = _http("POST", base + "/api/v1/tenants/acme/spans",
+                          hotel_payload(12))
+        assert code == 200 and out["ingested_traces"] == 12, out
+        assert out["ingested_spans"] == 60
+        code, out = _http("POST", base + "/api/v1/flush")
+        assert code == 200 and out["solved_windows"] == 1, out
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert not calls
+    ten = service.tenants["acme"]
+    assert ten.counters.get("wire_columnar_posts") == 1
+    assert not ten.counters.get("wire_object_posts")
+    st = ten.stats()
+    assert st["parse_s"] > 0.0
+    assert st["stitch_s"] > 0.0 and st["emit_s"] > 0.0
+    service.drain()
+
+
+# ---------------------------------------------------------------------------
+# TW_WIRE_COLUMNAR=0 parity: identical emitted bytes either way
+# ---------------------------------------------------------------------------
+
+def _emit_bytes(tmp_path, name, raw_payload):
+    svc = TenantService(_cfg(state_dir=str(tmp_path / name)))
+    summary = svc.ingest("t0", raw_payload)
+    svc.flush()
+    svc.drain()
+    with open(tmp_path / name / "t0" / "traces.jsonl", "rb") as f:
+        return f.read(), summary
+
+
+def test_knob_off_emits_identical_bytes(tmp_path, monkeypatch):
+    raw = json.dumps(hotel_payload(24)).encode()
+    monkeypatch.setenv("TW_WIRE_COLUMNAR", "1")
+    on_bytes, on_sum = _emit_bytes(tmp_path, "on", raw)
+    monkeypatch.setenv("TW_WIRE_COLUMNAR", "0")
+    off_bytes, off_sum = _emit_bytes(tmp_path, "off", raw)
+    assert on_bytes and on_bytes == off_bytes
+    assert on_sum == off_sum
+
+
+# ---------------------------------------------------------------------------
+# randomized front-end parity: native / pure-Python wire vs the object
+# parser — accepted spans, counters, and exceptions must all agree
+# ---------------------------------------------------------------------------
+
+def _rand_payload(rng):
+    data = []
+    for t in range(rng.randint(0, 4)):
+        tid = f"T{t}"
+        spans, sids = [], []
+        for i in range(rng.randint(0, 6)):
+            sid = (f"s{i}" if rng.random() > 0.1 or not sids
+                   else rng.choice(sids))  # duplicate sids sometimes
+            sids.append(sid)
+            rec = {
+                "traceID": tid if rng.random() > 0.05 else f"X{t}",
+                "spanID": sid,
+                "startTime": rng.choice(
+                    [1000 + i, float(1000 + i), str(1000 + i), 1000.5]),
+                "duration": rng.choice([50, 50.0, "50"]),
+                "operationName": rng.choice(
+                    ["opA", "HTTP GET /hotels", "init-span"]),
+                "processID": rng.choice(["p1", "p2", None]),
+                "references": [],
+                "tags": [{"key": "span.kind",
+                          "value": rng.choice(["server", "client"])}],
+            }
+            if rec["processID"] is None:
+                del rec["processID"]
+            if i > 0 and rng.random() > 0.3:
+                rec["references"] = [
+                    {"traceID": tid, "spanID": rng.choice(sids[:-1] or [sid])}]
+            if rng.random() < 0.05:
+                del rec["startTime"]  # malformed span
+            if rng.random() < 0.03:
+                rec["requestType"] = "rt-op"
+            spans.append(rec)
+        entry = {"traceID": tid, "spans": spans,
+                 "processes": {"p1": {"serviceName": "svcA"},
+                               "p2": {"serviceName": "svcB"}}}
+        if rng.random() < 0.05:
+            del entry["spans"]  # malformed trace
+        data.append(entry)
+    return {"data": data}
+
+
+def _canon_spans(spans):
+    def num(v):
+        try:
+            return repr(float(v))
+        except (TypeError, ValueError):
+            return repr(v)
+    return tuple(sorted(
+        (s.sid, s.trace_id, num(s.start_mus), num(s.duration_mus),
+         repr(s.op_name), repr(s.references), repr(s.process_id),
+         repr(s.span_kind)) for s in spans.values()))
+
+
+def _canon(entries, wire):
+    out = []
+    for e in entries:
+        if e is None:
+            out.append(None)
+            continue
+        tid, spans, procs = e.materialize() if wire else e
+        out.append((tid, _canon_spans(spans),
+                    tuple(sorted((str(k), repr(v))
+                                 for k, v in (procs or {}).items()))))
+    return out
+
+
+def test_wire_frontend_parity_randomized(monkeypatch):
+    from traceweaver_tpu.ingest import wire as wire_mod
+    from traceweaver_tpu.ingest.jaeger import parse_trace_payload
+
+    rng = random.Random(20180)
+    ineligible = 0
+    for trial in range(120):
+        fix = rng.choice([2, 3, 4, 6])
+        payload = _rand_payload(rng)
+        raw = json.dumps(payload).encode()
+        o_cnt = {}
+        try:
+            o_res = _canon(parse_trace_payload(
+                json.loads(raw), fix, {}, {}, strict=False,
+                counters=o_cnt), wire=False)
+            o_exc = None
+        except Exception as e:  # noqa: BLE001 — parity on the message
+            o_res, o_exc = None, f"{type(e).__name__}: {e}"
+        for disable in ("0", "1"):
+            monkeypatch.setenv("TW_DISABLE_NATIVE", disable)
+            w_cnt = {}
+            try:
+                entries = wire_mod.parse_payload_wire(
+                    raw, fix, {}, strict=False, counters=w_cnt)
+                if entries is None:
+                    ineligible += 1
+                    continue
+                w_res, w_exc = _canon(entries, wire=True), None
+            except Exception as e:  # noqa: BLE001
+                w_res, w_exc = None, f"{type(e).__name__}: {e}"
+            tag = f"trial {trial} fix={fix} native={disable == '0'}"
+            assert o_exc == w_exc, f"{tag}: {o_exc!r} vs {w_exc!r}"
+            assert o_cnt == w_cnt, f"{tag}: counters {o_cnt} vs {w_cnt}"
+            assert o_res == w_res, f"{tag}: accepted spans diverge"
+    assert ineligible == 0  # non-strict, fix in FIX_ROOT_OPS: all eligible
+
+
+# ---------------------------------------------------------------------------
+# malformed dead-letter accounting survives the columnar path
+# ---------------------------------------------------------------------------
+
+def test_malformed_deadletter_counters_pinned_on_columnar(monkeypatch):
+    from traceweaver_tpu.ingest.jaeger import MalformedSpan
+
+    payload = hotel_payload(n_traces=4, prefix="m")
+    payload["data"][0]["spans"][1] = {"spanID": "broken"}  # no ids/times
+    raw = json.dumps(payload).encode()
+
+    monkeypatch.setenv("TW_WIRE_COLUMNAR", "1")
+    svc = TenantService(_cfg())
+    out = svc.ingest("m", raw)
+    assert out["malformed_spans"] == 1
+    assert out["ingested_traces"] == 4  # the trace survives minus the span
+    assert svc.tenants["m"].counters.get("wire_columnar_posts") == 1
+
+    monkeypatch.setenv("TW_WIRE_COLUMNAR", "0")
+    ref = TenantService(_cfg())
+    assert ref.ingest("m", raw) == out
+
+    # strict mode is wire-ineligible by design: the object parser owns
+    # the raise, and the columnar knob must not change the exception
+    monkeypatch.setenv("TW_WIRE_COLUMNAR", "1")
+    strict = TenantService(_cfg(strict=True))
+    with pytest.raises(MalformedSpan):
+        strict.ingest("m", raw)
+    assert strict.tenants["m"].counters.get("wire_columnar_posts") is None
+
+
+def test_invalid_json_post_is_malformed_not_500(tmp_path):
+    from traceweaver_tpu.serve import make_server
+
+    service = TenantService(_cfg())
+    server = make_server(service, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        req = urllib.request.Request(
+            base + "/api/v1/tenants/j/spans", data=b"{not json",
+            method="POST", headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# stitch property test: array BFS == object DFS on randomized DAGs
+# ---------------------------------------------------------------------------
+
+def _rand_stitch_case(rng):
+    from traceweaver_tpu.spans import NA, SKIP, Span
+
+    n = rng.randint(1, 28)
+    services = ["A", "B", "C", None]
+    spans = {}
+    for i in range(n):
+        tid = f"T{rng.randint(0, 3)}"
+        kind = rng.choice(["server", "client"])
+        s = Span.fast(tid, f"s{i}", float(i), 1.0, "op", [],
+                      f"p{rng.randint(0, 2)}", kind)
+        spans[s.GetId()] = s
+    ids = list(spans)
+    phantoms = [(f"T{rng.randint(0, 3)}", f"ghost{k}") for k in range(4)]
+    for s in spans.values():
+        for _ in range(rng.randint(0, 3)):
+            s.children_spans.append(rng.choice(ids + phantoms))
+    svc_of = {sid: rng.choice(services) for sid in ids}
+    assignments = {}
+    for svc in ("A", "B", "C"):
+        eps = {}
+        for ep in range(rng.randint(0, 3)):
+            amap = {}
+            for sid in rng.sample(ids, rng.randint(0, len(ids))):
+                amap[sid] = rng.choice(
+                    [rng.choice(ids), rng.choice(phantoms), NA, SKIP,
+                     "not-a-tuple"])
+            eps[f"ep{ep}"] = amap
+        if eps:
+            assignments[svc] = eps
+    servers = [s for s in spans.values() if s.span_kind == "server"]
+    roots = rng.sample(servers, min(len(servers), rng.randint(0, 5)))
+    live = SimpleNamespace(
+        all_spans=spans,
+        service_of=lambda span: svc_of.get(span.GetId()))
+    return SimpleNamespace(live=live, _stitch_roots=lambda buf: roots), \
+        assignments
+
+
+def test_stitch_arrays_equals_object_dfs_on_random_dags():
+    from traceweaver_tpu.stream.service import StreamingReconstructor
+
+    rng = random.Random(777)
+    for trial in range(120):
+        stub, assignments = _rand_stitch_case(rng)
+        obj = StreamingReconstructor._stitch_objects(stub, None, assignments)
+        arr = StreamingReconstructor._stitch_arrays(stub, None, assignments)
+        assert obj == arr, f"trial {trial}: stitch paths diverge"
+
+
+# ---------------------------------------------------------------------------
+# native-loads-or-fallback: the parse engine is counted, never silent
+# ---------------------------------------------------------------------------
+
+def test_wire_parse_engine_counted_and_on_metrics(monkeypatch):
+    from traceweaver_tpu.ingest import wire as wire_mod
+    from traceweaver_tpu.native import get_lib
+    from traceweaver_tpu.obs.exposition import render_metrics
+    from traceweaver_tpu.obs.registry import get_registry
+
+    raw = json.dumps(hotel_payload(2)).encode()
+
+    def engine_counts():
+        snap = get_registry().snapshot()
+        return {eng: snap.get('tw_wire_parse_total{engine="%s"}' % eng, 0.0)
+                for eng in ("native", "python")}
+
+    monkeypatch.delenv("TW_DISABLE_NATIVE", raising=False)
+    before = engine_counts()
+    assert wire_mod.parse_payload_wire(raw, 2, {}, strict=False,
+                                       counters={}) is not None
+    after = engine_counts()
+    expected = "native" if get_lib() is not None else "python"
+    assert after[expected] == before[expected] + 1.0
+    other = "python" if expected == "native" else "native"
+    assert after[other] == before[other]
+
+    # forcing the native loader off must fall back — and be counted
+    monkeypatch.setenv("TW_DISABLE_NATIVE", "1")
+    assert wire_mod.parse_payload_wire(raw, 2, {}, strict=False,
+                                       counters={}) is not None
+    assert engine_counts()["python"] == after["python"] + 1.0
+
+    text = render_metrics()
+    assert 'tw_wire_parse_total{engine="python"}' in text
+
+
+# ---------------------------------------------------------------------------
+# batched emission: storage layer and resume contract
+# ---------------------------------------------------------------------------
+
+def test_tracesink_write_lines_matches_sequential(tmp_path):
+    from traceweaver_tpu.stream.service import TraceSink
+
+    lines = ['{"a": %d}' % i for i in range(7)] + ["", "trailing"]
+    seq = TraceSink(str(tmp_path / "seq.jsonl"))
+    for line in lines:
+        seq.write_line(line)
+    bat = TraceSink(str(tmp_path / "bat.jsonl"))
+    bat.write_lines(lines)
+    bat.write_lines([])  # no-op, no bytes, no offset move
+    assert seq.offset == bat.offset
+    with open(seq.path, "rb") as f:
+        seq_bytes = f.read()
+    with open(bat.path, "rb") as f:
+        bat_bytes = f.read()
+    assert seq_bytes == bat_bytes
+    assert seq_bytes.endswith(b"trailing\n")
+
+
+def test_kill_resume_byte_identity_with_batched_emitter(tmp_path):
+    pay_a = hotel_payload(12, prefix="a")
+    pay_b = hotel_payload(12, prefix="b", base_us=70_000_000.0)
+
+    # uninterrupted reference run
+    ref = TenantService(_cfg(state_dir=str(tmp_path / "ref")))
+    ref.ingest("t0", pay_a)
+    ref.ingest("t0", pay_b)
+    ref.flush()
+    ref.drain()
+    with open(tmp_path / "ref" / "t0" / "traces.jsonl", "rb") as f:
+        want = f.read()
+    assert want
+
+    # killed mid-stream (graceful drain = checkpoint), then resumed
+    svc = TenantService(_cfg(state_dir=str(tmp_path / "kr")))
+    svc.ingest("t0", pay_a)
+    svc.drain()  # checkpoint with the first window still open
+    svc2 = TenantService.resume(_cfg(state_dir=str(tmp_path / "kr")))
+    assert "t0" in svc2.tenants
+    svc2.ingest("t0", pay_b)
+    svc2.flush()
+    svc2.drain()
+    with open(tmp_path / "kr" / "t0" / "traces.jsonl", "rb") as f:
+        got = f.read()
+    assert got == want

@@ -211,7 +211,8 @@ def _aggregate(fleet: FleetManager) -> Dict[str, object]:
     agg = dict(ingested_traces=0, ingested_spans=0, traces_emitted=0,
                spans_emitted=0, shed_dropped_windows=0,
                deadletter_windows=0, late_dropped=0, quarantined=0,
-               backlog=0, backpressure_429s=0)
+               backlog=0, backpressure_429s=0,
+               parse_s=0.0, stitch_s=0.0, emit_s=0.0)
     p99 = {}
     per_tenant = {}
     for name, st in stats["replica_stats"].items():
@@ -232,6 +233,9 @@ def _aggregate(fleet: FleetManager) -> Dict[str, object]:
             agg["late_dropped"] += int(ts.get("late_dropped", 0))
             agg["quarantined"] += int(ts.get("quarantined_windows", 0))
             agg["backlog"] += int(ts.get("backlog", 0))
+            agg["parse_s"] += float(ts.get("parse_s", 0.0))
+            agg["stitch_s"] += float(ts.get("stitch_s", 0.0))
+            agg["emit_s"] += float(ts.get("emit_s", 0.0))
             p99[tid] = float(ts.get("seal_emit_p99_ms", 0.0))
             per_tenant[f"{name}/{tid}"] = dict(
                 ingested=int(c.get("ingested_traces", 0)),
@@ -458,6 +462,9 @@ def run_fleet_rung(n: int, mode: str, state_root: str, tenants: int,
             replicas_restarted=restarted,
             backpressure_429s=int(agg["backpressure_429s"]),
             generator_429s=sum(d.retry_after_429s for d in all_drives),
+            parse_s=round(float(agg["parse_s"]), 4),
+            stitch_s=round(float(agg["stitch_s"]), 4),
+            emit_s=round(float(agg["emit_s"]), 4),
             zero_loss=True,
         ),
     )

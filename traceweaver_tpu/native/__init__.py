@@ -75,6 +75,8 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.tw_last_error.restype = ctypes.c_char_p
     lib.tw_parse_files.restype = ctypes.c_void_p
     lib.tw_parse_files.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_long]
+    lib.tw_parse_payload.restype = ctypes.c_void_p
+    lib.tw_parse_payload.argtypes = [ctypes.c_char_p, ctypes.c_long]
     lib.tw_corpus_free.argtypes = [ctypes.c_void_p]
     for name in ("tw_num_spans", "tw_num_traces", "tw_num_strings",
                  "tw_num_process_entries"):
@@ -241,6 +243,21 @@ def parse_files(paths: Sequence[str]) -> Optional[NativeCorpus]:
     if not handle:
         return None
     return NativeCorpus(lib, handle, len(paths))
+
+
+def parse_payload(raw: bytes) -> Optional[NativeCorpus]:
+    """Parse one Jaeger-JSON POST body (bytes, the serve wire path) into a
+    NativeCorpus; None if native parsing is unavailable or the payload
+    fails the native loader's fail-fast extraction (missing required span
+    fields, non-numeric times) — the caller then runs the pure-Python wire
+    parser, which owns skip-and-count dead-letter accounting."""
+    lib = get_lib()
+    if lib is None or not raw:
+        return None
+    handle = lib.tw_parse_payload(raw, len(raw))
+    if not handle:
+        return None
+    return NativeCorpus(lib, handle, 1)
 
 
 def last_error() -> str:

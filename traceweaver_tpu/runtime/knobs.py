@@ -79,6 +79,11 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("TW_COLUMNAR", "bool", True,
        help="0 kills the columnar host pack path (object-walk packing, "
             "the bit-identical pre-columnar flow)"),
+    _k("TW_WIRE_COLUMNAR", "bool", True,
+       help="0 kills the columnar wire path (per-span object parse in "
+            "parse_trace_payload, per-root DFS stitch, per-record emit "
+            "writes — the byte-identical pre-r18 serve flow; "
+            "ingest/wire.py)"),
     _k("TW_DEVCOLS", "bool", True,
        help="0 kills the device-resident span-column path (fleet window "
             "tensors assembled on device from HBM rings; 0 restores the "

@@ -49,12 +49,44 @@ from typing import Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from traceweaver_tpu.ingest.jaeger import MalformedSpan
+from traceweaver_tpu.obs.registry import get_registry as _get_registry
+from traceweaver_tpu.runtime import knobs as _knobs
 from traceweaver_tpu.serve.tenancy import TenancyError, TenantService
 
 _TENANT_PATH = re.compile(r"^/api/v1/tenants/([^/]+)(/.*)?$")
 
 #: request body cap (64 MB): a runaway POST must not OOM the service
 MAX_BODY_BYTES = 64 << 20
+
+# rendered error-body cache: under load-campaign backpressure the same
+# 429 body is serialized thousands of times per second on request
+# threads — rendered bytes are reused by exact message. Bounded
+# (clear-on-cap beats LRU bookkeeping at this size); the hit/render
+# ledger on /metrics measures what the cache actually saves.
+_OBS_ERROR_BODY = _get_registry().counter(
+    "tw_serve_error_body_total",
+    "error replies by body source: hit = cached bytes reused, "
+    "render = json.dumps ran on the request thread",
+    labels=("event",))
+_ERROR_BODY_LOCK = threading.Lock()
+_ERROR_BODY_CACHE: dict = {}
+_ERROR_BODY_CAP = 256
+
+
+def _error_body(message: str) -> bytes:
+    with _ERROR_BODY_LOCK:
+        body = _ERROR_BODY_CACHE.get(message)
+    if body is None:
+        body = json.dumps({"error": message},
+                          sort_keys=True).encode("utf-8")
+        _OBS_ERROR_BODY.inc(1.0, event="render")
+        with _ERROR_BODY_LOCK:
+            if len(_ERROR_BODY_CACHE) >= _ERROR_BODY_CAP:
+                _ERROR_BODY_CACHE.clear()
+            _ERROR_BODY_CACHE[message] = body
+    else:
+        _OBS_ERROR_BODY.inc(1.0, event="hit")
+    return body
 
 
 class ServeHandler(BaseHTTPRequestHandler):
@@ -75,6 +107,10 @@ class ServeHandler(BaseHTTPRequestHandler):
     def _reply(self, code: int, payload: dict,
                headers: Optional[dict] = None) -> None:
         body = json.dumps(payload, sort_keys=True).encode("utf-8")
+        self._send(code, body, headers)
+
+    def _send(self, code: int, body: bytes,
+              headers: Optional[dict] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -93,7 +129,7 @@ class ServeHandler(BaseHTTPRequestHandler):
 
     def _error(self, code: int, message: str,
                headers: Optional[dict] = None) -> None:
-        self._reply(code, {"error": message}, headers=headers)
+        self._send(code, _error_body(message), headers=headers)
 
     def _tenancy_error(self, e: TenancyError) -> None:
         """TenancyError -> status: migrated-out tenants are 410 Gone
@@ -159,7 +195,15 @@ class ServeHandler(BaseHTTPRequestHandler):
                         headers={"Retry-After": max(1, int(round(wait_s)))})
                     return
             if tenant_id is not None and sub == "/spans":
-                payload = self._read_json()
+                # default: the raw body goes straight to the columnar
+                # wire parse (ingest/wire.py) — no request-thread
+                # json.loads of a body the wire layer re-reads anyway;
+                # TW_WIRE_COLUMNAR=0 keeps the decoded-dict flow and its
+                # exact "invalid JSON: ..." 400 body
+                if _knobs.get_bool("TW_WIRE_COLUMNAR"):
+                    payload = self._read_body("Jaeger JSON")
+                else:
+                    payload = self._read_json()
                 if payload is None:
                     return
                 self._reply(200, self.service.ingest(tenant_id, payload))

@@ -53,7 +53,12 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from traceweaver_tpu.ingest.jaeger import FIX_ROOT_OPS, parse_trace_payload
+from traceweaver_tpu.ingest.jaeger import (
+    FIX_ROOT_OPS,
+    MalformedSpan,
+    parse_trace_payload,
+)
+from traceweaver_tpu.ingest import wire as _wire
 from traceweaver_tpu.obs import events as _events
 from traceweaver_tpu.obs import quality as _quality
 from traceweaver_tpu.obs.registry import get_registry as _get_registry
@@ -99,6 +104,12 @@ _OBS_DISPATCHER_DEGRADED = _get_registry().gauge(
     "1 while the continuous dispatcher thread has crashed and serve is "
     "degraded to the fixed inline pump (0 = dispatcher healthy / pump "
     "mode by configuration)")
+_OBS_WIRE_INGEST = _get_registry().counter(
+    "tw_wire_ingest_total",
+    "span POSTs by parse path: columnar (the TW_WIRE_COLUMNAR wire "
+    "parse, ingest/wire.py) vs object (parse_trace_payload — knob off, "
+    "strict mode, repair-shim fixes, or converter payloads)",
+    labels=("path",))
 
 
 def _merge_stats(dst: Dict, src: Dict) -> None:
@@ -253,32 +264,73 @@ class Tenant:
         self._capture = None
 
     # -- ingestion --------------------------------------------------------
-    def ingest_payload(self, payload: dict) -> Dict[str, int]:
-        """Fold one posted Jaeger-JSON payload into the tenant's stream.
+    def ingest_payload(self, payload) -> Dict[str, int]:
+        """Fold one posted Jaeger-JSON payload (raw POST ``bytes`` on
+        the default columnar wire path, or a decoded dict) into the
+        tenant's stream.
 
-        Reuses the batch loader's parse pipeline
-        (:func:`parse_trace_payload`) including its malformed-span
-        dead-letter path; applies the FIX mode's root-operation filter
-        (rejected-and-counted, same rule as ``ingest_trace``); then
-        feeds every span as an arrival-ordered event through watermark ->
-        windowing -> scheduler, exactly the stream service's loop body.
+        ``TW_WIRE_COLUMNAR`` (default on) parses eligible payloads
+        through the columnar wire path (:mod:`traceweaver_tpu.ingest.
+        wire`): native byte-level field extraction, Span objects
+        materialized only for traces that pass the root-op filter.
+        Ineligible payloads (and the knob-off path) reuse the batch
+        loader's object pipeline (:func:`parse_trace_payload`) — both
+        share the malformed-span dead-letter counters. Either way the
+        FIX mode's root-operation filter applies (rejected-and-counted,
+        same rule as ``ingest_trace``) and every accepted span feeds as
+        an arrival-ordered event through watermark -> windowing ->
+        scheduler, exactly the stream service's loop body; the host
+        parse cost lands in the ``parse_s`` stage ledger.
         """
         self._bump("posts")
-        parsed = parse_trace_payload(
-            payload, self.cfg.fix, self._self_loop_map,
-            self.svc.live.service_loop_map, strict=self.cfg.strict,
-            counters=self.ingest_counters)
         root_op = FIX_ROOT_OPS[self.cfg.fix]
         n_traces = n_spans = rejected = 0
-        for entry in parsed:
-            if entry is None:
-                continue
-            trace_id, spans, processes = entry
-            root = next((s for s in spans.values() if s.IsRoot()), None)
-            if root is None or (root_op is not None
-                                and root.op_name != root_op):
-                rejected += 1
-                continue
+        accepted = []
+        t0 = time.perf_counter()
+        entries = None
+        if knobs.get_bool("TW_WIRE_COLUMNAR"):
+            entries = _wire.parse_payload_wire(
+                payload, self.cfg.fix, self._self_loop_map,
+                strict=self.cfg.strict, counters=self.ingest_counters)
+        if entries is not None:
+            parse_s = time.perf_counter() - t0
+            for wt in entries:
+                if wt is None:
+                    continue
+                if root_op is not None and wt.root_op != root_op:
+                    rejected += 1
+                    continue
+                t1 = time.perf_counter()
+                accepted.append(wt.materialize())
+                parse_s += time.perf_counter() - t1
+            self.svc._bump("parse_s", parse_s)
+            _OBS_WIRE_INGEST.inc(1.0, path="columnar")
+            self._bump("wire_columnar_posts")
+        else:
+            if isinstance(payload, (bytes, bytearray)):
+                try:
+                    payload = json.loads(payload)
+                except json.JSONDecodeError as e:
+                    raise MalformedSpan(f"invalid JSON: {e}") from None
+            parsed = parse_trace_payload(
+                payload, self.cfg.fix, self._self_loop_map,
+                self.svc.live.service_loop_map, strict=self.cfg.strict,
+                counters=self.ingest_counters)
+            self.svc._bump("parse_s", time.perf_counter() - t0)
+            _OBS_WIRE_INGEST.inc(1.0, path="object")
+            self._bump("wire_object_posts")
+            for entry in parsed:
+                if entry is None:
+                    continue
+                trace_id, spans, processes = entry
+                root = next((s for s in spans.values() if s.IsRoot()),
+                            None)
+                if root is None or (root_op is not None
+                                    and root.op_name != root_op):
+                    rejected += 1
+                    continue
+                accepted.append(entry)
+        for trace_id, spans, processes in accepted:
             n_traces += 1
             ordered = sorted(spans.values(),
                              key=lambda s: (float(s.start_mus), s.sid))
@@ -409,9 +461,12 @@ class Tenant:
         stream service's own emission path, plus ring insertion for the
         live query surface and per-tenant quarantine accounting. Ring
         records carry each trace's ``tw.confidence`` so the live query
-        surface can rank/exclude by reconstruction trust."""
+        surface can rank/exclude by reconstruction trust. Sink writes
+        go through the stream service's batched emitter (one buffered
+        write per solved batch, ``emit_s`` ledger) — the emitted bytes
+        are identical to the per-window writes, just coalesced."""
+        self.svc.emit_batch(results)
         for res in results:
-            self.svc._emit(res)
             if res.poisoned:
                 self._bump("quarantined_windows")
                 self._bump("quarantined_services",
@@ -520,6 +575,9 @@ class Tenant:
             low_confidence_traces=int(
                 svc.stats.get("low_confidence_traces", 0)),
             seal_emit_p99_ms=round(svc.seal_emit_p99_ms() or 0.0, 2),
+            parse_s=round(float(svc.stats.get("parse_s", 0.0)), 6),
+            stitch_s=round(float(svc.stats.get("stitch_s", 0.0)), 6),
+            emit_s=round(float(svc.stats.get("emit_s", 0.0)), 6),
             slo_breaches=int(svc.stats.get("slo_breaches", 0)),
             adapt_refits=int(svc.stats.get("adapt_refits", 0)),
             adapt=(svc.adapt.summary() if svc.adapt is not None else None),
@@ -622,8 +680,10 @@ class TenantService:
                 self.tenants[tenant_id] = t
             return t
 
-    def ingest(self, tenant_id: str, payload: dict) -> Dict[str, int]:
-        """Ingest one payload for one tenant. Under continuous batching
+    def ingest(self, tenant_id: str, payload) -> Dict[str, int]:
+        """Ingest one payload (raw Jaeger-JSON POST ``bytes`` on the
+        default wire path, or a decoded dict) for one tenant. Under
+        continuous batching
         the POST only seals and KICKS the dispatcher (solve cadence is
         the admission scheduler's, decoupled from ingest); the classic
         mode auto-pumps inline once enough sealed windows are queued
@@ -1232,7 +1292,7 @@ class TenantService:
         "late_dropped", "deadletter_windows", "deadletter_spans",
         "low_confidence_traces", "seal_emit_p99_ms", "slo_breaches",
         "adapt_refits", "quarantined_windows", "ring_traces",
-        "ring_evicted")
+        "ring_evicted", "parse_s", "stitch_s", "emit_s")
 
     def metrics_families(self) -> List:
         """Collector-style families for ``GET /metrics``

@@ -63,6 +63,26 @@ class Span:
         self.children_spans: List[SpanId] = []
         self.ep: Optional[str] = None
 
+    @classmethod
+    def fast(cls, trace_id: str, sid: str, start_mus: float,
+             duration_mus: float, op_name: Optional[str],
+             references: List[SpanId], process_id: str,
+             span_kind: Optional[str]) -> "Span":
+        """Cheap materialization for the columnar wire path
+        (ingest/wire.py): bypasses dataclass ``__init__`` argument
+        plumbing and fills ``__dict__`` directly. Semantically identical
+        to the constructor with ``tags=None`` — nothing downstream of
+        the serve path reads ``tags`` (the lazy-object contract,
+        docs/PERF.md \"Wire ingest (r18)\")."""
+        s = cls.__new__(cls)
+        s.__dict__ = {
+            "trace_id": trace_id, "sid": sid, "start_mus": start_mus,
+            "duration_mus": duration_mus, "op_name": op_name,
+            "references": references, "process_id": process_id,
+            "span_kind": span_kind, "tags": None,
+            "children_spans": [], "ep": None}
+        return s
+
     # -- identity ---------------------------------------------------------
     def GetId(self) -> SpanId:
         return (self.trace_id, self.sid)

@@ -126,6 +126,17 @@ class TraceSink:
         self._f.flush()
         self.offset += len(data)
 
+    def write_lines(self, lines: List[str]) -> None:
+        """One buffered write for a whole micro-batch of records —
+        byte-identical to the equivalent :meth:`write_line` sequence
+        (same lines, same order, one flush instead of one per record)."""
+        if not lines:
+            return
+        data = "".join(line + "\n" for line in lines).encode("utf-8")
+        self._f.write(data)
+        self._f.flush()
+        self.offset += len(data)
+
     def truncate(self, offset: int) -> None:
         self._f.truncate(offset)
         self._f.seek(offset)
@@ -560,8 +571,24 @@ class StreamingReconstructor:
     def _stitch(self, buf: WindowBuffer, assignments) -> Dict[str, List]:
         """Assemble predicted traces from this window's owned roots:
         follow each service's predicted outgoing span to its server half
-        downstream and recurse through the window's assignments."""
-        traces: Dict[str, List] = {}
+        downstream and recurse through the window's assignments.
+
+        Dispatches on ``TW_WIRE_COLUMNAR``: the default is the array
+        path (:meth:`_stitch_arrays` — interned span ids, CSR adjacency,
+        one batched numpy BFS over every root at once); ``0`` keeps the
+        per-root object DFS (:meth:`_stitch_objects`). Both produce the
+        identical trace map (tests/test_wire.py property-tests the
+        equivalence on randomized DAGs), so the knob only moves time —
+        counted in the ``stitch_s`` stage ledger either way."""
+        t0 = time.perf_counter()
+        if _knobs.get_bool("TW_WIRE_COLUMNAR"):
+            traces = self._stitch_arrays(buf, assignments)
+        else:
+            traces = self._stitch_objects(buf, assignments)
+        self._bump("stitch_s", time.perf_counter() - t0)
+        return traces
+
+    def _stitch_roots(self, buf: WindowBuffer) -> List[Span]:
         # owned server roots were flagged at buffer-add time (WindowBuffer
         # collects them as spans arrive), so stitching starts from the
         # root list instead of re-scanning every span of the window; the
@@ -572,7 +599,12 @@ class StreamingReconstructor:
             roots = [s for s in buf.spans
                      if s.GetId() in buf.owned_ids
                      and s.span_kind == "server" and s.IsRoot()]
-        for span in roots:
+        return roots
+
+    def _stitch_objects(self, buf: WindowBuffer,
+                        assignments) -> Dict[str, List]:
+        traces: Dict[str, List] = {}
+        for span in self._stitch_roots(buf):
             collected = {span.GetId()}
             stack, visited = [span], set()
             while stack:
@@ -599,6 +631,131 @@ class StreamingReconstructor:
                             collected.add(child.GetId())
                             stack.append(child)
             traces[span.trace_id] = sorted(collected)
+        return traces
+
+    def _stitch_arrays(self, buf: WindowBuffer,
+                       assignments) -> Dict[str, List]:
+        """Array form of :meth:`_stitch_objects`: one shared traversal
+        interns every reachable node and its edges into CSR arrays, then
+        a single numpy BFS advances ALL roots' frontiers at once over
+        (R, N) boolean masks. A subgraph shared by many roots is walked
+        once here instead of once per root, and the per-root bookkeeping
+        is bitmap writes instead of Python set ops. Output is the
+        identical trace map: collected ids are sets sorted at the end on
+        both paths, so edge/visit order never shows through."""
+        roots = self._stitch_roots(buf)
+        if not roots:
+            return {}
+        idx: Dict = {}          # span id -> node index
+        table: List = []        # node index -> span id
+        span_of: Dict[int, Span] = {}
+
+        def intern(sid) -> int:
+            j = idx.get(sid)
+            if j is None:
+                j = len(table)
+                idx[sid] = j
+                table.append(sid)
+            return j
+
+        root_js: List[int] = []
+        work: List[int] = []
+        for s in roots:
+            j = intern(s.GetId())
+            root_js.append(j)
+            if j not in span_of:
+                span_of[j] = s
+                work.append(j)
+        # shared traversal: each node's outgoing assignment edges are a
+        # property of the node alone (its service's assignment map), so
+        # they are computed exactly once no matter how many roots reach
+        # it. coll rows carry everything the node adds to a collected
+        # set (predicted out ids — present in all_spans or not — plus
+        # their server children); next rows carry only the server
+        # children the walk continues through, mirroring the object DFS.
+        coll_map: Dict[int, List[int]] = {}
+        next_map: Dict[int, List[int]] = {}
+        while work:
+            j = work.pop()
+            span = span_of[j]
+            by_ep = assignments.get(self.live.service_of(span))
+            if not by_ep:
+                continue
+            sid = span.GetId()
+            c_row: List[int] = []
+            n_row: List[int] = []
+            for ep_map in by_ep.values():
+                out_id = ep_map.get(sid)
+                if (not isinstance(out_id, tuple)
+                        or out_id in (NA, SKIP)):
+                    continue
+                c_row.append(intern(out_id))
+                out_span = self.live.all_spans.get(out_id)
+                if out_span is None:
+                    continue
+                for child_id in out_span.children_spans:
+                    child = self.live.all_spans.get(child_id)
+                    if child is not None and child.span_kind == "server":
+                        cj = intern(child.GetId())
+                        c_row.append(cj)
+                        n_row.append(cj)
+                        if cj not in span_of:
+                            span_of[cj] = child
+                            work.append(cj)
+            if c_row:
+                coll_map[j] = c_row
+            if n_row:
+                next_map[j] = n_row
+        n = len(table)
+        r = len(roots)
+        coll_indptr = np.zeros(n + 1, np.int64)
+        next_indptr = np.zeros(n + 1, np.int64)
+        coll_flat: List[int] = []
+        next_flat: List[int] = []
+        for j in range(n):
+            coll_flat.extend(coll_map.get(j, ()))
+            next_flat.extend(next_map.get(j, ()))
+            coll_indptr[j + 1] = len(coll_flat)
+            next_indptr[j + 1] = len(next_flat)
+        coll_cols = np.asarray(coll_flat, np.int64)
+        next_cols = np.asarray(next_flat, np.int64)
+
+        def gather(indptr, cols, fr_r, fr_n):
+            # rows fr_r expand to their CSR slices: (row, col) pairs for
+            # every edge out of every frontier node, fully vectorized
+            counts = indptr[fr_n + 1] - indptr[fr_n]
+            total = int(counts.sum())
+            if not total:
+                return (np.empty(0, np.int64),) * 2
+            rows = np.repeat(fr_r, counts)
+            cum = np.cumsum(counts)
+            offs = np.arange(total, dtype=np.int64) \
+                - np.repeat(cum - counts, counts)
+            return rows, cols[np.repeat(indptr[fr_n], counts) + offs]
+
+        visited = np.zeros((r, n), bool)
+        collected = np.zeros((r, n), bool)
+        fr_r = np.arange(r, dtype=np.int64)
+        fr_n = np.asarray(root_js, np.int64)
+        collected[fr_r, fr_n] = True
+        while fr_r.size:
+            visited[fr_r, fr_n] = True
+            c_rows, c_cols = gather(coll_indptr, coll_cols, fr_r, fr_n)
+            if c_rows.size:
+                collected[c_rows, c_cols] = True
+            n_rows, n_cols = gather(next_indptr, next_cols, fr_r, fr_n)
+            if not n_rows.size:
+                break
+            keep = ~visited[n_rows, n_cols]
+            n_rows, n_cols = n_rows[keep], n_cols[keep]
+            if not n_rows.size:
+                break
+            _, uniq = np.unique(n_rows * n + n_cols, return_index=True)
+            fr_r, fr_n = n_rows[uniq], n_cols[uniq]
+        traces: Dict[str, List] = {}
+        for i, span in enumerate(roots):
+            traces[span.trace_id] = sorted(
+                table[j] for j in np.nonzero(collected[i])[0])
         return traces
 
     # -- emission ---------------------------------------------------------
@@ -730,7 +887,31 @@ class StreamingReconstructor:
                         psi=stat if self.drift.mature(key) else None,
                         low_rate=sum(v <= low for v in vals) / len(vals))
 
-    def _emit(self, res: WindowResult) -> None:
+    def emit_batch(self, results: List[WindowResult]) -> None:
+        """Emit one pump's worth of window results. Default
+        (``TW_WIRE_COLUMNAR``): every record is rendered first and the
+        whole batch lands in ONE buffered sink write — the same bytes
+        in the same order as the per-record flow (``0``), so checkpoint
+        truncate-splice, kill/resume, and migration byte-identity hold
+        unchanged (tests/test_wire.py pins the sink bytes across the
+        knob). Dead-letter windows keep their own per-record sidecar
+        writes on both paths. Wall time lands in the ``emit_s`` stage
+        ledger either way."""
+        if not results:
+            return
+        t0 = time.perf_counter()
+        if _knobs.get_bool("TW_WIRE_COLUMNAR") and self.sink is not None:
+            lines: List[str] = []
+            for res in results:
+                self._emit(res, _batch=lines)
+            self.sink.write_lines(lines)
+        else:
+            for res in results:
+                self._emit(res)
+        self._bump("emit_s", time.perf_counter() - t0)
+
+    def _emit(self, res: WindowResult,
+              _batch: Optional[List[str]] = None) -> None:
         if res.poisoned:
             self._deadletter(res)
             return
@@ -764,7 +945,11 @@ class StreamingReconstructor:
                 # low-trust reconstructions the way the culprit query
                 # does, straight off the record
                 rec["tw.confidence"] = conf
-            self.sink.write_line(json.dumps(rec, sort_keys=True))
+            line = json.dumps(rec, sort_keys=True)
+            if _batch is None:
+                self.sink.write_line(line)
+            else:
+                _batch.append(line)
         self.emitted_windows += 1
         sealed_wall = getattr(buf, "sealed_wall", 0.0)
         if sealed_wall:
@@ -1102,8 +1287,7 @@ class StreamingReconstructor:
                 self.scheduler.offer(buf)
             if self.scheduler.backlog >= c.solve_min_batch \
                     or self._slo_pressure():
-                for res in self.scheduler.pump():
-                    self._emit(res)
+                self.emit_batch(list(self.scheduler.pump()))
                 # adaptation refits run OFF the pump, between pumps:
                 # the hot micro-batch dispatch never carries the
                 # out-of-band two-pass refit load
@@ -1135,8 +1319,7 @@ class StreamingReconstructor:
         self._trace_seal(flushed)
         for buf in flushed:
             self.scheduler.offer(buf)
-        for res in self.scheduler.pump():
-            self._emit(res)
+        self.emit_batch(list(self.scheduler.pump()))
         self.maybe_adapt()
         self._checkpoint()
         return self._summary(final=True)
