@@ -843,6 +843,65 @@ def test_tw011_lattice_modules_and_lookalikes_clean():
 
 
 # ---------------------------------------------------------------------------
+# TW012 — serve ticket discipline
+# ---------------------------------------------------------------------------
+
+def test_tw012_inflight_mutation_outside_lifecycle_flagged():
+    # every mutation shape: mutator call, clear, slice-assign, rebind,
+    # augmented assign — all outside the lifecycle allowlist
+    findings, _ = lint("""
+        class TenantService:
+            def prune(self, t, buf):
+                t.in_flight.remove(buf)
+
+            def reset(self, t):
+                t.in_flight.clear()
+                t.in_flight[:] = []
+                t.in_flight = []
+                t.in_flight += [1]
+    """, path="traceweaver_tpu/serve/tenancy.py")
+    assert rules_of(findings).count("TW012") == 5
+    assert findings[0].line == 4  # the remove() site
+
+
+def test_tw012_lifecycle_sites_and_reads_clean():
+    # the real lifecycle: __init__ constructs, submit extends, the
+    # retire helper slice-assigns; everything else only reads
+    findings, _ = lint("""
+        class Tenant:
+            def __init__(self):
+                self.in_flight = []
+
+        class TenantService:
+            def submit_admitted(self, plan):
+                for t, bufs in plan:
+                    t.in_flight.extend(bufs)
+
+            def _ring_retire_locked(self, ticket):
+                for t, bufs in ticket.taken:
+                    drop = {id(b) for b in bufs}
+                    t.in_flight[:] = [b for b in t.in_flight
+                                      if id(b) not in drop]
+
+            def checkpoint_all(self, t):
+                if t.in_flight:
+                    return len(t.in_flight)
+                return 0
+    """, path="traceweaver_tpu/serve/tenancy.py")
+    assert [f for f in findings if f.rule == "TW012"] == []
+
+
+def test_tw012_suppression():
+    findings, suppressed = lint("""
+        class TenantService:
+            def emergency_reset(self, t):
+                t.in_flight.clear()  # twlint: disable=TW012 — why
+    """, path="traceweaver_tpu/serve/tenancy.py")
+    assert [f for f in findings if f.rule == "TW012"] == []
+    assert suppressed == 1
+
+
+# ---------------------------------------------------------------------------
 # CLI plumbing + the tier-1 repo gate
 # ---------------------------------------------------------------------------
 
@@ -852,7 +911,8 @@ def test_module_entry_point_and_cli_subcommand_list_rules(capsys):
 
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rid in ("TW001", "TW002", "TW003", "TW004", "TW005", "TW006"):
+    for rid in ("TW001", "TW002", "TW003", "TW004", "TW005", "TW006",
+                "TW012"):
         assert rid in out
     assert cli.main(["lint", "--list-rules"]) == 0
 

@@ -354,6 +354,51 @@ def test_continuous_fields_slo_and_throughput_verdicts(bench):
     assert empty["continuous_p99_within_slo"] is None
 
 
+def test_overlap_fields_ring_engagement_and_throughput_verdicts(bench):
+    """The --serve-overlap leg's report builder: serial (inflight=1) vs
+    ring run summaries -> the overlap_* field set, with the headline
+    triple (beats serial on spans/s; measured overlap_pct > 0 with the
+    ring actually engaged; worst-tenant p99 inside the SLO) and the
+    zero-steady-compiles flag."""
+    serial = dict(spans=3000, wall_s=3.0, p99_max_ms=900.0)
+    ring = dict(spans=3000, wall_s=2.0, p99_max_ms=1100.0,
+                steady_compiles=0,
+                ring=dict(enabled=True, inflight_limit=2, outstanding=0,
+                          submitted=12, completed=12, aborted=0,
+                          overlap_pct=37.5))
+    out = bench.overlap_fields(24, 2, 2000.0, serial, ring)
+    assert out["overlap_tenants"] == 24
+    assert out["overlap_inflight"] == 2
+    assert out["overlap_spans_per_s"] == 1500.0
+    assert out["overlap_spans_per_s_serial"] == 1000.0
+    assert out["overlap_speedup_vs_serial_pct"] == 50.0
+    assert out["overlap_beats_serial"] is True
+    assert out["overlap_pct"] == 37.5
+    assert out["overlap_ring_engaged"] is True
+    assert out["overlap_tickets_submitted"] == 12
+    assert out["overlap_tickets_completed"] == 12
+    assert out["overlap_tickets_aborted"] == 0
+    assert out["overlap_seal_emit_p99_ms_max"] == 1100.0
+    assert out["overlap_seal_emit_p99_ms_max_serial"] == 900.0
+    assert out["overlap_p99_within_slo"] is True
+    assert out["overlap_zero_steady_compiles"] is True
+    # a ring that never held two tickets at once is NOT engaged — and a
+    # recompiling or SLO-breaching ring flips its verdicts
+    idle = bench.overlap_fields(
+        24, 2, 2000.0, serial,
+        dict(ring, p99_max_ms=2500.0, steady_compiles=2,
+             ring=dict(ring["ring"], overlap_pct=0.0)))
+    assert idle["overlap_ring_engaged"] is False
+    assert idle["overlap_p99_within_slo"] is False
+    assert idle["overlap_zero_steady_compiles"] is False
+    # empty/zero inputs degrade to None rates, never divide-by-zero
+    empty = bench.overlap_fields(0, 1, 2000.0, {}, {})
+    assert empty["overlap_spans_per_s"] is None
+    assert empty["overlap_speedup_vs_serial_pct"] is None
+    assert empty["overlap_p99_within_slo"] is None
+    assert empty["overlap_ring_engaged"] is False
+
+
 @pytest.mark.collector
 def test_capture_fields_hardening_verdicts(bench):
     """The --capture leg's report builder: clean/skew/lossy run

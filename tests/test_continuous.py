@@ -19,6 +19,8 @@ Contracts pinned here:
 Synthetic feeds, JAX_PLATFORMS=cpu — tier-1.
 """
 
+import os
+import threading
 import time
 
 import pytest
@@ -337,12 +339,17 @@ def test_stream_slo_pressure_unit():
 # crash containment: the dispatcher thread must degrade, never wedge
 # ---------------------------------------------------------------------------
 
-def test_dispatcher_crash_degrades_to_fixed_pump(tmp_path, warm_programs):
+@pytest.mark.parametrize("inflight", [1, 2])
+def test_dispatcher_crash_degrades_to_fixed_pump(tmp_path, warm_programs,
+                                                 inflight):
     """An uncaught exception on the ContinuousDispatcher thread used to
     die silently with serve still accepting spans (every tenant's
     seal→emit path wedged). Now: the crash is counted + evented, the
     degraded gauge flips, the service falls back to the FIXED pump, and
-    tenants keep emitting."""
+    tenants keep emitting. Parametrized over the dispatch ring: with
+    TW_SERVE_INFLIGHT>1 the poison fires on submit_admitted (the ring
+    path the dispatcher actually calls) and containment must still land
+    on the dispatcher thread via ring_raise_pending."""
     import json as _json
 
     from traceweaver_tpu.obs import events as obs_events
@@ -351,10 +358,13 @@ def test_dispatcher_crash_degrades_to_fixed_pump(tmp_path, warm_programs):
     log = obs_events.EventLog(str(tmp_path / "events.jsonl"))
     prev_log = obs_events.install(log)
     svc = TenantService(_cfg(continuous=True, slo_p99_ms=50.0,
-                             pump_windows=1))
+                             pump_windows=1, inflight=inflight))
     real_solve = svc.solve_admitted
-    svc.solve_admitted = lambda plan: (_ for _ in ()).throw(
+    real_submit = svc.submit_admitted
+    boom = lambda plan: (_ for _ in ()).throw(  # noqa: E731
         RuntimeError("boom: deliberate dispatcher crash"))
+    svc.solve_admitted = boom
+    svc.submit_admitted = boom
     try:
         _feed(svc, n_tenants=2, chunks=2, traces=2)
         deadline = time.time() + 30
@@ -370,6 +380,7 @@ def test_dispatcher_crash_degrades_to_fixed_pump(tmp_path, warm_programs):
         # the solve path heals once the poison is gone: ingest now pumps
         # inline (fixed-pump mode) and the stranded windows emit
         svc.solve_admitted = real_solve
+        svc.submit_admitted = real_submit
         _feed(svc, n_tenants=2, chunks=2, traces=2)
         svc.flush()
         emitted = sum(t["emitted_windows"]
@@ -384,3 +395,182 @@ def test_dispatcher_crash_degrades_to_fixed_pump(tmp_path, warm_programs):
                 and r["event"] == "dispatcher_degraded"]
     assert len(degraded) == 1
     assert "boom" in degraded[0]["error"]
+
+
+# ---------------------------------------------------------------------------
+# the in-flight dispatch ring (ISSUE 19): overlap, FIFO consume, barriers
+# ---------------------------------------------------------------------------
+
+def _sink_bytes(state_dir):
+    out = {}
+    for ten in sorted(os.listdir(state_dir)):
+        p = os.path.join(state_dir, ten, "traces.jsonl")
+        if os.path.isfile(p):
+            with open(p, "rb") as f:
+                out[ten] = f.read()
+    return out
+
+
+def _quiesce(svc, timeout_s=30.0):
+    deadline = time.time() + timeout_s
+    while (svc.total_backlog() or svc.in_flight_windows()) \
+            and time.time() < deadline:
+        time.sleep(0.02)
+
+
+def _manual_service(tmp_path, tag, n_tenants=1):
+    """A pump-less, dispatcher-less service with naturally sealed
+    windows — the fixture for driving the ticket lifecycle by hand
+    (submit/_ring_dispatch/complete in controlled orders)."""
+    svc = TenantService(_cfg(state_dir=str(tmp_path / tag),
+                             pump_windows=10**9))
+    _feed(svc, n_tenants=n_tenants, chunks=3, traces=3)
+    return svc
+
+
+def _ready_halves(svc, tid="t00"):
+    with svc._lock:
+        t = svc.tenants[tid]
+        ready = list(t.svc.scheduler.ready())
+    assert len(ready) >= 2, f"need >=2 sealed windows, got {len(ready)}"
+    half = len(ready) // 2
+    return t, [ready[:half], ready[half:]]
+
+
+def test_serve_inflight_knob_registered_and_resolved():
+    from traceweaver_tpu.runtime import knobs
+
+    k = dict(knobs.REGISTRY)["TW_SERVE_INFLIGHT"]
+    assert k.type == "int" and k.lo == 1 and k.hi == 8 and k.help
+    assert knobs.get_int("TW_SERVE_INFLIGHT") == 2  # overlap is the default
+    assert _cfg().inflight == 2          # ServeConfig resolves the knob
+    assert _cfg(inflight=1).inflight == 1  # explicit kill switch wins
+
+
+def test_inflight_one_kill_switch_byte_identical(tmp_path, warm_programs):
+    """The kill switch (ISSUE 19 acceptance): TW_SERVE_INFLIGHT=1 runs
+    the serial admit→solve→consume dispatcher and its emitted sinks are
+    byte-identical to the fixed pump (the pre-ring reference the serial
+    dispatcher was already pinned against); the default ring (inflight=2)
+    must ALSO emit identical bytes — FIFO consume keeps per-tenant
+    emission order, so overlap moves wall time, never content."""
+    def run(tag, **kw):
+        d = str(tmp_path / tag)
+        svc = TenantService(_cfg(state_dir=d, pump_windows=4, **kw))
+        _feed(svc, n_tenants=2, chunks=3, traces=3)
+        svc.flush()
+        _quiesce(svc)
+        st = svc.stats()
+        svc.drain()
+        return _sink_bytes(d), st
+
+    pump_bytes, _ = run("pump")
+    ser_bytes, ser_st = run("serial", continuous=True,
+                            slo_p99_ms=30_000.0, inflight=1)
+    ring_bytes, ring_st = run("ring", continuous=True,
+                              slo_p99_ms=30_000.0, inflight=2)
+    assert ser_bytes == pump_bytes
+    assert ring_bytes == pump_bytes
+    # structural: inflight=1 never runs the worker pool, the ring does
+    assert ser_st["ring"]["enabled"] is False
+    assert ser_st["ring"]["inflight_limit"] == 1
+    assert ring_st["ring"]["enabled"] is True
+    assert ring_st["ring"]["submitted"] == ring_st["ring"]["completed"]
+    assert ring_st["ring"]["outstanding"] == 0
+    assert ring_st["ring"]["aborted"] == 0
+
+
+def test_ticket_fifo_consume_and_out_of_order_dispatch(tmp_path,
+                                                       warm_programs):
+    """Two tickets submitted back-to-back, dispatched OUT of order:
+    ticket 2's complete must block until ticket 1 retires (FIFO consume
+    is what keeps per-tenant emission order serial), per-tenant
+    in_flight retires per ticket (identity removal, not clear), and the
+    final bytes equal the serial composition's."""
+    serial = _manual_service(tmp_path, "serial")
+    t, plans = _ready_halves(serial)
+    for p in plans:
+        assert serial.solve_admitted([(t, p)]) >= 1
+    serial.drain()
+
+    over = _manual_service(tmp_path, "overlap")
+    t, plans = _ready_halves(over)
+    tk1 = over.submit_admitted([(t, plans[0])])
+    tk2 = over.submit_admitted([(t, plans[1])])
+    assert tk1 is not None and tk2 is not None
+    assert len(t.in_flight) == len(plans[0]) + len(plans[1])
+    assert over.stats()["ring"]["outstanding"] == 2
+    over._ring_dispatch(tk2)            # out of order on purpose
+    over._ring_dispatch(tk1)
+    done = []
+    th = threading.Thread(
+        target=lambda: done.append(over.complete_ticket(tk2)), daemon=True)
+    th.start()
+    time.sleep(0.25)
+    assert th.is_alive(), "ticket 2 consumed before ticket 1 (FIFO broken)"
+    n1 = over.complete_ticket(tk1)
+    th.join(timeout=30)
+    assert not th.is_alive() and n1 >= 1 and done and done[0] >= 1
+    assert not t.in_flight                # both tickets fully retired
+    st = over.stats()["ring"]
+    assert st["outstanding"] == 0
+    assert st["submitted"] == 2 and st["completed"] == 2
+    over.drain()
+    assert _sink_bytes(str(tmp_path / "overlap")) == \
+        _sink_bytes(str(tmp_path / "serial"))
+
+
+def test_checkpoint_skips_tenant_with_outstanding_ticket(tmp_path,
+                                                         warm_programs):
+    """state_dict captures the scheduler queues, not windows a ticket
+    took off them: checkpoint_all must SKIP a tenant whose windows are
+    riding an outstanding ticket (its last good checkpoint stays
+    current) and land the checkpoint once the ticket retires."""
+    svc = _manual_service(tmp_path, "ckpt")
+    t, plans = _ready_halves(svc)
+    tk = svc.submit_admitted([(t, plans[0] + plans[1])])
+    assert tk is not None
+    out = svc.checkpoint_all(timeout_s=0.3)   # bounded barrier times out
+    assert out["skipped"] >= 1 and out["checkpointed"] == 0, out
+    svc._ring_dispatch(tk)
+    assert svc.complete_ticket(tk) >= 1
+    out = svc.checkpoint_all(timeout_s=10.0)
+    assert out["checkpointed"] == 1 and out["skipped"] == 0, out
+    svc.drain()
+
+
+def test_drain_barriers_on_outstanding_ticket_resume_byte_identical(
+        tmp_path, warm_programs):
+    """ISSUE 19 satellite: a drain cut while a ticket is in flight must
+    barrier on the ticket (retired before state_dict, never lost), and
+    the kill/resume output must stay byte-identical to an uninterrupted
+    run."""
+    ref = _manual_service(tmp_path, "ref")
+    ref.flush()
+    ref.drain()
+
+    svc = _manual_service(tmp_path, "cut")
+    t, plans = _ready_halves(svc)
+    tk = svc.submit_admitted([(t, plans[0] + plans[1])])
+    assert tk is not None
+
+    def finish():
+        time.sleep(0.3)
+        svc._ring_dispatch(tk)
+        svc.complete_ticket(tk)
+
+    th = threading.Thread(target=finish, daemon=True)
+    th.start()
+    t0 = time.monotonic()
+    out = svc.drain()                      # must block on the barrier
+    th.join(timeout=30)
+    assert time.monotonic() - t0 >= 0.25, \
+        "drain returned before the outstanding ticket retired"
+    assert out["checkpointed"] == 1 and out["skipped"] == 0, out
+    # "kill": resume from the drained state dir, solve the remainder
+    resumed = TenantService.resume(_cfg(state_dir=str(tmp_path / "cut"),
+                                        pump_windows=10**9))
+    resumed.flush()
+    resumed.drain()
+    assert _sink_bytes(str(tmp_path / "cut")) == \
+        _sink_bytes(str(tmp_path / "ref"))

@@ -332,3 +332,61 @@ def test_inproc_wire_campaign_artifact_and_self_compare(tmp_path):
     assert "fleet-1" in report and "fleet-2" in report
     res = compare_artifacts(art, loaded, tol_pct=10.0, tol_acc=1.0)
     assert res["ok"], res["regressions"]
+
+
+# ---------------------------------------------------------------------------
+# migration under the dispatch ring (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def test_migration_under_overlap_byte_identical(tmp_path):
+    """Generalizes the handoff suite to the in-flight ring: migrate_out
+    of a tenant whose windows are riding TWO different outstanding
+    tickets must block until BOTH retire (the PR 16 wait-for-retire fix
+    over a set of tickets, not one dispatch), and the migrated output
+    stays byte-identical to the unmigrated single-service run."""
+    import threading
+
+    pays = [hotel_payload(n_traces=6, prefix=f"w{k}-",
+                          base_us=10e6 + k * 61e6) for k in range(4)]
+    both = {"data": [t for p in pays for t in p["data"]]}
+    base_bytes, _ = _run_single_tenant(tmp_path, "mig", both)
+
+    src = TenantService(_cfg(state_dir=str(tmp_path / "src")))
+    dst = TenantService(_cfg(state_dir=str(tmp_path / "dst")))
+    for p in pays:
+        src.ingest("mig", p)
+    with src._lock:
+        t = src.tenants["mig"]
+        ready = list(t.svc.scheduler.ready())
+    assert len(ready) >= 2, f"need >=2 sealed windows, got {len(ready)}"
+    tk1 = src.submit_admitted([(t, ready[:1])])
+    tk2 = src.submit_admitted([(t, ready[1:])])
+    assert tk1 is not None and tk2 is not None
+
+    moved = []
+    th = threading.Thread(
+        target=lambda: moved.append(src.migrate_out("mig")), daemon=True)
+    th.start()
+    time.sleep(0.3)
+    assert th.is_alive(), "migrate_out ran with tickets outstanding"
+    src._ring_dispatch(tk1)
+    src.complete_ticket(tk1)
+    time.sleep(0.3)
+    assert th.is_alive(), \
+        "migrate_out proceeded with ticket 2 still outstanding"
+    src._ring_dispatch(tk2)
+    src.complete_ticket(tk2)
+    th.join(timeout=30)
+    assert not th.is_alive() and moved, "migration never unblocked"
+
+    dst.migrate_in("mig", moved[0])
+    with pytest.raises(TenancyError, match="migrated out"):
+        src.tenant("mig")
+    dst.flush()
+    st = dst.stats("mig")
+    assert st["traces_emitted"] == 24
+    assert st["shed_dropped_windows"] == 0
+    src.drain()
+    dst.drain()
+    with open(tmp_path / "dst" / "mig" / "traces.jsonl", "rb") as f:
+        assert f.read() == base_bytes

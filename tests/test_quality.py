@@ -357,6 +357,32 @@ def test_drift_psi_reference_rolling_and_single_alert(tmp_path):
     assert shifts[0]["key"] == "svc" and shifts[0]["psi"] > 0.2
 
 
+def test_drift_mature_gauge_and_immature_psi_not_actionable():
+    """ISSUE 19 satellite (the CAMPAIGN_r18 psi=6.17 scrape): right
+    after the reference freezes, the rolling window is thin and its PSI
+    is sampling noise — mature() must be False there (the adapt ladder
+    gates on it, stream/service.py passes psi=None to the controller),
+    and the explicit tw_confidence_drift_mature gauge must export 0 so
+    a scrape can tell a thin-window excursion from a real shift."""
+    from traceweaver_tpu.obs.registry import get_registry
+
+    d = quality.ConfidenceDrift(window=16, threshold=0.2)
+    d.update("k", [0.9] * 16)            # freezes the reference
+    # thin rolling window: PSI exports (operators can weigh it) but the
+    # key is NOT mature — this is exactly the r18 excursion shape
+    stat = d.update("k", [0.2] * 4)
+    assert stat is not None
+    assert d.mature("k") is False
+    snap = get_registry().snapshot()
+    assert snap.get('tw_confidence_drift_mature{key="k"}') == 0.0
+    assert snap.get('tw_confidence_drift_psi{key="k"}') == stat
+    # a full rolling window matures the key and flips the gauge
+    d.update("k", [0.2] * 16)
+    assert d.mature("k") is True
+    snap = get_registry().snapshot()
+    assert snap.get('tw_confidence_drift_mature{key="k"}') == 1.0
+
+
 def test_drift_state_roundtrip():
     d = quality.ConfidenceDrift(window=8, threshold=0.3)
     d.update("a", [0.8] * 8)

@@ -215,7 +215,14 @@ class _Stats:
     one locked helper. ``d is None`` (caller passed no stats) makes the
     dict half a no-op; the obs-registry mirror runs either way, so the
     scrape surface never has blind spots (twlint TW007 enforces that no
-    new counter grows outside this path)."""
+    new counter grows outside this path).
+
+    The serve dispatch ring leans on the same shape from the outside:
+    each concurrent ``solve_fleet`` call (one per in-flight ticket)
+    gets its OWN local stats dict — and therefore its own ``_Stats``
+    instance and lock — so ticket dispatches never contend here; the
+    per-ticket dicts are folded into the service ledger under the
+    service lock at complete (serve/tenancy.py ``_merge_stats``)."""
 
     def __init__(self, d: Optional[Dict[str, float]]):
         self.d = d

@@ -29,6 +29,8 @@ Rule ids are stable (baseline entries and suppressions reference them):
   only through the ledgered fetch
 - TW010 adapt ledger         — adaptation actuations route through the
   controller's evented ledger; no silent rung transitions
+- TW012 ticket discipline    — per-tenant ``in_flight`` windows mutate
+  only inside the ticket lifecycle (submit extends, retire removes)
 """
 
 from __future__ import annotations
@@ -1217,9 +1219,94 @@ class AotCompileDiscipline:
         return findings
 
 
+# ---------------------------------------------------------------------------
+# TW012 — serve ticket discipline
+# ---------------------------------------------------------------------------
+
+class TicketDiscipline:
+    """Per-tenant ``in_flight`` windows mutate only inside the ticket
+    lifecycle.
+
+    The overlapped serve drain (ISSUE 19) splits admit→solve→consume
+    into tickets: ``submit_admitted`` takes windows off the tenant
+    queues and records them in ``Tenant.in_flight`` (under the service
+    lock), and ``_ring_retire_locked`` identity-removes exactly that
+    ticket's windows when it retires (complete or abort, again under
+    the lock). Everything between — retention pruning, checkpoint
+    skip/barrier decisions, ``migrate_out``'s wait-for-retire, the
+    flush barrier — only READS the list. A mutation anywhere else
+    breaks the accounting both directions: windows vanish from
+    ``in_flight`` while a worker still holds them (retention prunes a
+    buffer mid-solve, a checkpoint captures a state the replay will
+    double-count), or linger after retirement (drain and migration
+    barriers deadlock waiting for a ticket that already completed).
+    TW005 cannot see this — ``in_flight`` lives on ``Tenant``, not on
+    the lock-owning service — so the lifecycle contract gets its own
+    rule.
+
+    Mechanics: flags any mutator-method call on ``<x>.in_flight``
+    (the TW005 mutator set: append/extend/clear/remove/...) and any
+    assignment or augmented assignment whose target is
+    ``<x>.in_flight`` or ``<x>.in_flight[...]`` (the slice-assign
+    retire idiom counts), unless the enclosing outer function is one
+    of the lifecycle sites: ``__init__`` (construction), the submit
+    half, or the retire helper.
+    """
+
+    id = "TW012"
+    title = "in_flight mutated outside the ticket lifecycle"
+
+    #: the only functions allowed to mutate in_flight — construction,
+    #: the submit half (extend under the service lock), and the single
+    #: retire helper both complete and abort funnel through
+    LIFECYCLE = frozenset({"__init__", "submit_admitted",
+                           "_ring_retire_locked"})
+    ATTR = "in_flight"
+
+    @classmethod
+    def _inflight_attr(cls, node: ast.AST) -> bool:
+        """``<x>.in_flight`` or ``<x>.in_flight[...]`` (any receiver —
+        ``self``, a tenant local, a dict lookup)."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        return isinstance(node, ast.Attribute) and node.attr == cls.ATTR
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        exempt: Set[int] = set()
+        for fn in outer_functions(mod.tree):
+            if fn.name in self.LIFECYCLE:
+                exempt.update(id(n) for n in ast.walk(fn))
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            hit = False
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    elts = (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                            else [t])
+                    hit = hit or any(self._inflight_attr(e) for e in elts)
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in LockDiscipline._MUTATORS):
+                hit = self._inflight_attr(node.func.value)
+            if hit and id(node) not in exempt:
+                findings.append(mod.finding(
+                    self.id, node,
+                    "in_flight mutated outside the ticket lifecycle — "
+                    "only submit_admitted (extend) and "
+                    "_ring_retire_locked (identity removal) may touch "
+                    "per-tenant in_flight, under the service lock; "
+                    "anything else desyncs retention pruning, "
+                    "checkpoint barriers, and migrate_out's "
+                    "wait-for-retire (docs/SERVING.md, ticket "
+                    "lifecycle)"))
+        return findings
+
+
 #: registration order == reporting order for same-line findings
 RULE_CLASSES = [KnobDiscipline, ImportTimeFreeze, HostSyncHazard,
                 RecompileDiscipline, LockDiscipline, PrecisionDiscipline,
                 MetricDiscipline, ChannelLayoutDiscipline,
                 DevcolsResidency, AdaptLedgerDiscipline,
-                AotCompileDiscipline]
+                AotCompileDiscipline, TicketDiscipline]

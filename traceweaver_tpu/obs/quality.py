@@ -68,6 +68,14 @@ _OBS_DRIFT = _OBS.gauge(
     "distribution vs its frozen reference window (ground-truth-free "
     "drift signal)",
     labels=("key",))
+_OBS_DRIFT_MATURE = _OBS.gauge(
+    "tw_confidence_drift_mature",
+    "1 once the rolling confidence window behind tw_confidence_drift_psi "
+    "is fully populated, 0 while the PSI is estimated from a thin "
+    "window (sampling noise, not drift — the adapt ladder ignores "
+    "immature PSI, and a dashboard should too: CAMPAIGN_r18's "
+    "psi=6.17 excursion was an immature chaos-phase window)",
+    labels=("key",))
 
 
 def conf_enabled() -> bool:
@@ -353,6 +361,9 @@ class ConfidenceDrift:
             return None
         stat = psi(self._ref[key], _bin_counts(cur))
         _OBS_DRIFT.set(stat, key=key)
+        # exported alongside the PSI so a scrape can tell a real shift
+        # from a thin-window excursion without knowing the window size
+        _OBS_DRIFT_MATURE.set(1.0 if self.mature(key) else 0.0, key=key)
         if stat > self.threshold and not self._alerted.get(key):
             self._alerted[key] = True
             self.alerts += 1

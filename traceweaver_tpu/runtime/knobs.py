@@ -202,6 +202,12 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        help="per-tenant seal→emit latency SLO (p99, milliseconds): the "
             "continuous-batching scheduler admits SLO-at-risk windows "
             "ahead of batch-fill efficiency"),
+    _k("TW_SERVE_INFLIGHT", "int", 2, lo=1, hi=8,
+       help="continuous-serve dispatch ring depth: admitted batches "
+            "(tickets) allowed in flight at once — the dispatcher packs "
+            "batch N+1 while batch N executes; 1 restores the serial "
+            "admit→solve→consume dispatcher byte-exactly (the kill "
+            "switch)"),
     # --- fleet serve tier (traceweaver_tpu/fleet_serve, docs/SERVING.md) -
     _k("TW_FLEET_REPLICAS", "int", 2, lo=1, hi=64,
        help="replica count for `cli fleet`: serve processes the router "

@@ -265,9 +265,15 @@ class ContinuousDispatcher:
         One giant catch-all dispatch would mint batch shapes the steady
         state never compiles (a 256-row flush program serves exactly one
         flush); fill-sized chunks keep every dispatch on the same
-        bounded shape lattice the admission loop runs on."""
+        bounded shape lattice the admission loop runs on.
+
+        Barriers on the dispatch ring each pass: a flush that raced an
+        in-flight ticket used to report drained while the ticket's
+        windows were still mid-solve (undercounting emitted traces) —
+        drained now means queues empty AND zero outstanding tickets."""
         total = 0
         while True:
+            self.service.wait_idle(self.service.cfg.drain_timeout_s)
             with self.service._lock:
                 cands = self._candidates()
                 if not cands:
@@ -315,23 +321,36 @@ class ContinuousDispatcher:
             with self._cond:
                 if self._stop:
                     return
+            # a ring-worker error must crash THIS thread (containment
+            # lives here): poll even when idle, so a worker death with
+            # no further admissions still degrades serve
+            self.service.ring_raise_pending()
             with self.service._lock:
                 plan, wait = self._admit()
             if plan:
-                # solve_admitted drops the service lock around the
-                # device dispatch — ingest keeps flowing while the
-                # fleet executes (the throughput half of continuous
-                # batching; the fixed pump solves inline on the
-                # ingesting request's thread)
+                if self.service.ring_enabled:
+                    # overlapped drain (TW_SERVE_INFLIGHT > 1): submit
+                    # takes the windows and launches the ticket on the
+                    # worker pool, then THIS thread loops straight back
+                    # to admitting batch N+1 while batch N executes —
+                    # throttled to the ring bound. EWMA/fill bookkeeping
+                    # arrives via note_solve when each ticket completes.
+                    ticket = self.service.submit_admitted(plan)
+                    if ticket is not None:
+                        self.service.launch_ticket(ticket)
+                        self.service.ring_throttle()
+                    self.service.run_adaptations()
+                    continue
+                # serial path (TW_SERVE_INFLIGHT=1, the kill switch):
+                # solve_admitted still drops the service lock around the
+                # device dispatch — ingest keeps flowing while the fleet
+                # executes (the throughput half of continuous batching;
+                # the fixed pump solves inline on the ingesting
+                # request's thread)
                 t0 = time.perf_counter()
                 n = self.service.solve_admitted(plan)
                 if n:
-                    solve_s = time.perf_counter() - t0
-                    self.solve_ewma_s = (
-                        (1 - self._EWMA) * self.solve_ewma_s
-                        + self._EWMA * solve_s)
-                    self.dispatches += 1
-                    _OBS_BATCH_FILL.observe(float(n))
+                    self.note_solve(time.perf_counter() - t0, n)
                 # drift-adaptation tick: refits the retired solve's
                 # emissions scheduled run NOW, as their own dispatches,
                 # before the next admission — off the hot batch
@@ -340,6 +359,20 @@ class ContinuousDispatcher:
             with self._cond:
                 if not self._stop:
                     self._cond.wait(timeout=wait)
+
+    def note_solve(self, solve_s: float, n: int) -> None:
+        """Fold one retired dispatch into the pacing model (EWMA solve
+        wall, dispatch count, batch-fill histogram). The serial loop
+        calls this inline; ring tickets call it from complete_ticket —
+        under the ring the EWMA tracks per-ticket device wall, which is
+        exactly what the admission deadline math needs (a ticket's wall
+        is the lead time an SLO-at-risk window must be admitted by)."""
+        if n <= 0:
+            return
+        self.solve_ewma_s = ((1 - self._EWMA) * self.solve_ewma_s
+                             + self._EWMA * solve_s)
+        self.dispatches += 1
+        _OBS_BATCH_FILL.observe(float(n))
 
     # -- introspection ----------------------------------------------------
     def stats(self) -> Dict:

@@ -13,6 +13,7 @@ Endpoint reference (full table + curl quickstart in docs/SERVING.md)::
     POST /api/v1/tenants/<id>/migrate_out          live migration, source half
     POST /api/v1/tenants/<id>/migrate_in           live migration, dest half
     POST /api/v1/flush                             seal+solve now (all)
+    POST /api/v1/reset_latency_window              fresh seal→emit p99 window
     GET  /api/v1/tenants                           tenant list
     GET  /api/v1/tenants/<id>/traces               recent trace ids (ring)
     GET  /api/v1/tenants/<id>/traces/<trace_id>    one reconstructed trace
@@ -187,12 +188,17 @@ class ServeHandler(BaseHTTPRequestHandler):
                 # observed drain pace, so closed-loop clients back off
                 wait_s = self.service.retry_after(tenant_id)
                 if wait_s is not None:
+                    # fractional header (RFC 9110 allows only integer
+                    # seconds, but every client here parses float — and
+                    # rounding sub-second waits up to 1s re-quantizes
+                    # the closed-loop generators the drain-rate-derived
+                    # wait exists to de-synchronize)
                     self._error(
                         429,
                         f"tenant {tenant_id!r} backpressured: sealed-"
                         "window queues full; retry after "
-                        f"{wait_s:.0f}s",
-                        headers={"Retry-After": max(1, int(round(wait_s)))})
+                        f"{wait_s:.2f}s",
+                        headers={"Retry-After": f"{max(0.05, wait_s):.2f}"})
                     return
             if tenant_id is not None and sub == "/spans":
                 # default: the raw body goes straight to the columnar
@@ -250,6 +256,13 @@ class ServeHandler(BaseHTTPRequestHandler):
                     tenant_id, transfer))
             elif tenant_id is None and sub == "/api/v1/flush":
                 self._reply(200, self.service.flush())
+            elif tenant_id is None and sub == "/api/v1/reset_latency_window":
+                # campaign warmup boundary (fleet_serve/campaign.py):
+                # warmup windows sit sealed until the warmup flush, so
+                # their seal→emit samples are flush-wait artifacts —
+                # reset lets the measured phase report its own p99
+                self.service.reset_latency_window()
+                self._reply(200, {"ok": True})
             else:
                 self._error(404, f"no such endpoint: POST {sub or self.path}")
         except TenancyError as e:

@@ -47,9 +47,11 @@ from __future__ import annotations
 import base64
 import json
 import os
+import queue
 import re
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -110,6 +112,22 @@ _OBS_WIRE_INGEST = _get_registry().counter(
     "parse, ingest/wire.py) vs object (parse_trace_payload — knob off, "
     "strict mode, repair-shim fixes, or converter payloads)",
     labels=("path",))
+_OBS_INFLIGHT = _get_registry().gauge(
+    "tw_serve_inflight",
+    "dispatch-ring tickets currently outstanding (admitted + launched, "
+    "consume not yet retired; 0 in pump mode / idle)")
+_OBS_OVERLAP = _get_registry().gauge(
+    "tw_serve_overlap_pct",
+    "percent of ring device-dispatch wall that ran concurrently with "
+    "another ticket (100*(1 - union/busy); 0 under the serial "
+    "dispatcher / TW_SERVE_INFLIGHT=1)")
+_OBS_RETRY_AFTER = _get_registry().histogram(
+    "tw_serve_retry_after_seconds",
+    "Retry-After seconds advertised on 429 backpressure responses "
+    "(drain-rate derived since the in-flight ring; sub-second values "
+    "are the point — the old 1s floor quantized closed-loop "
+    "generators into lockstep waves)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
 
 
 def _merge_stats(dst: Dict, src: Dict) -> None:
@@ -176,6 +194,11 @@ class ServeConfig:
     # TW_SERVE_CONTINUOUS. slo_p99_ms None -> TW_SERVE_SLO_P99_MS.
     continuous: bool = False
     slo_p99_ms: Optional[float] = None
+    # dispatch-ring depth: tickets (admitted batches) allowed in flight
+    # at once under the continuous dispatcher. 1 restores the serial
+    # admit→solve→consume loop byte-exactly (the kill switch, test-
+    # pinned); None -> TW_SERVE_INFLIGHT (default 2).
+    inflight: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.max_tenants is None:
@@ -192,6 +215,8 @@ class ServeConfig:
             self.pump_windows = knobs.get_int("TW_SERVE_PUMP_WINDOWS")
         if self.slo_p99_ms is None:
             self.slo_p99_ms = knobs.get_float("TW_SERVE_SLO_P99_MS")
+        if self.inflight is None:
+            self.inflight = knobs.get_int("TW_SERVE_INFLIGHT")
 
 
 class Tenant:
@@ -578,6 +603,7 @@ class Tenant:
             parse_s=round(float(svc.stats.get("parse_s", 0.0)), 6),
             stitch_s=round(float(svc.stats.get("stitch_s", 0.0)), 6),
             emit_s=round(float(svc.stats.get("emit_s", 0.0)), 6),
+            consume_s=round(float(svc.stats.get("consume_s", 0.0)), 6),
             slo_breaches=int(svc.stats.get("slo_breaches", 0)),
             adapt_refits=int(svc.stats.get("adapt_refits", 0)),
             adapt=(svc.adapt.summary() if svc.adapt is not None else None),
@@ -602,6 +628,39 @@ class Tenant:
         )
 
 
+class _Ticket:
+    """One outstanding dispatch-ring entry: an admitted batch taken off
+    its tenants' queues (``submit_admitted``), through the lock-free
+    device phase (``_ring_dispatch``), to the FIFO locked consume
+    (``complete_ticket``). The ticket carries everything the three
+    phases hand each other, so per-tenant ``in_flight`` accounting can
+    retire EXACTLY this ticket's windows (identity removal, never a
+    wholesale clear — another ticket's windows may be in flight too)."""
+
+    __slots__ = ("seq", "taken", "shared", "isolated", "prepared",
+                 "items", "quarantined", "confidences", "outs",
+                 "local_stats", "solve_s", "via_ring")
+
+    def __init__(self, seq: int) -> None:
+        self.seq = seq
+        #: every (tenant, bufs) taken — shared AND isolated — for
+        #: in_flight retire/requeue
+        self.taken: List[Tuple["Tenant", List]] = []
+        self.shared: List[Tuple["Tenant", List]] = []
+        self.isolated: List[Tuple["Tenant", List]] = []
+        self.prepared: List = []
+        self.items: List = []
+        self.quarantined: List[int] = []
+        self.confidences: Optional[List] = None
+        self.outs: List = []
+        self.local_stats: Dict[str, float] = {}
+        self.solve_s = 0.0
+        #: launched onto the worker pool (completion feeds the
+        #: dispatcher's EWMA through note_solve); False for the serial
+        #: solve_admitted composition
+        self.via_ring = False
+
+
 class TenantService:
     """The multi-tenant reconstruction service (the HTTP layer's model).
 
@@ -609,6 +668,17 @@ class TenantService:
     in concurrently); one re-entrant lock serializes tenancy state and
     solves — the device is a serially-dispatched resource anyway, and the
     fleet call itself pipelines internally.
+
+    **The in-flight dispatch ring** (``TW_SERVE_INFLIGHT``, default 2):
+    under the continuous dispatcher, :meth:`solve_admitted`'s three
+    phases are split into :meth:`submit_admitted` (locked take +
+    prepare, returns a ticket), the lock-free device dispatch on a
+    small worker pool, and :meth:`complete_ticket` (locked FIFO consume
+    + emit) — so the dispatcher admits and packs batch N+1 while batch
+    N executes on the device. Consumes retire strictly in ticket-seq
+    order, which keeps per-tenant emission order identical to the
+    serial loop; ``TW_SERVE_INFLIGHT=1`` bypasses the ring entirely and
+    runs the serial composition byte-exactly (test-pinned kill switch).
     """
 
     def __init__(self, cfg: Optional[ServeConfig] = None) -> None:
@@ -646,9 +716,43 @@ class TenantService:
         # on the dispatcher thread degrades serve to the fixed pump
         # instead of silently wedging every tenant's seal→emit path
         self.dispatcher_degraded = False
+        # -- the in-flight dispatch ring (TW_SERVE_INFLIGHT) --------------
+        # outstanding tickets by seq; _ring_done counts retired tickets
+        # (consume runs when a ticket's seq == _ring_done: FIFO order).
+        # The condition shares the service lock so "outstanding changed"
+        # waits compose with the ordinary locked sections.
+        self._ring_limit = max(1, int(self.cfg.inflight or 1))
+        self._ring_cond = threading.Condition(self._lock)
+        self._ring_seq = 0
+        self._ring_done = 0
+        self._ring_outstanding: Dict[int, _Ticket] = {}
+        self._ring_exc: Optional[BaseException] = None
+        self._ring_queue: Optional[queue.Queue] = None
+        self._ring_workers: List[threading.Thread] = []
+        # overlap accounting (its own tiny mutex — updated inside the
+        # LOCK-FREE device phase, where taking the service lock would
+        # serialize the very overlap being measured): busy = Σ per-ticket
+        # device walls, union = wall time with ≥1 ticket dispatching;
+        # overlap_pct = 100*(1 - union/busy)
+        self._ring_mutex = threading.Lock()
+        self._ring_active = 0
+        self._ring_active_since = 0.0
+        self._ring_busy_s = 0.0
+        self._ring_union_s = 0.0
+        # recent ticket retirements (monotonic time, windows) — the live
+        # drain rate Retry-After derives from
+        self._ring_completions: deque = deque(maxlen=32)
         if self.cfg.continuous:
             from traceweaver_tpu.serve.continuous import ContinuousDispatcher
 
+            if self._ring_limit > 1:
+                self._ring_queue = queue.Queue()
+                for i in range(self._ring_limit):
+                    w = threading.Thread(
+                        target=self._ring_worker,
+                        name=f"tw-serve-ring-{i}", daemon=True)
+                    w.start()
+                    self._ring_workers.append(w)
             self.dispatcher = ContinuousDispatcher(
                 self, slo_ms=self.cfg.slo_p99_ms).start()
             _OBS_DISPATCHER_DEGRADED.set(0.0)
@@ -755,6 +859,10 @@ class TenantService:
             _OBS_DISPATCHER_DEGRADED.set(1.0)
             _events.emit("serve", "dispatcher_degraded",
                          error="%s: %s" % (type(exc).__name__, exc))
+        # retire the ring worker pool (outside the lock — workers need it
+        # to complete queued tickets before honoring the stop sentinel);
+        # subsequent flush/drain route through the pump path
+        self._ring_shutdown()
         try:
             with self._lock:
                 self.pump()
@@ -814,66 +922,281 @@ class TenantService:
 
     def solve_admitted(self, plan: List[Tuple[Tenant, List]]) -> int:
         """Solve an admission-scheduler batch (``[(tenant, [bufs])]`` —
-        serve/continuous.py picked WHICH windows; this takes them off
-        the owning tenants' queues and rides them through the same
-        shared/isolated dispatch split as :meth:`pump`). Windows a
-        concurrent flush already drained are skipped (the take is
-        identity-matched), so admission races resolve to at-most-once
-        solving.
+        serve/continuous.py picked WHICH windows) SERIALLY: submit,
+        dispatch on the calling thread, consume. This is the
+        ``TW_SERVE_INFLIGHT=1`` path, the drain_backlog path, and the
+        byte-exact reference the ring's overlapped composition is
+        pinned against (tests/test_continuous.py): the same three
+        phases, one ticket, zero outstanding while it runs.
 
         Unlike the pump, the shared DISPATCH runs OUTSIDE the service
         lock: ingest proceeds while the device executes — the
-        throughput half of continuous batching. The taken windows are
-        marked in-flight on their tenants (so retention pruning cannot
-        advance past them mid-solve), prepare/consume stay under the
-        lock, and the fleet ledger accumulates into a local dict merged
-        under the lock afterwards (a concurrent stats() scrape must
-        never iterate a dict the solver is growing). Fault-spec'd
-        tenants' isolated solves keep the lock — storms are rare and
-        already pay for isolation. Returns windows solved."""
+        throughput half of continuous batching. Windows a concurrent
+        flush already drained are skipped (the take is identity-
+        matched), so admission races resolve to at-most-once solving.
+        Returns windows solved."""
+        ticket = self.submit_admitted(plan)
+        if ticket is None:
+            return 0
+        self._ring_dispatch(ticket)
+        return self.complete_ticket(ticket)
+
+    # -- the in-flight dispatch ring (ticket lifecycle) -------------------
+    # in_flight discipline (twlint TW012): per-tenant ``in_flight`` lists
+    # are mutated ONLY here — submit extends, complete/abort retire by
+    # ticket identity — and only under the service lock. Everything else
+    # (pruning, migration wait-for-retire, checkpoint gating, drain
+    # barriers) just READS them.
+    def submit_admitted(self,
+                        plan: List[Tuple[Tenant, List]]
+                        ) -> Optional[_Ticket]:
+        """Phase 1, locked: take the admitted windows off their tenants'
+        queues (identity-matched — at-most-once vs a racing flush),
+        split shared/isolated, mark every taken window in-flight on its
+        tenant (retention pruning must not advance past a window whose
+        spans are still being solved — isolated windows included, they
+        sit in neither queue mid-dispatch too), and build the fleet
+        items. Returns the ticket to dispatch, or ``None`` when every
+        window was already drained by a concurrent take."""
         with self._lock:
-            shared: List[Tuple[Tenant, List]] = []
-            isolated: List[Tuple[Tenant, List]] = []
+            ticket = _Ticket(self._ring_seq)
             for t, bufs in plan:
                 if self.tenants.get(t.id) is not t:
-                    # admitted, then migrated out (or evicted) before the
-                    # take: the windows rode the transfer checkpoint to
-                    # the destination replica — solving them here would
-                    # double-emit into a closed tenant
+                    # admitted, then migrated out (or evicted) before
+                    # the take: the windows rode the transfer checkpoint
+                    # to the destination replica — solving them here
+                    # would double-emit into a closed tenant
                     continue
                 taken = t.svc.scheduler.take(bufs)
                 if taken:
-                    (isolated if t.fault_spec else shared).append((t, taken))
-            for t, bufs in shared:
+                    ticket.taken.append((t, taken))
+                    (ticket.isolated if t.fault_spec
+                     else ticket.shared).append((t, taken))
+            if not ticket.taken:
+                return None
+            self._ring_seq += 1
+            for t, bufs in ticket.taken:
                 t.in_flight.extend(bufs)
-            prepared, items = self._prepare_shared(shared)
-        quarantined: List[int] = []
-        confidences: Optional[List] = (
-            [None] * len(items) if _quality.conf_enabled() else None)
-        local_stats: Dict[str, float] = {}
-        t0 = time.perf_counter()
-        outs = self._dispatch_shared(items, quarantined, confidences,
-                                     stats=local_stats)
-        solve_s = time.perf_counter() - t0
+            ticket.prepared, ticket.items = \
+                self._prepare_shared(ticket.shared)
+            if _quality.conf_enabled():
+                ticket.confidences = [None] * len(ticket.items)
+            self._ring_outstanding[ticket.seq] = ticket
+            self._bump("ring_submitted")
+            _OBS_INFLIGHT.set(float(len(self._ring_outstanding)))
+            return ticket
+
+    def launch_ticket(self, ticket: _Ticket) -> None:
+        """Hand a submitted ticket to the ring worker pool (dispatch +
+        FIFO complete happen there); the dispatcher thread returns to
+        admitting immediately. Ring mode only (``ring_enabled``)."""
+        ticket.via_ring = True
+        q = self._ring_queue
+        if q is None:  # ring shut down mid-flight: degrade to serial
+            self._ring_dispatch(ticket)
+            self.complete_ticket(ticket)
+            return
+        q.put(ticket)
+
+    def _ring_dispatch(self, ticket: _Ticket) -> None:
+        """Phase 2, LOCK-FREE: the device dispatch. The fleet ledger
+        accumulates into the ticket's local dict (merged under the lock
+        at complete — a concurrent stats() scrape must never iterate a
+        dict the solver is growing), and the overlap interval union is
+        tracked under its own mutex so concurrent tickets' device walls
+        can be decomposed into overlapped vs serial time."""
+        t_in = time.monotonic()
+        with self._ring_mutex:
+            if self._ring_active == 0:
+                self._ring_active_since = t_in
+            self._ring_active += 1
+        try:
+            t0 = time.perf_counter()
+            ticket.outs = self._dispatch_shared(
+                ticket.items, ticket.quarantined, ticket.confidences,
+                stats=ticket.local_stats)
+            ticket.solve_s = time.perf_counter() - t0
+        finally:
+            t_out = time.monotonic()
+            with self._ring_mutex:
+                self._ring_active -= 1
+                self._ring_busy_s += t_out - t_in
+                if self._ring_active == 0:
+                    self._ring_union_s += t_out - self._ring_active_since
+
+    def complete_ticket(self, ticket: _Ticket) -> int:
+        """Phase 3, locked, FIFO: wait for the ticket's seq turn (ring
+        consumes retire in submission order — per-tenant emission order
+        stays identical to the serial loop, which is what makes
+        overlapped output deterministic per ordering), then merge the
+        fleet ledger, consume/emit the shared results, retire the
+        ticket's windows from their tenants' in-flight sets (identity
+        removal — other tickets' windows stay protected), run the
+        isolated solves, and checkpoint tenants on cadence — SKIPPING
+        any tenant that still has windows in flight on another ticket
+        (``state_dict`` captures queues, not in-flight windows: a
+        checkpoint taken mid-ticket would lose them on resume)."""
+        n = 0
+        with self._ring_cond:
+            while self._ring_done < ticket.seq:
+                self._ring_cond.wait(timeout=0.25)
+            try:
+                _merge_stats(self.fleet_stats, ticket.local_stats)
+                if ticket.shared:
+                    n = self._consume_shared(
+                        ticket.prepared, len(ticket.items),
+                        len(ticket.shared), ticket.outs,
+                        ticket.quarantined, ticket.confidences,
+                        ticket.solve_s)
+                self._ring_retire_locked(ticket)
+                for t, bufs in ticket.isolated:
+                    n += self._solve_isolated(t, bufs)
+                for tid in sorted(self.tenants):
+                    t = self.tenants[tid]
+                    if t.in_flight:
+                        continue
+                    if t.ckpt_path and t.svc._since_checkpoint \
+                            >= self.cfg.checkpoint_every:
+                        t.checkpoint()
+                self._bump("pumped_windows", n)
+                self._bump("continuous_dispatches")
+                self._bump("ring_completed")
+                self._ring_completions.append((time.monotonic(), n))
+                if ticket.via_ring and self.dispatcher is not None:
+                    self.dispatcher.note_solve(ticket.solve_s, n)
+            finally:
+                # idempotent: the happy path retired above; an exception
+                # mid-consume must still advance the ring (FIFO waiters
+                # + migrate_out's wait-for-retire would wedge otherwise)
+                self._ring_retire_locked(ticket)
+        return n
+
+    def _ring_retire_locked(self, ticket: _Ticket) -> None:
+        """Retire one ticket (caller holds the lock; idempotent):
+        identity-remove exactly its windows from each tenant's in-flight
+        set, advance the FIFO counter, wake ring waiters."""
+        # twlint: disable=TW005 — every caller (complete_ticket,
+        # _ring_abort) holds the service lock across this helper
+        if self._ring_outstanding.pop(ticket.seq, None) is None:
+            return
+        for t, bufs in ticket.taken:
+            drop = {id(b) for b in bufs}
+            t.in_flight[:] = [b for b in t.in_flight
+                              if id(b) not in drop]
+        self._ring_done = ticket.seq + 1
+        _OBS_INFLIGHT.set(float(len(self._ring_outstanding)))
+        _OBS_OVERLAP.set(self.overlap_pct())
+        self._ring_cond.notify_all()
+
+    def _ring_worker(self) -> None:
+        """One ring worker: dispatch lock-free, then the FIFO locked
+        complete. A dispatch error re-queues the ticket's windows (they
+        never reached a sink — solving them again is safe); a complete
+        error only retires (results may be partially emitted — a replay
+        could double-emit). Either way the error is recorded and raised
+        on the DISPATCHER thread (its next throttle/idle check), so
+        crash containment degrades serve to the fixed pump exactly like
+        a serial dispatcher crash."""
+        q = self._ring_queue
+        while True:
+            ticket = q.get()
+            if ticket is None:
+                return
+            try:
+                self._ring_dispatch(ticket)
+            except Exception as e:  # noqa: BLE001 — containment
+                self._ring_abort(ticket, e, requeue=True)
+                continue
+            try:
+                self.complete_ticket(ticket)
+            except Exception as e:  # noqa: BLE001 — containment
+                self._ring_abort(ticket, e, requeue=False)
+
+    def _ring_abort(self, ticket: _Ticket, exc: BaseException,
+                    requeue: bool) -> None:
+        with self._ring_cond:
+            while self._ring_done < ticket.seq \
+                    and ticket.seq in self._ring_outstanding:
+                self._ring_cond.wait(timeout=0.25)
+            if requeue and ticket.seq in self._ring_outstanding:
+                for t, bufs in ticket.taken:
+                    if self.tenants.get(t.id) is t:
+                        for b in bufs:
+                            t.svc.scheduler.offer(b)
+            self._ring_retire_locked(ticket)
+            if self._ring_exc is None:
+                self._ring_exc = exc
+            self._bump("ring_aborted")
+        _events.emit("serve", "ring_ticket_aborted", seq=ticket.seq,
+                     requeued=requeue,
+                     error="%s: %s" % (type(exc).__name__, exc))
+
+    @property
+    def ring_enabled(self) -> bool:
+        """True while the overlapped ring is live (TW_SERVE_INFLIGHT > 1
+        and the worker pool running); the dispatcher falls back to the
+        serial solve_admitted path when False."""
+        return self._ring_queue is not None
+
+    def ring_throttle(self) -> None:
+        """Dispatcher-side back edge: block while the ring is full
+        (outstanding == TW_SERVE_INFLIGHT), then surface any worker
+        error ON THE DISPATCHER THREAD so its crash containment
+        (_on_dispatcher_death → fixed-pump degrade) fires for ring-mode
+        failures exactly as for serial ones."""
+        with self._ring_cond:
+            while (self._ring_exc is None
+                   and len(self._ring_outstanding) >= self._ring_limit):
+                self._ring_cond.wait(timeout=0.25)
+        self.ring_raise_pending()
+
+    def ring_raise_pending(self) -> None:
+        """Re-raise (once) the first ring-worker error on the caller's
+        thread — the dispatcher polls this even when idle, so a worker
+        crash with no further admissions still degrades serve."""
         with self._lock:
-            _merge_stats(self.fleet_stats, local_stats)
-            n = 0
-            if shared:
-                n = self._consume_shared(prepared, len(items), len(shared),
-                                         outs, quarantined, confidences,
-                                         solve_s)
-            for t, _ in shared:
-                t.in_flight.clear()
-            for t, bufs in isolated:
-                n += self._solve_isolated(t, bufs)
-            for tid in sorted(self.tenants):
-                t = self.tenants[tid]
-                if t.ckpt_path and \
-                        t.svc._since_checkpoint >= self.cfg.checkpoint_every:
-                    t.checkpoint()
-            self._bump("pumped_windows", n)
-            self._bump("continuous_dispatches")
-            return n
+            exc, self._ring_exc = self._ring_exc, None
+        if exc is not None:
+            raise exc
+
+    def wait_idle(self, timeout_s: Optional[float] = None) -> bool:
+        """Barrier on ALL outstanding ring tickets (the drain/flush/
+        checkpoint contract: a flush that races an in-flight ticket
+        undercounts emitted traces; a checkpoint taken mid-ticket loses
+        the ticket's windows on resume). Returns False on timeout with
+        tickets still outstanding."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        with self._ring_cond:
+            while self._ring_outstanding:
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    return False
+                self._ring_cond.wait(timeout=0.1)
+        return True
+
+    def overlap_pct(self) -> float:
+        """Percent of ring device wall that overlapped another ticket:
+        ``100*(1 - union/busy)`` over closed dispatch intervals. 0.0
+        under the serial dispatcher (union == busy by construction)."""
+        with self._ring_mutex:
+            busy, union = self._ring_busy_s, self._ring_union_s
+        if busy <= 0.0:
+            return 0.0
+        return max(0.0, 100.0 * (1.0 - union / busy))
+
+    def _ring_shutdown(self, timeout_s: float = 10.0) -> None:
+        """Stop the ring worker pool (sentinel per worker; queued
+        tickets ahead of the sentinels complete first — nothing taken is
+        dropped). Callers barrier via wait_idle before checkpointing."""
+        q, self._ring_queue = self._ring_queue, None
+        if q is None:
+            return
+        for _ in self._ring_workers:
+            q.put(None)
+        for w in self._ring_workers:
+            w.join(timeout=timeout_s)
+        self._ring_workers = []
 
     # -- the shared solve, in three phases so solve_admitted can drop
     # -- the lock around the dispatch (pump() composes them locked) -------
@@ -992,13 +1315,27 @@ class TenantService:
                        timeout_s: Optional[float] = None) -> Dict[str, int]:
         """Checkpoint every tenant, time-boxed (``TW_SERVE_DRAIN_S``): a
         drain must not hold SIGTERM forever — tenants past the box are
-        counted, their last good checkpoint stays on disk."""
+        counted, their last good checkpoint stays on disk.
+
+        Barriers on the dispatch ring first: ``state_dict`` captures the
+        scheduler queues, NOT windows a ticket has taken off them, so a
+        checkpoint cut mid-ticket would lose those windows on resume.
+        Any tenant still holding in-flight windows after the (bounded)
+        barrier is skipped — its last good checkpoint stays current."""
         budget = (self.cfg.drain_timeout_s
                   if timeout_s is None else timeout_s)
         t0 = time.monotonic()
+        self.wait_idle(budget)
         done = skipped = timed_out = 0
         with self._lock:
             for tid in sorted(self.tenants):
+                if self.tenants[tid].in_flight:
+                    # outstanding ticket survived the barrier: this
+                    # tenant's last good checkpoint stays current (the
+                    # in-flight check outranks the time box — it is
+                    # cheap, and "skipped" names the cause)
+                    skipped += 1
+                    continue
                 if time.monotonic() - t0 > budget:
                     timed_out += 1
                     self._bump("drain_timeouts")
@@ -1031,11 +1368,18 @@ class TenantService:
         the watermark past several open windows (window/overlap
         geometry), and a flush force-seals every open window, so an
         admission check against the exact bound lets the burst overflow
-        into dropped windows. Derived from the backlog depth and the
-        tenant's observed seal→emit latency, so the ``Retry-After``
-        header tracks real drain time instead of a constant. Kicks the
-        continuous dispatcher so the advertised wait is actually in
-        motion."""
+        into dropped windows.
+
+        The wait is the ring's LIVE drain rate times the tenant's queue
+        position (recent ticket completions → seconds-per-window),
+        falling back to the dispatcher's solve EWMA and only then to the
+        tenant's seal→emit p99. No 1-second floor: the old
+        ``max(1.0, …)`` + integer header quantized every closed-loop
+        generator in a campaign onto the same retry instant, arriving as
+        a lockstep wave that re-saturated the queues it had just been
+        bounced off (CAMPAIGN_r18 attributes part of the serve↔direct
+        gap to exactly this). Kicks the continuous dispatcher so the
+        advertised wait is actually in motion."""
         with self._lock:
             t = self.tenants.get(tenant_id)
             if t is None:
@@ -1050,15 +1394,34 @@ class TenantService:
             if sched.backlog < bound - headroom:
                 return None
             self._bump("backpressure_429s")
-            # per-window drain pace from the tenant's own latency ledger
-            # (1s floor before any window has solved)
-            pace_s = max(0.05, (t.svc.seal_emit_p99_ms() or 1000.0)
-                         / 1000.0)
-            wait = max(1.0, min(sched.backlog * pace_s,
-                                self.cfg.drain_timeout_s))
+            pace_s = self._drain_pace_locked(t)
+            wait = min(max(0.1, sched.backlog * pace_s),
+                       self.cfg.drain_timeout_s)
+        _OBS_RETRY_AFTER.observe(wait)
         if self.dispatcher is not None:
             self.dispatcher.kick()
-        return round(wait, 1)
+        return round(wait, 2)
+
+    def _drain_pace_locked(self, t: "Tenant") -> float:
+        """Seconds-per-window the serve drain is ACTUALLY sustaining
+        (caller holds the lock). Prefers the ring's recent ticket
+        completions (wall span / windows retired — measures the
+        overlapped throughput, not one ticket's latency), then the
+        dispatcher's solve EWMA spread over its batch fill, then the
+        tenant's seal→emit p99 (which includes queue wait — a gross
+        overestimate of marginal pace, but the only signal cold)."""
+        comps = [c for c in self._ring_completions
+                 if c[0] >= time.monotonic() - 30.0]
+        if len(comps) >= 2:
+            span = comps[-1][0] - comps[0][0]
+            windows = sum(n for _, n in comps[1:])
+            if span > 0.0 and windows > 0:
+                return max(0.001, span / windows)
+        if self.dispatcher is not None:
+            fill = max(1, min(self.cfg.pump_windows,
+                              self.cfg.max_pending))
+            return max(0.005, self.dispatcher.solve_ewma_s / fill)
+        return max(0.05, (t.svc.seal_emit_p99_ms() or 1000.0) / 1000.0)
 
     def migrate_out(self, tenant_id: str) -> Dict[str, object]:
         """Source half of live tenant migration: checkpoint the tenant
@@ -1072,12 +1435,14 @@ class TenantService:
         Zero loss by construction: every ingested-but-unsolved window
         rides the checkpoint; every emitted byte rides the sink copy;
         the tombstone stops this replica minting a forked twin. Windows
-        a continuous dispatch has TAKEN but not yet retired sit in
-        neither scheduler queue (solve_admitted drops the lock around
-        the device dispatch), so checkpointing mid-dispatch would lose
+        a dispatch ticket has TAKEN but not yet retired sit in neither
+        scheduler queue (the device dispatch runs outside the lock —
+        and under the ring, windows from SEVERAL outstanding tickets
+        can be out at once), so checkpointing mid-ticket would lose
         them — the wait below holds the migration until the tenant's
-        in-flight set is empty (the dispatch's consume/emit runs under
-        the lock and clears it), bounded by the drain budget."""
+        in-flight set is empty (each ticket's complete/abort retires
+        exactly its own windows under the lock), bounded by the drain
+        budget."""
         deadline = time.monotonic() + self.cfg.drain_timeout_s
         while True:
             with self._lock:
@@ -1197,16 +1562,20 @@ class TenantService:
 
     def drain(self) -> Dict[str, int]:
         """Graceful drain (the SIGTERM path): stop the continuous
-        dispatcher (no new admissions), checkpoint every tenant within
-        the drain budget, then close sinks. Open windows ride the
-        checkpoints — a restart resumes every tenant with zero lost
+        dispatcher (no new admissions), barrier on every outstanding
+        ring ticket and retire the worker pool, checkpoint every tenant
+        within the drain budget, then close sinks. Open windows ride
+        the checkpoints — a restart resumes every tenant with zero lost
         windows (tests/test_stream.py pins byte-identical per-tenant
-        resume)."""
+        resume; tests/test_continuous.py extends the pin to drains cut
+        while tickets were still in flight)."""
         self.begin_drain()
         if self.dispatcher is not None:
             self.dispatcher.stop()
+        self.wait_idle(self.cfg.drain_timeout_s)
+        self._ring_shutdown()
+        out = self.checkpoint_all()
         with self._lock:
-            out = self.checkpoint_all()
             for t in self.tenants.values():
                 t.close()
             return out
@@ -1292,7 +1661,7 @@ class TenantService:
         "late_dropped", "deadletter_windows", "deadletter_spans",
         "low_confidence_traces", "seal_emit_p99_ms", "slo_breaches",
         "adapt_refits", "quarantined_windows", "ring_traces",
-        "ring_evicted", "parse_s", "stitch_s", "emit_s")
+        "ring_evicted", "parse_s", "stitch_s", "emit_s", "consume_s")
 
     def metrics_families(self) -> List:
         """Collector-style families for ``GET /metrics``
@@ -1376,6 +1745,20 @@ class TenantService:
                 dispatcher_degraded=self.dispatcher_degraded,
                 continuous=(self.dispatcher.stats()
                             if self.dispatcher is not None else None),
+                ring=dict(
+                    inflight_limit=self._ring_limit,
+                    enabled=self.ring_enabled,
+                    outstanding=len(self._ring_outstanding),
+                    submitted=int(
+                        self.stats_counters.get("ring_submitted", 0)),
+                    completed=int(
+                        self.stats_counters.get("ring_completed", 0)),
+                    aborted=int(
+                        self.stats_counters.get("ring_aborted", 0)),
+                    overlap_pct=round(self.overlap_pct(), 2),
+                    busy_s=round(self._ring_busy_s, 6),
+                    union_s=round(self._ring_union_s, 6),
+                ),
                 fleet=fleet,
                 tenants={tid: t.stats()
                          for tid, t in sorted(self.tenants.items())},

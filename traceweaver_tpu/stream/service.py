@@ -264,6 +264,12 @@ class StreamingReconstructor:
         # SLO-breach excursion arming (one event per excursion,
         # re-armed when the p99 falls back under the budget)
         self._slo_breached = False
+        # consume re-entrancy tripwire: carried-state/grader/plan-cache
+        # updates in consume_batch_results are sequenced per service —
+        # the serve ring's FIFO complete guarantees one consume at a
+        # time per tenant, and this flag turns a future violation into
+        # a loud error instead of silently interleaved carried stats
+        self._consuming = False
         # seal→emit latencies of recent emitted windows (seconds; the
         # live p99 the continuous-batching SLO is graded against —
         # bounded so a long-lived tenant tracks RECENT latency, not its
@@ -446,7 +452,34 @@ class StreamingReconstructor:
         the manager splits a shared ``solve_fleet`` call's outputs back
         per tenant and hands each tenant its slice here). ``quarantined``
         indexes into THIS batch's item list; carried-state/grader updates
-        skip quarantined items exactly as the single-tenant path does."""
+        skip quarantined items exactly as the single-tenant path does.
+
+        NOT re-entrant per service: the carried-state/plan-cache/grader
+        updates below are order-dependent folds. The serve ring's FIFO
+        complete serializes consumes (tickets retire in submission
+        order, under the service lock); this guard makes any future
+        violation a loud error, and the ``consume_s`` ledger separates
+        host-side decode/fold wall from device ``solve_s``."""
+        if self._consuming:
+            raise RuntimeError(
+                "consume_batch_results re-entered: concurrent consumes "
+                "would interleave carried-state folds (serve ring FIFO "
+                "contract violated)")
+        self._consuming = True
+        t_consume = time.perf_counter()
+        try:
+            return self._consume_batch_results(
+                bufs, per_buf, owners, outs, quarantined, solve_s,
+                confidences)
+        finally:
+            self._consuming = False
+            self._bump("consume_s", time.perf_counter() - t_consume)
+
+    def _consume_batch_results(self, bufs: List[WindowBuffer], per_buf,
+                               owners: List[int], outs,
+                               quarantined: List[int],
+                               solve_s: float,
+                               confidences=None) -> List[WindowResult]:
         from traceweaver_tpu.algorithms import timing
 
         results: List[WindowResult] = []
