@@ -902,6 +902,61 @@ def test_tw012_suppression():
 
 
 # ---------------------------------------------------------------------------
+# TW013 — serve ack discipline
+# ---------------------------------------------------------------------------
+
+def test_tw013_unledgered_ingest_ack_flagged():
+    # a 2xx whose payload comes from the bare in-memory ingest entry
+    # points, with no TW_WAL guard anywhere above it — both ingest
+    # shapes, plus a nested-expression payload
+    findings, _ = lint("""
+        class Handler:
+            def do_POST(self):
+                self._reply(200, self.service.ingest(tid, payload))
+                self._reply(200, self.service.ingest_capture(tid, caps))
+                self._reply(201, dict(self.service.ingest(tid, payload)))
+    """, path="traceweaver_tpu/serve/http.py")
+    assert rules_of(findings).count("TW013") == 3
+
+
+def test_tw013_ledgered_and_guarded_acks_clean():
+    # the real shape: the TW_WAL knob selects the ledgered form, and
+    # the bare form lives on the guard's else branch (the explicit
+    # no-durability opt-out); error replies and non-ingest payloads
+    # are not ack surfaces
+    findings, _ = lint("""
+        class Handler:
+            def do_POST(self):
+                if _knobs.get_bool("TW_WAL"):
+                    self._reply(200, self.service.wal_ingest(
+                        tid, payload, raw=raw, client_seq=seq))
+                else:
+                    self._reply(200, self.service.ingest(tid, payload))
+                self._reply(200, self.service.stats(tid))
+                self._reply(400, {"error": self.service.ingest(tid, p)})
+    """, path="traceweaver_tpu/serve/http.py")
+    assert [f for f in findings if f.rule == "TW013"] == []
+    # other modules' ingest-shaped calls are out of scope (the rule is
+    # about the serve front door's ack, not every ingest() in the repo)
+    findings, _ = lint("""
+        class Handler:
+            def do_POST(self):
+                self._reply(200, self.service.ingest(tid, payload))
+    """, path="traceweaver_tpu/fleet_serve/router.py")
+    assert [f for f in findings if f.rule == "TW013"] == []
+
+
+def test_tw013_suppression():
+    findings, suppressed = lint("""
+        class Handler:
+            def do_POST(self):
+                self._reply(200, self.service.ingest(tid, p))  # twlint: disable=TW013 — why
+    """, path="traceweaver_tpu/serve/http.py")
+    assert [f for f in findings if f.rule == "TW013"] == []
+    assert suppressed == 1
+
+
+# ---------------------------------------------------------------------------
 # CLI plumbing + the tier-1 repo gate
 # ---------------------------------------------------------------------------
 
@@ -912,7 +967,7 @@ def test_module_entry_point_and_cli_subcommand_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("TW001", "TW002", "TW003", "TW004", "TW005", "TW006",
-                "TW012"):
+                "TW012", "TW013"):
         assert rid in out
     assert cli.main(["lint", "--list-rules"]) == 0
 

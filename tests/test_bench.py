@@ -399,6 +399,45 @@ def test_overlap_fields_ring_engagement_and_throughput_verdicts(bench):
     assert empty["overlap_ring_engaged"] is False
 
 
+@pytest.mark.wal
+def test_wal_fields_overhead_and_compile_verdicts(bench):
+    """The --wal leg's report builder: per-sync-policy run summaries ->
+    the wal_* field set, with the headline pair (batch policy's
+    throughput overhead vs WAL-off <= 10%; zero steady compiles on
+    every pass) and per-policy ack-latency passthrough."""
+    passes = dict(
+        off=dict(spans=3000, wall_s=3.0, ack_p50_ms=0.9, ack_p99_ms=5.0,
+                 steady_compiles=0),
+        batch=dict(spans=2910, wall_s=3.0, ack_p50_ms=2.1,
+                   ack_p99_ms=10.0, steady_compiles=0, wal_appends=144),
+        always=dict(spans=2700, wall_s=3.0, ack_p50_ms=4.2,
+                    ack_p99_ms=14.0, steady_compiles=0, wal_appends=144),
+    )
+    out = bench.wal_fields(6, passes)
+    assert out["wal_tenants"] == 6
+    assert out["wal_off_spans_per_s"] == 1000.0
+    assert out["wal_batch_spans_per_s"] == 970.0
+    assert out["wal_batch_overhead_pct"] == 3.0
+    assert out["wal_batch_within_overhead"] is True
+    assert out["wal_batch_appends"] == 144
+    assert "wal_off_appends" not in out  # no log to count when off
+    assert out["wal_always_ack_p99_ms"] == 14.0
+    assert out["wal_zero_steady_compiles"] is True
+    # a batch pass pricier than the 10% budget, or any recompiling
+    # pass, flips its verdict
+    slow = bench.wal_fields(6, dict(
+        passes, batch=dict(passes["batch"], spans=2500),
+        always=dict(passes["always"], steady_compiles=2)))
+    assert slow["wal_batch_overhead_pct"] > 10.0
+    assert slow["wal_batch_within_overhead"] is False
+    assert slow["wal_zero_steady_compiles"] is False
+    # empty/zero inputs degrade to None rates, never divide-by-zero
+    empty = bench.wal_fields(0, dict(off={}, batch={}, always={}))
+    assert empty["wal_off_spans_per_s"] is None
+    assert empty["wal_batch_overhead_pct"] is None
+    assert empty["wal_batch_within_overhead"] is None
+
+
 @pytest.mark.collector
 def test_capture_fields_hardening_verdicts(bench):
     """The --capture leg's report builder: clean/skew/lossy run

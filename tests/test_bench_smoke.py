@@ -869,3 +869,87 @@ def test_serve_overlap_smoke_ring_overlaps_and_stays_compile_free(tmp_path):
         assert st["aborted"] == 0 and st["outstanding"] == 0
     finally:
         svc.drain()
+
+
+@pytest.mark.wal
+def test_fleet_smoke_kill9_recovers_without_acked_loss(tmp_path):
+    """Tier-1 durability smoke: a REAL replica process is SIGKILLed
+    after acking a window, the crash supervisor brings the tenant back
+    (respawn-with-resume), and the acked window is still there — the
+    WAL replayed it. A retry of an already-acked client seq dedups
+    instead of double-ingesting (docs/ROBUSTNESS.md "Durability")."""
+    import json
+    import time
+    import urllib.error
+    import urllib.request
+
+    from test_serve import hotel_payload
+
+    from traceweaver_tpu.fleet_serve.manager import (
+        FleetManager,
+        ReplicaProcess,
+    )
+
+    rep = ReplicaProcess(
+        "r0", str(tmp_path / "r0"), serve_args=["--fix", "2"]).start()
+    fleet = FleetManager([rep], router_port=0, supervise=True)
+
+    def post(payload, seq, deadline_s=120.0):
+        """POST through the router, riding out 503+Retry-After while
+        the supervisor recovers the crashed replica."""
+        data = json.dumps(payload).encode()
+        deadline = time.time() + deadline_s
+        while True:
+            req = urllib.request.Request(
+                fleet.base_url + "/api/v1/tenants/kt/spans",
+                data=data, method="POST")
+            req.add_header("Content-Type", "application/json")
+            req.add_header("X-TW-Seq", str(seq))
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                retry_in = float(e.headers.get("Retry-After", 0.3) or 0.3)
+                e.read()
+                if e.code not in (429, 503) or time.time() > deadline:
+                    raise
+            except (ConnectionError, OSError):
+                retry_in = 0.3
+                if time.time() > deadline:
+                    raise
+            time.sleep(retry_in)
+
+    def get(path):
+        with urllib.request.urlopen(fleet.base_url + path,
+                                    timeout=120) as resp:
+            return json.loads(resp.read())
+
+    try:
+        acked = post(hotel_payload(prefix="a"), seq=1)
+        assert acked["ingested_traces"] == 24 and acked["seq"] == 1
+
+        rep.proc.kill()  # SIGKILL: no atexit, no flush, no checkpoint
+        deadline = time.time() + 120
+        while (fleet.router.counters["respawns"]
+               + fleet.router.counters["failovers"]) < 1:
+            assert time.time() < deadline, "supervisor never recovered"
+            time.sleep(0.2)
+
+        # a retry of the acked-then-crashed seq dedups with the ORIGINAL
+        # accounting — the dedup window rode the WAL through the crash
+        retry = post(hotel_payload(prefix="a"), seq=1)
+        assert retry.get("deduped") is True
+        assert retry["ingested_traces"] == 24
+        # fresh work lands normally on the respawned replica
+        assert post(hotel_payload(prefix="b", base_us=200e6),
+                    seq=2)["ingested_traces"] == 24
+
+        req = urllib.request.Request(fleet.base_url + "/api/v1/flush",
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            resp.read()
+        # both acked windows emitted: the pre-kill ack survived SIGKILL
+        traces = get("/api/v1/tenants/kt/traces")
+        assert traces["n_traces"] == 48, traces
+    finally:
+        fleet.stop()

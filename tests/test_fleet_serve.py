@@ -54,11 +54,13 @@ def _cfg(**kw):
     return ServeConfig(**base)
 
 
-def _http(method, url, payload=None, timeout=120):
+def _http(method, url, payload=None, timeout=120, headers=None):
     data = json.dumps(payload).encode() if payload is not None else None
     req = urllib.request.Request(url, data=data, method=method)
     if data:
         req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status, dict(resp.headers), json.loads(resp.read())
@@ -153,6 +155,91 @@ def test_router_retries_dead_replica_and_pins_fallback(tmp_path):
     finally:
         router.stop()
         live.stop()
+
+
+def test_router_classifies_reset_midbody_and_reroutes(tmp_path):
+    """A replica that ACCEPTS the connection and then resets it (RST
+    after the request starts flowing — a crashing process, not a dead
+    port) is a distinct failure class: the router must count it as
+    ``reset_midbody``, re-resolve the ring, and land the POST on a
+    survivor — not surface the reset to the client."""
+    import struct
+    import threading
+
+    from traceweaver_tpu.fleet_serve.manager import InProcReplica
+    from traceweaver_tpu.fleet_serve.router import FleetRouter, HashRing
+
+    live = InProcReplica("live", _cfg(state_dir=str(tmp_path / "live")))
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(5)
+    rst_port = srv.getsockname()[1]
+
+    def rst_loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            # SO_LINGER(1, 0): close() sends RST, the client sees
+            # ConnectionResetError mid-request/response, not FIN
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                            struct.pack("ii", 1, 0))
+            try:
+                conn.recv(64)
+            except OSError:
+                pass
+            conn.close()
+
+    threading.Thread(target=rst_loop, daemon=True).start()
+    router = FleetRouter(
+        {"rst": f"http://127.0.0.1:{rst_port}",
+         "live": live.base_url}, port=0).start()
+    try:
+        ring = HashRing(["rst", "live"])
+        tenant = next(f"t{i}" for i in range(200)
+                      if ring.lookup(f"t{i}") == "rst")
+        code, _, out = _http(
+            "POST", f"{router.base_url}/api/v1/tenants/{tenant}/spans",
+            hotel_payload(n_traces=6, prefix="rm"))
+        assert code == 200 and out["ingested_traces"] == 6, out
+        assert router.counters["reset_midbody"] >= 1
+        assert router.counters["retried"] >= 1
+        assert router.pins[tenant] == "live"
+    finally:
+        router.stop()
+        live.stop()
+        srv.close()
+
+
+def test_router_forwards_client_seq_and_retry_dedups(tmp_path):
+    """The lost-ack retry path end to end THROUGH the router: X-TW-Seq
+    rides the proxy to the owning replica, the first POST is ledgered
+    and acked, and a client retry of the same seq (its ack 'lost') is
+    answered with the ORIGINAL accounting — no second WAL append, no
+    double ingest."""
+    from traceweaver_tpu.fleet_serve.manager import InProcReplica
+    from traceweaver_tpu.fleet_serve.router import FleetRouter
+
+    rep = InProcReplica("solo", _cfg(state_dir=str(tmp_path / "solo")))
+    router = FleetRouter({"solo": rep.base_url}, port=0).start()
+    try:
+        url = f"{router.base_url}/api/v1/tenants/rt/spans"
+        pay = hotel_payload(n_traces=6, prefix="sq")
+        code, _, out = _http("POST", url, pay,
+                             headers={"X-TW-Seq": "11"})
+        assert code == 200 and out["seq"] == 11
+        assert out["ingested_traces"] == 6
+        code, _, out = _http("POST", url, pay,
+                             headers={"X-TW-Seq": "11"})
+        assert code == 200 and out.get("deduped") is True
+        assert out["ingested_traces"] == 6  # original accounting echoed
+        t = rep.service.tenant("rt")
+        assert t.wal.stats()["appended"] == 1
+        assert t.counters["wal_deduped"] == 1
+    finally:
+        router.stop()
+        rep.stop()
 
 
 # ---------------------------------------------------------------------------

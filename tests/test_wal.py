@@ -1,0 +1,406 @@
+"""Durable ingest WAL tests (tier-1, CPU).
+
+Contracts covered (ISSUE 20, docs/ROBUSTNESS.md "Durability"):
+
+- frame layer: CRC32-framed records round-trip through
+  ``pack_frame``/``scan_frames``; a torn tail is truncated to the last
+  CRC-valid frame boundary at open — exercised at EVERY byte cut point
+  of the final frame, plus a mid-frame corruption flip;
+- segment layer: rotation by size, checkpoint low-water truncation
+  (whole segments only, the open tail never), transfer round-trip
+  through ``read_all_bytes``/``install_bytes`` with a torn transfer
+  tail;
+- service layer: ack-after-ledger replay identity — a tenant killed
+  hard after ack, before checkpoint, resumes from the WAL and emits
+  byte-for-byte what an uncrashed run emits; a tenant killed before its
+  FIRST checkpoint recovers purely from the WAL;
+- idempotent re-ingest: per-tenant client ``seq`` echo, dedup on retry
+  of a lost ack (original accounting returned, no re-append), the dedup
+  window surviving crash + replay;
+- ``TW_WAL=0`` inertness: no ``wal/`` directory, no WAL stats;
+- the ``wal`` fault-injection site: a faulted append writes HALF a
+  frame (a real torn append), the client gets no ack, and both the
+  live rewind and the next open truncate it;
+- X-TW-Seq over the wire: echo, dedup, and the 400 on a non-integer;
+- the TW_WAL* / TW_FLEET_RESPAWN_MAX knobs: registered, typed, ranged.
+
+The corpus is the handcrafted Jaeger JSON from test_serve.py — fully
+deterministic, so byte-identity assertions are exact.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+import traceweaver_tpu.runtime  # noqa: F401  — breaks the serve import cycle
+from traceweaver_tpu.serve import ServeConfig, TenantService
+from traceweaver_tpu.stream import wal as walmod
+
+jax.config.update("jax_platforms", "cpu")
+
+pytestmark = pytest.mark.wal
+
+from tests.test_serve import hotel_payload  # noqa: E402
+
+
+def _cfg(**kw):
+    base = dict(fix=2, window_us=60e6, overlap_us=5e6, ooo_bound_us=1e6,
+                verbose=False, pump_windows=10**9)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _raw(payload) -> bytes:
+    return json.dumps(payload).encode("utf-8")
+
+
+@pytest.fixture(autouse=True)
+def _wal_on(monkeypatch):
+    """These tests pin the knob explicitly — the suite must hold under
+    any ambient TW_WAL/TW_WAL_SYNC setting."""
+    monkeypatch.setenv("TW_WAL", "1")
+    monkeypatch.setenv("TW_WAL_SYNC", "batch")
+    monkeypatch.delenv("TW_FAULTS", raising=False)
+    yield
+
+
+# ---------------------------------------------------------------------------
+# frame layer
+# ---------------------------------------------------------------------------
+
+def test_frame_roundtrip_and_scan():
+    payloads = [b"alpha", b"", b"x" * 300]
+    raw = b"".join(walmod.pack_frame(i + 1, p)
+                   for i, p in enumerate(payloads))
+    frames, valid_end = walmod.scan_frames(raw)
+    assert valid_end == len(raw)
+    assert [(seq, p) for _off, seq, p in frames] == [
+        (1, b"alpha"), (2, b""), (3, b"x" * 300)]
+
+
+def test_torn_tail_truncated_at_every_byte_boundary(tmp_path):
+    """Cut a 3-frame log at EVERY byte offset inside the final frame:
+    each cut must scan back to exactly 2 frames, and reopening the
+    directory must truncate the file to the 2-frame boundary, count one
+    torn tail, and append seq 3 cleanly on top."""
+    payloads = [b"one", b"two", b"payload-three"]
+    full = b"".join(walmod.pack_frame(i + 1, p)
+                    for i, p in enumerate(payloads))
+    keep = len(b"".join(walmod.pack_frame(i + 1, p)
+                        for i, p in enumerate(payloads[:2])))
+    for cut in range(keep + 1, len(full)):
+        frames, valid_end = walmod.scan_frames(full[:cut])
+        assert valid_end == keep, cut
+        assert [s for _o, s, _p in frames] == [1, 2], cut
+
+        d = tmp_path / f"cut{cut}"
+        d.mkdir()
+        seg = d / walmod.segment_name(1)
+        seg.write_bytes(full[:cut])
+        w = walmod.WriteAheadLog(str(d))
+        assert w.torn_tails == 1 and w.torn_bytes == cut - keep, cut
+        assert w.last_seq == 2, cut
+        assert seg.stat().st_size == keep, cut
+        assert w.append(payloads[2]) == 3
+        w.close()
+        assert seg.read_bytes() == full
+    # a clean cut at the frame boundary is NOT torn
+    d = tmp_path / "clean"
+    d.mkdir()
+    (d / walmod.segment_name(1)).write_bytes(full[:keep])
+    w = walmod.WriteAheadLog(str(d))
+    assert w.torn_tails == 0 and w.last_seq == 2
+    w.close()
+
+
+def test_mid_frame_corruption_ends_the_valid_prefix(tmp_path):
+    """A flipped byte inside the LAST frame's payload fails its CRC and
+    truncates it; earlier frames are untouched (append-only + whole-
+    segment truncation mean only the tail can rot)."""
+    full = b"".join(walmod.pack_frame(i + 1, b"p%d" % i) for i in range(3))
+    keep = len(full) - len(walmod.pack_frame(3, b"p2"))
+    rotten = bytearray(full)
+    rotten[-1] ^= 0xFF
+    frames, valid_end = walmod.scan_frames(bytes(rotten))
+    assert valid_end == keep
+    assert [s for _o, s, _p in frames] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# segment layer
+# ---------------------------------------------------------------------------
+
+def test_segment_rotation_truncation_and_replay(tmp_path):
+    d = str(tmp_path / "wal")
+    w = walmod.WriteAheadLog(d, segment_bytes=64)  # ~2 frames per segment
+    for i in range(10):
+        assert w.append(b"payload-%02d" % i) == i + 1
+    segs = walmod.list_segments(d)
+    assert len(segs) >= 3
+    # replay crosses segments in order, honoring the low-water mark
+    assert [p for _s, p in w.replay(0)] == [b"payload-%02d" % i
+                                            for i in range(10)]
+    assert [s for s, _p in w.replay(7)] == [8, 9, 10]
+    # checkpoint low-water truncation drops whole covered segments only;
+    # the open tail always survives
+    removed = w.truncate_below(w.last_seq)
+    assert removed == len(segs) - 1
+    assert walmod.list_segments(d) == [segs[-1]]
+    assert [s for s, _p in w.replay(0)]  # tail records still replayable
+    w.close()
+
+
+def test_transfer_roundtrip_with_torn_tail(tmp_path):
+    """The failover transfer halves: concatenated segment bytes from a
+    crashed disk install as one fresh segment; a torn transfer tail is
+    truncated on install, same contract as open."""
+    src = str(tmp_path / "src")
+    w = walmod.WriteAheadLog(src, segment_bytes=64)
+    for i in range(6):
+        w.append(b"rec-%d" % i)
+    w.close()
+    raw = walmod.read_all_bytes(src)
+    dst = str(tmp_path / "dst")
+    torn = walmod.pack_frame(7, b"torn-in-transfer")
+    assert walmod.install_bytes(dst, raw + torn[: len(torn) // 2]) == 6
+    r = walmod.WriteAheadLog(dst)
+    assert [p for _s, p in r.replay(0)] == [b"rec-%d" % i for i in range(6)]
+    r.close()
+    assert walmod.install_bytes(str(tmp_path / "empty"), b"junk") == 0
+
+
+# ---------------------------------------------------------------------------
+# service layer: ack-after-ledger replay identity
+# ---------------------------------------------------------------------------
+
+def _wal_post(svc, tid, payload, seq):
+    raw = _raw(payload)
+    return svc.wal_ingest(tid, raw, raw=raw, client_seq=seq)
+
+
+def _emitted(state_dir, tid):
+    with open(os.path.join(state_dir, tid, "traces.jsonl"), "rb") as f:
+        return f.read()
+
+
+def test_replay_after_hard_death_emits_identical_bytes(tmp_path):
+    """The tentpole contract: a tenant killed hard AFTER its acks but
+    BEFORE the covering checkpoint resumes from the WAL tail and emits
+    byte-for-byte what an uncrashed run emits. The first chunk is
+    checkpointed (low-water covers it); the second exists only in the
+    WAL at death."""
+    chunk1 = hotel_payload(prefix="a")
+    chunk2 = hotel_payload(prefix="b", base_us=200e6)
+
+    clean_dir = str(tmp_path / "clean")
+    svc = TenantService(_cfg(state_dir=clean_dir))
+    assert _wal_post(svc, "ten", chunk1, 1)["ingested_traces"] == 24
+    assert _wal_post(svc, "ten", chunk2, 2)["ingested_traces"] == 24
+    svc.flush()
+    svc.drain()
+    want = _emitted(clean_dir, "ten")
+    assert want
+
+    crash_dir = str(tmp_path / "crash")
+    svc = TenantService(_cfg(state_dir=crash_dir))
+    _wal_post(svc, "ten", chunk1, 1)
+    assert svc.tenant("ten").checkpoint() is True
+    summary = _wal_post(svc, "ten", chunk2, 2)
+    assert summary["ingested_traces"] == 24 and summary["seq"] == 2
+    # kill -9: no drain, no close, no checkpoint — just abandon the
+    # object; the batch policy already flushed every append to the OS
+    del svc
+
+    resumed = TenantService.resume(_cfg(state_dir=crash_dir))
+    t = resumed.tenant("ten")
+    assert t.counters.get("wal_replayed") == 1  # chunk2 only: low-water
+    resumed.flush()
+    resumed.drain()
+    assert _emitted(crash_dir, "ten") == want
+
+
+def test_recover_before_first_checkpoint_replays_everything(tmp_path):
+    """A tenant that dies before its FIRST checkpoint exists only as a
+    WAL directory — resume must still find it (no ckpt.pkl to scan for)
+    and replay from seq 0."""
+    state = str(tmp_path / "s")
+    svc = TenantService(_cfg(state_dir=state))
+    _wal_post(svc, "ten", hotel_payload(prefix="a"), 1)
+    del svc  # kill -9 before any checkpoint
+
+    resumed = TenantService.resume(_cfg(state_dir=state))
+    assert "ten" in resumed.stats()["tenants"]
+    t = resumed.tenant("ten")
+    assert t.counters.get("wal_replayed") == 1
+    resumed.flush()
+    resumed.drain()
+    assert _emitted(state, "ten")
+
+
+def test_client_seq_dedup_on_retry_and_across_crash(tmp_path):
+    """A client retry of a LOST ack (same X-TW-Seq) is answered with the
+    original application's accounting — no re-append, no re-ingest —
+    both live and after a crash+replay (the dedup window rides the WAL
+    envelope and the checkpoint)."""
+    state = str(tmp_path / "s")
+    svc = TenantService(_cfg(state_dir=state))
+    payload = hotel_payload(prefix="a")
+    first = _wal_post(svc, "ten", payload, 41)
+    assert first["ingested_traces"] == 24 and first["seq"] == 41
+    retry = _wal_post(svc, "ten", payload, 41)
+    assert retry["deduped"] is True and retry["seq"] == 41
+    assert retry["ingested_traces"] == 24  # the ORIGINAL accounting
+    t = svc.tenant("ten")
+    assert t.wal.stats()["appended"] == 1  # the retry never hit the log
+    assert t.counters["wal_deduped"] == 1
+    del svc  # kill -9
+
+    resumed = TenantService.resume(_cfg(state_dir=state))
+    retry = _wal_post(resumed, "ten", payload, 41)
+    assert retry["deduped"] is True and retry["ingested_traces"] == 24
+    resumed.flush()
+    resumed.drain()
+    # exactly one emitted window despite 3 posts of the same seq
+    assert _emitted(state, "ten").count(b"\n") == 1
+
+
+def test_tw_wal_0_is_inert(tmp_path, monkeypatch):
+    """The kill switch: with TW_WAL=0 the plain ingest path runs, no
+    wal/ directory is ever created, and the stats surface reports no
+    WAL."""
+    monkeypatch.setenv("TW_WAL", "0")
+    state = str(tmp_path / "s")
+    svc = TenantService(_cfg(state_dir=state))
+    assert svc.ingest("ten", _raw(hotel_payload()))["ingested_traces"] == 24
+    svc.flush()
+    assert not os.path.isdir(os.path.join(state, "ten", "wal"))
+    assert svc.stats()["tenants"]["ten"]["wal"] is None
+    svc.drain()
+
+
+# ---------------------------------------------------------------------------
+# the `wal` fault-injection site: torn appends on demand
+# ---------------------------------------------------------------------------
+
+def test_faulted_append_tears_the_frame_and_never_acks(tmp_path,
+                                                       monkeypatch):
+    from traceweaver_tpu.runtime import faults
+
+    d = str(tmp_path / "wal")
+    w = walmod.WriteAheadLog(d)
+    w.append(b"good-1")
+    monkeypatch.setenv("TW_FAULTS", "wal:1.0:max=1")
+    with pytest.raises(Exception):
+        w.append(b"never-acked")
+    # half a frame is on disk past the valid boundary — exactly what a
+    # death mid-write leaves; the live log rewinds it on the next append
+    monkeypatch.delenv("TW_FAULTS")
+    assert w.append(b"good-2") == 2  # seq 2: the torn record never counted
+    w.close()
+    assert [p for _s, p in walmod.WriteAheadLog(d).replay(0)] == [
+        b"good-1", b"good-2"]
+    assert faults.SITES.count("wal") == 1  # registered exactly once
+
+
+def test_faulted_append_torn_on_disk_when_process_dies(tmp_path,
+                                                       monkeypatch):
+    """Same injection, but the process 'dies' holding the torn tail
+    (no rewind): the next OPEN truncates and counts it."""
+    d = str(tmp_path / "wal")
+    w = walmod.WriteAheadLog(d)
+    w.append(b"good-1")
+    monkeypatch.setenv("TW_FAULTS", "wal:1.0:max=1")
+    with pytest.raises(Exception):
+        w.append(b"never-acked")
+    del w  # kill -9 with the half frame on disk
+    monkeypatch.delenv("TW_FAULTS")
+    r = walmod.WriteAheadLog(d)
+    assert r.torn_tails == 1 and r.last_seq == 1
+    assert [p for _s, p in r.replay(0)] == [b"good-1"]
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# over the wire: X-TW-Seq echo + dedup through serve/http.py
+# ---------------------------------------------------------------------------
+
+def _http(method, url, payload=None, headers=None, timeout=120):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_seq_echo_and_dedup(tmp_path):
+    from traceweaver_tpu.serve import make_server
+
+    svc = TenantService(_cfg(state_dir=str(tmp_path / "s")))
+    server = make_server(svc, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        url = base + "/api/v1/tenants/ten/spans"
+        code, out = _http("POST", url, hotel_payload(),
+                          headers={"X-TW-Seq": "7"})
+        assert code == 200 and out["seq"] == 7
+        assert out["ingested_traces"] == 24
+        code, out = _http("POST", url, hotel_payload(),
+                          headers={"X-TW-Seq": "7"})
+        assert code == 200 and out.get("deduped") is True
+        assert out["ingested_traces"] == 24  # original accounting echoed
+        # the ack really was ledgered before the 200 went out
+        code, st = _http("GET", base + "/api/v1/stats")
+        assert st["tenants"]["ten"]["wal"]["appended"] == 1
+        assert st["tenants"]["ten"]["counters"]["wal_deduped"] == 1
+        # a seq-less POST is plain (non-idempotent) ingest, still WAL'd
+        code, out = _http("POST", url, hotel_payload(prefix="b",
+                                                     base_us=200e6))
+        assert code == 200 and "seq" not in out
+        # a non-integer header is the client's bug: 400, nothing applied
+        code, out = _http("POST", url, hotel_payload(),
+                          headers={"X-TW-Seq": "not-a-number"})
+        assert code == 400 and "X-TW-Seq" in out["error"]
+    finally:
+        server.shutdown()
+        svc.drain()
+
+
+# ---------------------------------------------------------------------------
+# knobs: typed + ranged
+# ---------------------------------------------------------------------------
+
+def test_wal_knobs_registered_typed_and_ranged(monkeypatch):
+    from traceweaver_tpu.runtime import knobs
+
+    assert knobs.REGISTRY["TW_WAL"].type == "bool"
+    assert knobs.REGISTRY["TW_WAL"].default is True
+    assert knobs.REGISTRY["TW_WAL_SYNC"].type == "enum"
+    assert knobs.get("TW_WAL_SYNC") == "batch"
+    assert set(walmod.SYNC_POLICIES) == {"always", "batch", "off"}
+    monkeypatch.setenv("TW_WAL_SYNC", "sometimes")
+    with pytest.raises(knobs.KnobError):
+        knobs.get("TW_WAL_SYNC")
+    monkeypatch.setenv("TW_WAL_SEGMENT_MB", "0")
+    assert knobs.get_int("TW_WAL_SEGMENT_MB") == 1  # clamped to lo
+    monkeypatch.setenv("TW_WAL_SEGMENT_MB", "99999")
+    assert knobs.get_int("TW_WAL_SEGMENT_MB") == 1024  # clamped to hi
+    monkeypatch.setenv("TW_FLEET_RESPAWN_MAX", "-3")
+    assert knobs.get_int("TW_FLEET_RESPAWN_MAX") == 0
+    assert knobs.REGISTRY["TW_FLEET_RESPAWN_MAX"].hi == 64
+    # every WAL knob is known at startup (no unknown-knob warning)
+    for name in ("TW_WAL", "TW_WAL_SYNC", "TW_WAL_SEGMENT_MB",
+                 "TW_FLEET_RESPAWN_MAX"):
+        assert name not in knobs.unknown_knobs()

@@ -31,6 +31,8 @@ Rule ids are stable (baseline entries and suppressions reference them):
   controller's evented ledger; no silent rung transitions
 - TW012 ticket discipline    — per-tenant ``in_flight`` windows mutate
   only inside the ticket lifecycle (submit extends, retire removes)
+- TW013 ack discipline       — a 2xx ack on the serve ingest paths is
+  ledgered (``wal_ingest*``) or explicitly ``TW_WAL``-guarded
 """
 
 from __future__ import annotations
@@ -1304,9 +1306,107 @@ class TicketDiscipline:
         return findings
 
 
+# ---------------------------------------------------------------------------
+# TW013 — serve ack discipline
+# ---------------------------------------------------------------------------
+
+class AckDiscipline:
+    """A 2xx ack on the serve ingest paths implies durability.
+
+    The ingest WAL (ISSUE 20, ``stream/wal.py``) moves the front door's
+    contract from "accepted into memory" to "accepted into the ledger":
+    a client that got a 200 for a ``/spans`` or ``/capture`` POST may
+    retire its send buffer, so the bytes behind that 200 must survive
+    ``kill -9`` — which means the handler must have routed them through
+    the WAL-appending service entry points (``wal_ingest`` /
+    ``wal_ingest_capture``, which append + fsync-per-policy BEFORE
+    applying) rather than the bare in-memory forms. The one legitimate
+    bare-ingest ack is the explicit opt-out: a reply dominated by a
+    ``TW_WAL`` guard (the knob's off-branch), where the operator chose
+    no-durability on purpose and the byte-identity contract
+    (``TW_WAL=0`` == pre-WAL wire behavior) requires the un-ledgered
+    path to stay reachable.
+
+    Mechanics: inside the serve HTTP front door, flags any
+    ``self._reply(2xx, <payload>)`` whose payload expression contains a
+    call to a bare ingest entry point (attribute name ``ingest`` /
+    ``ingest_capture``), unless the reply sits under an ``if`` whose
+    test mentions the ``TW_WAL`` constant (either branch — the guard IS
+    the documentation) — the ledgered ``wal_ingest*`` forms are always
+    clean. Narrow by design: only the ingest attribute names are
+    acked-durability surfaces; stats/flush/tenant-admin replies return
+    derived state a retry can rebuild and are untouched.
+    """
+
+    id = "TW013"
+    title = "unledgered 2xx ack on a serve ingest path"
+
+    WATCH_FILES = ("serve/http.py",)
+    #: bare in-memory ingest entry points — acking these without a
+    #: TW_WAL guard promises durability the process cannot deliver
+    INGEST = {"ingest", "ingest_capture"}
+    #: the ledgered forms (append + policy fsync before apply)
+    LEDGERED = {"wal_ingest", "wal_ingest_capture"}
+
+    @staticmethod
+    def _mentions_wal(node: ast.AST) -> bool:
+        return any(isinstance(n, ast.Constant) and n.value == "TW_WAL"
+                   for n in ast.walk(node))
+
+    @staticmethod
+    def _ack_payload(node: ast.AST) -> Optional[ast.AST]:
+        """The payload expression of a ``*._reply(2xx, payload)`` call,
+        else None."""
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_reply"
+                and len(node.args) >= 2):
+            return None
+        code = node.args[0]
+        if not (isinstance(code, ast.Constant)
+                and isinstance(code.value, int)
+                and 200 <= code.value < 300):
+            return None
+        return node.args[1]
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not _path_in(mod, self.WATCH_FILES):
+            return []
+        findings: List[Finding] = []
+
+        def visit(node: ast.AST, guarded: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_guarded = guarded
+                if isinstance(child, ast.If) and self._mentions_wal(
+                        child.test):
+                    child_guarded = True
+                payload = self._ack_payload(child)
+                if payload is not None and not child_guarded:
+                    for n in ast.walk(payload):
+                        if (isinstance(n, ast.Call)
+                                and isinstance(n.func, ast.Attribute)
+                                and n.func.attr in self.INGEST):
+                            findings.append(mod.finding(
+                                self.id, child,
+                                f"2xx ack over bare {n.func.attr}() — a "
+                                "200 on an ingest path promises the "
+                                "client its bytes survive kill -9; route "
+                                "through the ledgered wal_ingest* entry "
+                                "points, or put the reply under an "
+                                "explicit TW_WAL guard (the no-"
+                                "durability opt-out must be a visible "
+                                "operator choice, docs/ROBUSTNESS.md "
+                                "Durability)"))
+                            break
+                visit(child, child_guarded)
+
+        visit(mod.tree, False)
+        return findings
+
+
 #: registration order == reporting order for same-line findings
 RULE_CLASSES = [KnobDiscipline, ImportTimeFreeze, HostSyncHazard,
                 RecompileDiscipline, LockDiscipline, PrecisionDiscipline,
                 MetricDiscipline, ChannelLayoutDiscipline,
                 DevcolsResidency, AdaptLedgerDiscipline,
-                AotCompileDiscipline, TicketDiscipline]
+                AotCompileDiscipline, TicketDiscipline, AckDiscipline]

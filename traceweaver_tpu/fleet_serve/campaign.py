@@ -29,11 +29,16 @@ Drive shape:
   so a degenerate all-tenants-on-one hash split cannot measure a
   1-replica fleet twice), then a gated
   **chaos** phase where the generators resume and the hottest tenant
-  is LIVE-MIGRATED mid-post — plus, subprocess mode, a rolling restart
-  of every replica. The chaos wall (dominated by two full process
-  cold-starts) stays out of the throughput figure, but its spans ride
-  the same rung-wide conservation gate: the failover machinery must be
-  lossless under live load.
+  is LIVE-MIGRATED mid-post — plus, subprocess mode, a ``kill -9`` of
+  the replica serving the hot tenant (the crash supervisor must
+  recover it: respawn + ingest-WAL replay, or survivor failover from
+  the dead disk) and a rolling restart of every replica. The chaos
+  wall (dominated by full process cold-starts) stays out of the
+  throughput figure, but its spans ride the same rung-wide
+  conservation gate: every acked span must emit exactly once, with
+  dedup echoes (a router-retried POST whose ack died with the victim)
+  counted from the replica's ledger — the failover machinery must be
+  lossless under live load AND under SIGKILL.
 
 Rung accounting (per ``fleet-<n>`` rung): sustained spans/s over the
 steady phase wall, per-tenant seal→emit p99, migration/restart/
@@ -144,14 +149,21 @@ class _TenantDrive(threading.Thread):
         self.posts = 0
         self.traces = 0
         self.retry_after_429s = 0
+        self.retry_after_503s = 0
+        self.deduped = 0
         self.errors: List[str] = []
 
     def _post(self, payload: Dict) -> Tuple[int, Dict, Dict]:
         data = json.dumps(payload).encode("utf-8")
+        # the window seq doubles as the idempotency key: a retry of a
+        # POST whose ack died with a killed replica carries the same
+        # seq, and the replica's WAL dedup window answers it from the
+        # ledger instead of double-ingesting
         req = urlrequest.Request(
             f"{self.base_url}/api/v1/tenants/{self.tenant}/spans",
             data=data, method="POST",
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     "X-TW-Seq": str(self.seq)})
         try:
             with urlrequest.urlopen(req, timeout=120) as resp:
                 return resp.status, dict(resp.headers), \
@@ -169,7 +181,7 @@ class _TenantDrive(threading.Thread):
             payload = fleet_payload(self.tenant, self.seq, self.n_traces)
             while not self.stop_evt.is_set():
                 try:
-                    status, headers, _ = self._post(payload)
+                    status, headers, body = self._post(payload)
                 except (urlerror.URLError, OSError) as e:
                     # the router retries/fails internally; a transport
                     # error here means the ROUTER is gone — record, stop
@@ -177,13 +189,29 @@ class _TenantDrive(threading.Thread):
                     return
                 if status == 200:
                     self.posts += 1
-                    self.traces += self.n_traces
+                    # count what the replica says it INGESTED, not what
+                    # we offered: a dedup echo (the router retried a
+                    # POST whose ack died with a crashed replica)
+                    # reports the ORIGINAL apply exactly once, keeping
+                    # Σ acked == Σ ingested exact under crash-retry
+                    self.traces += int(body.get("ingested_traces",
+                                                self.n_traces))
+                    if body.get("deduped"):
+                        self.deduped += 1
                     break
-                if status == 429:
-                    self.retry_after_429s += 1
+                if status in (429, 503):
+                    # 429: replica backpressure. 503 + Retry-After:
+                    # degraded mode — the fleet is recovering a crashed
+                    # replica; same response either way, wait and retry
+                    # the SAME window (the seq header makes it
+                    # idempotent, so nothing double-ingests)
+                    if status == 429:
+                        self.retry_after_429s += 1
+                    else:
+                        self.retry_after_503s += 1
                     wait = float(headers.get("Retry-After", 1))
                     self.stop_evt.wait(min(wait, 5.0))
-                    continue  # retry the SAME window — no double ingest
+                    continue
                 self.errors.append(f"seq {self.seq}: HTTP {status}")
                 return
             else:
@@ -223,7 +251,10 @@ def _build_fleet(n: int, mode: str, state_root: str,
             for name in names]
     else:
         raise ValueError(f"unknown fleet campaign mode {mode!r}")
-    return FleetManager(replicas, router_port=0, verbose=verbose)
+    # subprocess fleets run supervised: the chaos phase kill -9s a
+    # loaded replica and the crash supervisor must bring it back
+    return FleetManager(replicas, router_port=0, verbose=verbose,
+                        supervise=(mode == "subprocess"))
 
 
 def _aggregate(fleet: FleetManager) -> Dict[str, object]:
@@ -356,11 +387,13 @@ def run_fleet_rung(n: int, mode: str, state_root: str, tenants: int,
       every accepted span to emit before the phase may end;
     - **chaos** (n >= 2, gated not measured): the generators resume
       (continuing their window sequence) while the hot tenant is
-      live-migrated and — subprocess mode — every replica takes a
-      rolling restart; a final flush + settle feeds the rung-wide
-      zero-loss gate, so the failover machinery must be lossless under
-      live load even though its wall cost (two full process restarts)
-      stays out of the throughput figure."""
+      live-migrated, then — subprocess mode — the replica serving it
+      is SIGKILLed mid-post (crash supervisor recovers; acked spans
+      ride the ingest WAL) and every replica takes a rolling restart;
+      a final flush + settle feeds the rung-wide zero-loss gate, so
+      the failover machinery must be lossless under live load even
+      though its wall cost (full process restarts) stays out of the
+      throughput figure."""
     fleet = _build_fleet(n, mode, state_root, serve_args, verbose)
     tenant_ids = [f"ten{i}" for i in range(tenants)]
 
@@ -380,7 +413,7 @@ def run_fleet_rung(n: int, mode: str, state_root: str, tenants: int,
             raise RuntimeError(f"fleet-{n} drive errors: {errors[:5]}")
 
     wall_t0 = time.monotonic()
-    migrated = restarted = rebalanced = 0
+    migrated = restarted = rebalanced = killed = 0
     all_drives: List[_TenantDrive] = []
     try:
         # -- warmup (untimed): first-contact EM + XLA compiles ------------
@@ -452,6 +485,27 @@ def run_fleet_rung(n: int, mode: str, state_root: str, tenants: int,
             fleet.migrate(hot, dst)
             migrated += 1
             if mode == "subprocess":
+                # kill -9 the replica now serving the hot tenant while
+                # its generator is mid-post: no drain, no checkpoint, no
+                # goodbye. The crash supervisor must detect the corpse,
+                # recover it (respawn + WAL replay, or survivor
+                # failover from the dead disk), and the rung-wide
+                # conservation gate below must still balance EXACTLY —
+                # acked spans survive the kill or the campaign fails.
+                victim = fleet.router.owner(hot)
+                vrep = fleet.replicas[victim]
+                vrep.proc.kill()
+                killed += 1
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline:
+                    c = fleet.router.counters
+                    if c.get("respawns", 0) + c.get("failovers", 0) >= 1:
+                        break
+                    time.sleep(0.2)
+                else:
+                    raise RuntimeError(
+                        f"fleet-{n} chaos: supervisor never recovered "
+                        f"{victim} after kill -9")
                 fleet.rolling_restart()
                 restarted = len(fleet.replicas)
             # post-chaos burst: the fleet must still be ingesting after
@@ -516,6 +570,14 @@ def run_fleet_rung(n: int, mode: str, state_root: str, tenants: int,
             replicas_restarted=restarted,
             backpressure_429s=int(agg["backpressure_429s"]),
             generator_429s=sum(d.retry_after_429s for d in all_drives),
+            generator_503s=sum(d.retry_after_503s for d in all_drives),
+            deduped_windows=sum(d.deduped for d in all_drives),
+            crash_kills=killed,
+            respawns=int(agg["router"]["counters"].get("respawns", 0)),
+            crash_failovers=int(
+                agg["router"]["counters"].get("failovers", 0)),
+            reset_midbody=int(
+                agg["router"]["counters"].get("reset_midbody", 0)),
             parse_s=round(float(agg["parse_s"]), 4),
             stitch_s=round(float(agg["stitch_s"]), 4),
             emit_s=round(float(agg["emit_s"]), 4),

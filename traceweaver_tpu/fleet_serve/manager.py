@@ -28,10 +28,32 @@ two fleet-wide operations:
   ``--resume``, poll ``/readyz`` until the new process answers 200,
   restore routing. The router keeps serving throughout — at most one
   replica is down at any instant.
+
+With ``supervise=True`` the manager also runs a **crash supervisor**: a
+watcher thread polls each subprocess replica's liveness (``waitpid``
+via ``Popen.poll``), and a replica that exits WITHOUT being asked to
+(kill -9, OOM, segfault — anything not flagged draining) is recovered
+on one of two paths, both with the recovery wall ledgered in
+``tw_failover_seconds{mode=...}``:
+
+- **counted respawn** (under ``TW_FLEET_RESPAWN_MAX``): doubling
+  backoff, then ``--resume`` on the same state dir — checkpoints
+  restore the windows, the ingest WAL tail replays everything acked
+  after the last checkpoint, so no acknowledged span is lost. The
+  replica's tenants are HELD at the router for the respawn window
+  (requests wait instead of forking empty twins on survivors).
+- **survivor failover** (respawn budget exhausted, survivors exist):
+  each tenant on the crashed disk is rebuilt from its checkpoint
+  (``.prev`` fallback if the head generation tore) plus WAL tail via
+  :func:`~traceweaver_tpu.serve.tenancy.read_crashed_transfer`,
+  ``migrate_in``'d on the least-loaded survivor, pinned there, and
+  tombstoned on the dead disk — the same zero-twin discipline as a
+  live migration, driven entirely from post-mortem bytes.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 import signal
@@ -43,8 +65,16 @@ from typing import Dict, List, Optional
 
 from traceweaver_tpu.fleet_serve.router import FleetRouter, http_json
 from traceweaver_tpu.obs import events as _events
+from traceweaver_tpu.obs.registry import get_registry as _get_registry
+from traceweaver_tpu.runtime import knobs
 
 _LISTEN_RE = re.compile(r"listening on (http://[\d.]+:\d+)")
+
+_OBS_FAILOVER = _get_registry().histogram(
+    "tw_failover_seconds",
+    "wall-clock seconds from replica-crash detection to restored "
+    "routing, by recovery mode (respawn/failover)",
+    labels=("mode",))
 
 
 class ReplicaError(RuntimeError):
@@ -170,15 +200,35 @@ class InProcReplica:
 
 
 class FleetManager:
-    """N replicas + one router, started together, torn down together."""
+    """N replicas + one router, started together, torn down together.
+
+    ``supervise=True`` arms the crash supervisor (subprocess replicas
+    only): unexpected exits are detected within ``watch_period_s`` and
+    recovered by counted respawn or survivor failover — see the module
+    docstring for the full protocol."""
 
     def __init__(self, replicas: List, router_port: Optional[int] = 0,
-                 verbose: bool = False) -> None:
+                 verbose: bool = False, supervise: bool = False,
+                 watch_period_s: float = 0.2) -> None:
         self.replicas: Dict[str, object] = {r.name: r for r in replicas}
         self.router = FleetRouter(
             {r.name: r.base_url for r in replicas},
             port=router_port, verbose=verbose).start()
         self.verbose = verbose
+        self.respawn_max = knobs.get_int("TW_FLEET_RESPAWN_MAX")
+        self.respawns: Dict[str, int] = {}
+        self.failovers: List[Dict[str, object]] = []
+        self._watch_period_s = watch_period_s
+        self._stop_ev = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+        if supervise:
+            # failed proxy attempts yield one grace period so crash
+            # detection + tenant holds beat the retry to the ring
+            self.router.crash_grace_s = max(0.5, 3.0 * watch_period_s)
+            self._watcher = threading.Thread(
+                target=self._watch_loop, name="tw-fleet-supervisor",
+                daemon=True)
+            self._watcher.start()
 
     @property
     def base_url(self) -> str:
@@ -263,7 +313,154 @@ class FleetManager:
             f"replica {name} did not become ready within "
             f"{timeout_s:.0f}s after restart")
 
+    # -- crash supervisor --------------------------------------------------
+    def _watch_loop(self) -> None:
+        """Liveness poll over the subprocess replicas. A replica that is
+        dead but NOT draining (nobody asked it to stop) crashed; recover
+        it. The loop itself must never die — recovery failures are
+        evented and the replica is struck from further attempts rather
+        than spinning."""
+        gave_up: set = set()
+        while not self._stop_ev.wait(self._watch_period_s):
+            for name, rep in sorted(self.replicas.items()):
+                if not isinstance(rep, ReplicaProcess) or name in gave_up:
+                    continue
+                ref = self.router.replicas.get(name)
+                if rep.alive or ref is None or ref.draining:
+                    continue
+                if self._stop_ev.is_set():
+                    return
+                try:
+                    done = self._recover_crashed(name, rep)
+                except Exception as e:  # noqa: BLE001 — supervisor survives
+                    done = True
+                    _events.emit("fleet", "recover_failed", replica=name,
+                                 error=f"{type(e).__name__}: {e}")
+                if done:
+                    gave_up.add(name)
+
+    def _crashed_tenant_dirs(self, rep: ReplicaProcess) -> List[str]:
+        """Tenant ids with recoverable state on a crashed replica's disk
+        (mirrors the ``TenantService.resume`` scan: a checkpoint or a
+        WAL segment, and no migration tombstone)."""
+        out: List[str] = []
+        try:
+            names = sorted(os.listdir(rep.state_dir))
+        except OSError:
+            return out
+        for n in names:
+            tdir = os.path.join(rep.state_dir, n)
+            if not os.path.isdir(tdir):
+                continue
+            if os.path.isfile(os.path.join(tdir, "migrated_out.json")):
+                continue
+            has_state = (
+                os.path.isfile(os.path.join(tdir, "ckpt.pkl"))
+                or os.path.isfile(os.path.join(tdir, "ckpt.pkl.prev"))
+                or os.path.isdir(os.path.join(tdir, "wal")))
+            if has_state:
+                out.append(n)
+        return out
+
+    def _recover_crashed(self, name: str, rep: ReplicaProcess) -> bool:
+        """One crash-recovery round. Returns True when the supervisor is
+        DONE with this replica (failover ran, or nothing left to try);
+        False keeps it under watch (a respawned process can crash
+        again and draw from the remaining budget)."""
+        t0 = time.monotonic()
+        rc = rep.proc.returncode if rep.proc is not None else None
+        ref = self.router.replicas[name]
+        ref.ready = False  # out of routing before the health loop notices
+        tenants = self._crashed_tenant_dirs(rep)
+        _events.emit("fleet", "replica_crashed", replica=name,
+                     returncode=rc, tenants=len(tenants),
+                     respawns_used=self.respawns.get(name, 0))
+        # hold the dead replica's tenants for the recovery window:
+        # their POSTs wait at the router instead of auto-creating empty
+        # forked twins on whichever survivor the ring offers next
+        with contextlib.ExitStack() as stack:
+            for t in tenants:
+                stack.enter_context(self.router.hold_tenant(t))
+            n = self.respawns.get(name, 0)
+            if n < self.respawn_max:
+                self.respawns[name] = n + 1
+                self._respawn_crashed(name, rep, backoff_round=n, t0=t0)
+                return False
+            self._failover_crashed(name, rep, tenants, t0=t0)
+        return True
+
+    def _respawn_crashed(self, name: str, rep: ReplicaProcess,
+                         backoff_round: int, t0: float) -> None:
+        """Respawn a crashed replica in place: doubling backoff, then
+        ``--resume`` on the same state dir — checkpoints restore the
+        windows, the WAL tail replays every ack after them."""
+        self._stop_ev.wait(min(5.0, 0.25 * (2 ** backoff_round)))
+        if self._stop_ev.is_set():
+            return
+        if rep._reader is not None:
+            rep._reader.join(timeout=5.0)
+        rep.base_url = ""
+        rep.start(resume=True)
+        rep.restarts += 1
+        self.router.update_replica(name, rep.base_url)
+        self._wait_ready(name, timeout_s=rep.startup_timeout_s)
+        wall_s = time.monotonic() - t0
+        _OBS_FAILOVER.observe(wall_s, mode="respawn")
+        self.router.bump("respawns")
+        _events.emit("fleet", "replica_respawned", replica=name,
+                     new_url=rep.base_url, wall_s=round(wall_s, 3),
+                     respawns_used=self.respawns.get(name, 0))
+
+    def _failover_crashed(self, name: str, rep: ReplicaProcess,
+                          tenants: List[str], t0: float) -> None:
+        """Respawn budget exhausted: rebuild each tenant from the
+        crashed disk (checkpoint + WAL tail) on the least-loaded
+        survivor, pin it there, tombstone the dead copy."""
+        # deferred import — the manager stays serve-stack-free until a
+        # failover actually runs (same rule as InProcReplica)
+        from traceweaver_tpu.serve import tenancy as _tenancy
+
+        moved, skipped = [], []
+        for tenant in tenants:
+            tdir = os.path.join(rep.state_dir, tenant)
+            dst = self._drain_target(exclude=name)
+            try:
+                payload = _tenancy.read_crashed_transfer(tdir, tenant)
+            except _tenancy.TenancyError as e:
+                # nothing recoverable in this dir (e.g. empty WAL, no
+                # checkpoint yet) — there is no acked state to lose
+                skipped.append(tenant)
+                _events.emit("fleet", "crash_failover_skipped",
+                             replica=name, tenant=tenant, error=str(e))
+                continue
+            dst_url = self.router.replicas[dst].base_url
+            status, res = http_json(
+                "POST", f"{dst_url}/api/v1/tenants/{tenant}/migrate_in",
+                payload, timeout=self.router.migrate_timeout_s)
+            if status != 200:
+                raise ReplicaError(
+                    f"crash failover of {tenant!r} onto {dst}: HTTP "
+                    f"{status} {res.get('error', '')} — state remains on "
+                    f"{name}'s disk ({tdir})")
+            self.router.pin(tenant, dst)
+            _tenancy.tombstone_crashed_tenant(tdir, tenant)
+            moved.append((tenant, dst))
+        wall_s = time.monotonic() - t0
+        _OBS_FAILOVER.observe(wall_s, mode="failover")
+        self.router.bump("failovers")
+        self.failovers.append(dict(
+            replica=name, moved=moved, skipped=skipped,
+            wall_s=round(wall_s, 3)))
+        _events.emit("fleet", "crash_failover", replica=name,
+                     moved=len(moved), skipped=len(skipped),
+                     wall_s=round(wall_s, 3))
+
     def stop(self) -> None:
+        # the supervisor goes first: the teardown that follows kills
+        # replicas on purpose, and a live watcher would "recover" them
+        self._stop_ev.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=10.0)
         self.router.stop()
         for rep in self.replicas.values():
             rep.stop()  # type: ignore[attr-defined]

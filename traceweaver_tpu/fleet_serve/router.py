@@ -24,7 +24,13 @@ its own mesh) and owns the fleet's availability story:
   replica in ring order, at most ``TW_FLEET_RETRY_MAX`` extra attempts,
   every hop counted (``tw_fleet_router_total{outcome=...}``) — and a
   tenant POST that lands on a fallback replica PINS the tenant there so
-  its stream stays on one replica.
+  its stream stays on one replica. The candidate list is re-resolved
+  before EVERY attempt (never snapshotted): a crash failover or
+  supervisor respawn landing mid-retry re-routes the very next hop. A
+  connection reset *after* the request was accepted (replica killed
+  mid-body) is classified separately (``outcome="reset_midbody"``) —
+  that request may be half-applied on the dead replica, and only the
+  WAL's client-seq dedup makes the retry that follows safe.
 - **migration pins**: live tenant migration (:meth:`FleetRouter.
   migrate`) holds the tenant's requests, runs the replica-side
   ``migrate_out``/``migrate_in`` pair, then pins the tenant to its new
@@ -106,12 +112,13 @@ def http_json(method: str, url: str, payload: Optional[dict] = None,
 
 
 def _http_raw(method: str, url: str, body: Optional[bytes],
-              content_type: Optional[str],
-              timeout: float) -> Tuple[int, Dict[str, str], bytes]:
+              content_type: Optional[str], timeout: float,
+              extra: Optional[Dict[str, str]] = None,
+              ) -> Tuple[int, Dict[str, str], bytes]:
     """Proxy-side round trip preserving bytes and headers. HTTP errors
     are responses (forwarded as-is); only connection-level failures
     raise."""
-    headers = {}
+    headers = dict(extra or {})
     if content_type:
         headers["Content-Type"] = content_type
     req = urlrequest.Request(url, data=body, method=method,
@@ -242,8 +249,9 @@ class RouterHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code: int, message: str) -> None:
-        self._reply(code, {"error": message})
+    def _error(self, code: int, message: str,
+               headers: Optional[dict] = None) -> None:
+        self._reply(code, {"error": message}, headers)
 
     def _read_body(self) -> Optional[bytes]:
         try:
@@ -338,55 +346,99 @@ class RouterHandler(BaseHTTPRequestHandler):
         r = self.router
         target = self.path  # full path incl. query, verbatim
         content_type = self.headers.get("Content-Type")
+        client_seq = self.headers.get("X-TW-Seq")
+        extra = {"X-TW-Seq": client_seq} if client_seq else None
         r.wait_routable(tenant)
+        budget = 1 + (r.retry_max if method == "POST" else 1)
+        attempts_left = budget
+        tried: set = set()
+        saw_410 = False
+        saw_candidates = False
         last_err: Optional[Exception] = None
-        for round_ in range(2):  # second round only after a 410
+        while attempts_left > 0:
+            # re-resolve the ring EVERY attempt, not once per round: a
+            # crash-failover or respawn landing mid-retry changes both
+            # the routable set and the pin table, and a stale snapshot
+            # would keep hammering a corpse while the tenant's new home
+            # sits routable one lookup away
             cands = r.candidates(tenant)
-            if not cands:
-                r.bump("rejected")
-                self._error(503, "no routable replicas")
-                return
-            attempts_left = 1 + (r.retry_max if method == "POST" else 1)
-            for k, ref in enumerate(cands):
-                if attempts_left <= 0:
-                    break
-                attempts_left -= 1
-                try:
-                    status, headers, payload = _http_raw(
-                        method, ref.base_url + target, body, content_type,
-                        timeout=r.proxy_timeout_s)
-                except (urlerror.URLError, OSError) as e:
-                    ref.breaker.record(False)
-                    ref.failures += 1
-                    last_err = e
-                    r.bump("retried")
-                    continue
-                ref.breaker.record(True)
-                ref.requests += 1
-                if status == 410 and round_ == 0:
-                    # the tenant migrated off this replica mid-flight:
-                    # the pin table already knows its new home
-                    r.bump("rerouted")
-                    break
-                if k > 0 and method == "POST":
-                    # landed on a fallback replica: pin the tenant there
-                    # so its stream stays on ONE replica
-                    r.pin(tenant, ref.name)
-                    r.bump("rerouted")
-                r.bump("proxied")
-                fwd = {}
-                if "Retry-After" in headers:
-                    fwd["Retry-After"] = headers["Retry-After"]
-                self._reply_bytes(
-                    status, payload,
-                    headers.get("Content-Type", "application/json"), fwd)
-                return
-            else:
-                break  # candidates exhausted without a 410 — give up
+            ref = next((c for c in cands if c.name not in tried), None)
+            if ref is None:
+                break
+            saw_candidates = True
+            attempts_left -= 1
+            try:
+                status, headers, payload = _http_raw(
+                    method, ref.base_url + target, body, content_type,
+                    timeout=r.proxy_timeout_s, extra=extra)
+            except (urlerror.URLError, OSError) as e:
+                reason = getattr(e, "reason", e)
+                if isinstance(reason, (ConnectionResetError,
+                                       BrokenPipeError)):
+                    # the replica died AFTER accepting the connection
+                    # (kill -9 mid-body) — distinct from never-reachable
+                    # because the request may be half-applied; the WAL
+                    # seq dedup is what makes the retry safe
+                    r.bump("reset_midbody")
+                ref.breaker.record(False)
+                ref.failures += 1
+                last_err = e
+                tried.add(ref.name)
+                r.bump("retried")
+                if r.crash_grace_s > 0:
+                    # a crash supervisor is attached: give it one
+                    # detection period to notice the corpse, strike it
+                    # from routing, and HOLD its tenants — then resolve
+                    # from scratch. Falling straight through to the next
+                    # ring candidate here would auto-create an empty
+                    # forked twin of a tenant whose real state sits on
+                    # the crashed disk, waiting to be recovered.
+                    time.sleep(r.crash_grace_s)
+                    r.wait_routable(tenant)
+                    tried.clear()
+                continue
+            ref.breaker.record(True)
+            ref.requests += 1
+            if status == 410 and not saw_410:
+                # the tenant migrated off this replica mid-flight: the
+                # pin table already knows its new home — re-resolve with
+                # a fresh budget (a second 410 forwards to the client)
+                saw_410 = True
+                tried.clear()
+                tried.add(ref.name)
+                attempts_left = budget
+                r.bump("rerouted")
+                continue
+            if tried and method == "POST":
+                # landed on a fallback replica: pin the tenant there
+                # so its stream stays on ONE replica
+                r.pin(tenant, ref.name)
+                r.bump("rerouted")
+            r.bump("proxied")
+            fwd = {}
+            if "Retry-After" in headers:
+                fwd["Retry-After"] = headers["Retry-After"]
+            self._reply_bytes(
+                status, payload,
+                headers.get("Content-Type", "application/json"), fwd)
+            return
+        if not saw_candidates:
+            # degraded mode: nothing routable (replica down, supervisor
+            # recovering it) — tell the client when to come back
+            r.bump("rejected")
+            self._error(503, "no routable replicas",
+                        {"Retry-After": "1"})
+            return
         r.bump("failed")
+        if last_err is not None:
+            # every attempt died at the connection level: the fleet is
+            # recovering, not wrong — retryable, with a comeback hint
+            self._error(503, f"all replicas failed for tenant {tenant!r}"
+                             f": {type(last_err).__name__}: {last_err}",
+                        {"Retry-After": "1"})
+            return
         self._error(502, f"all replicas failed for tenant {tenant!r}"
-                         + (f": {type(last_err).__name__}: {last_err}"
-                            if last_err else " (migration loop)"))
+                         " (migration loop)")
 
 
 class FleetRouter(ThreadingHTTPServer):
@@ -415,7 +467,13 @@ class FleetRouter(ThreadingHTTPServer):
             "TW_FLEET_MIGRATE_TIMEOUT_S")
         self.counters: Dict[str, int] = dict(
             proxied=0, rerouted=0, retried=0, failed=0, rejected=0,
-            held=0, migrations=0, restarts=0)
+            held=0, migrations=0, restarts=0, reset_midbody=0,
+            failovers=0, respawns=0)
+        # >0 only when a crash supervisor is attached (FleetManager
+        # supervise=True): how long a failed proxy attempt yields before
+        # re-resolving, so crash detection + tenant holds win the race
+        # against the retry
+        self.crash_grace_s = 0.0
         self._lock = threading.RLock()
         self._migrating: Dict[str, threading.Event] = {}
         self._stop = threading.Event()
@@ -506,12 +564,17 @@ class FleetRouter(ThreadingHTTPServer):
                 self._migrating.pop(tenant, None)
             ev.set()
 
-    def wait_routable(self, tenant: str) -> None:
+    def wait_routable(self, tenant: str) -> bool:
+        """Block while the tenant's state is in flight between replicas
+        (migration or crash recovery); True if a hold was waited on —
+        the caller's routing snapshot is stale and must re-resolve."""
         with self._lock:
             ev = self._migrating.get(tenant)
-        if ev is not None:
-            self.bump("held")
-            ev.wait(timeout=self.migrate_timeout_s)
+        if ev is None:
+            return False
+        self.bump("held")
+        ev.wait(timeout=self.migrate_timeout_s)
+        return True
 
     def migrate(self, tenant: str, dst: str) -> Dict[str, object]:
         """Live tenant migration, router-coordinated: hold the tenant's

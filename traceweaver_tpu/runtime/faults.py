@@ -45,7 +45,12 @@ Sites (anything else raises — the ops/precision.py raise-on-typo rule):
   them, the stimulus the skew estimator must detect and correct. Like
   ``capture``, this site is consumed via ``plan.should_fail`` (a state
   perturbation, not a raised error), so :func:`maybe_fail` never fires
-  for it inside the solve supervisor.
+  for it inside the solve supervisor;
+- ``wal``       — write-ahead-log I/O (``stream/wal.py``): a drawn
+  append writes HALF a frame before raising (a genuine torn append —
+  the client never gets an ack and the next open truncates the partial
+  record, counted in ``wal_torn_tail``); the same site gates the fsync
+  path, standing in for a full disk or yanked volume.
 
 Determinism: one seeded RNG shared across sites, so a given
 ``(spec, seed)`` produces one fixed draw sequence. Under the pipelined
@@ -66,7 +71,7 @@ from typing import Dict, Optional
 
 #: every legal injection site, in ladder order of first appearance
 SITES = ("dispatch", "fetch", "host", "checkpoint", "source", "devcols",
-         "capture", "skew")
+         "capture", "skew", "wal")
 
 
 class FaultError(RuntimeError):

@@ -173,6 +173,21 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
        help="bounded redispatch retries before the ladder bisects"),
     _k("TW_RETRY_BACKOFF_S", "float", 0.02, lo=0.0, hi=30.0,
        help="base of the exponential retry backoff (seconds)"),
+    _k("TW_WAL", "bool", True,
+       help="durable ingest WAL (stream/wal.py): POST /spans and capture "
+            "ingest are acked only after a ledgered append of the raw "
+            "wire bytes, and resume replays the tail — acked spans "
+            "survive kill -9. 0 is the kill switch: byte-identical "
+            "pre-WAL ack path, no wal/ directory touched"),
+    _k("TW_WAL_SYNC", "enum", "batch", choices=("always", "batch", "off"),
+       help="WAL durability point per append: 'always' fsyncs every "
+            "append (power-safe), 'batch' (default) flushes to the OS "
+            "per append (survives process death) and group-commits the "
+            "fsync on the pump cadence, 'off' buffers until "
+            "close/checkpoint (documented loss window; bench baseline)"),
+    _k("TW_WAL_SEGMENT_MB", "int", 16, lo=1, hi=1024,
+       help="WAL segment rotation size (MiB): whole segments are "
+            "deleted once the checkpoint low-water mark passes them"),
     # --- serve: multi-tenant reconstruction service ----------------------
     _k("TW_SERVE_PORT", "int", 8321, lo=0, hi=65535,
        help="HTTP ingestion/query port (0 = ephemeral, the test mode)"),
@@ -238,6 +253,12 @@ REGISTRY: Dict[str, Knob] = {k.name: k for k in [
     _k("TW_FLEET_PROXY_TIMEOUT_S", "float", 120.0, lo=0.1, hi=3600.0,
        help="per-attempt proxy timeout for requests forwarded to a "
             "replica (a cold first solve can be slow on CPU)"),
+    _k("TW_FLEET_RESPAWN_MAX", "int", 3, lo=0, hi=64,
+       help="crash supervisor respawn budget per replica: a replica "
+            "that dies hard is respawned with --resume (checkpoint + "
+            "WAL tail replay) at most this many times, with doubling "
+            "backoff; past it the replica stays down and its tenants "
+            "fail over onto survivors"),
     # --- online adaptation (traceweaver_tpu/adapt, docs/ROBUSTNESS.md) ---
     _k("TW_ADAPT", "bool", False,
        help="1 arms the drift→adapt controller: PSI/low-confidence "

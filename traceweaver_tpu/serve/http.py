@@ -167,6 +167,21 @@ class ServeHandler(BaseHTTPRequestHandler):
             self._error(400, f"invalid JSON: {e}")
             return None
 
+    def _client_seq(self) -> Optional[int]:
+        """Optional ``X-TW-Seq`` idempotency header: the client's
+        per-tenant retry cursor, echoed on ledgered ingest responses
+        and deduplicated when a retry re-sends a seq whose ack was lost
+        (docs/ROBUSTNESS.md "Durability")."""
+        hdr = self.headers.get("X-TW-Seq")
+        if hdr is None:
+            return None
+        try:
+            return int(hdr)
+        except ValueError:
+            raise TenancyError(
+                f"bad X-TW-Seq header: {hdr!r} (expected an integer)"
+            ) from None
+
     def _tenant_route(self) -> Tuple[Optional[str], str, dict]:
         """(tenant_id | None, subpath, query) of the request path."""
         parsed = urlparse(self.path)
@@ -206,13 +221,28 @@ class ServeHandler(BaseHTTPRequestHandler):
                 # json.loads of a body the wire layer re-reads anyway;
                 # TW_WIRE_COLUMNAR=0 keeps the decoded-dict flow and its
                 # exact "invalid JSON: ..." 400 body
-                if _knobs.get_bool("TW_WIRE_COLUMNAR"):
-                    payload = self._read_body("Jaeger JSON")
-                else:
-                    payload = self._read_json()
-                if payload is None:
+                raw = self._read_body("Jaeger JSON")
+                if raw is None:
                     return
-                self._reply(200, self.service.ingest(tenant_id, payload))
+                if _knobs.get_bool("TW_WIRE_COLUMNAR"):
+                    payload = raw
+                else:
+                    try:
+                        payload = json.loads(raw)
+                    except json.JSONDecodeError as e:
+                        self._error(400, f"invalid JSON: {e}")
+                        return
+                # ack discipline (twlint TW013): with the WAL armed the
+                # 200 is written only after wal_ingest's ledgered append
+                # of the raw bytes — the ack means the spans survive
+                # kill -9; TW_WAL=0 is the byte-identical pre-WAL path
+                if _knobs.get_bool("TW_WAL"):
+                    self._reply(200, self.service.wal_ingest(
+                        tenant_id, payload, raw=raw,
+                        client_seq=self._client_seq()))
+                else:
+                    self._reply(200, self.service.ingest(
+                        tenant_id, payload))
             elif tenant_id is not None and sub == "/capture":
                 # the collector ingress (docs/COLLECTOR.md): raw strace
                 # -f [-ttt] log text (?source= names the capture host;
@@ -239,8 +269,18 @@ class ServeHandler(BaseHTTPRequestHandler):
                         return
                 else:
                     captures = raw.decode("utf-8", "replace")
-                self._reply(200, self.service.ingest_capture(
-                    tenant_id, captures, source=query.get("source")))
+                # same ack discipline as /spans (twlint TW013): the raw
+                # capture body is WAL-appended before the 200
+                if _knobs.get_bool("TW_WAL"):
+                    self._reply(200, self.service.wal_ingest_capture(
+                        tenant_id, captures, raw=raw,
+                        ctype=("json" if ctype == "application/json"
+                               else "text"),
+                        source=query.get("source"),
+                        client_seq=self._client_seq()))
+                else:
+                    self._reply(200, self.service.ingest_capture(
+                        tenant_id, captures, source=query.get("source")))
             elif tenant_id is not None and sub == "/flush":
                 self.service.tenant(tenant_id, create=False)
                 self._reply(200, self.service.flush(tenant_id))

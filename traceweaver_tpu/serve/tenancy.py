@@ -51,7 +51,7 @@ import queue
 import re
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -68,6 +68,7 @@ from traceweaver_tpu.ops.precision import precision_from_env
 from traceweaver_tpu.query.delay_culprit import live_delay_culprit
 from traceweaver_tpu.runtime import knobs
 from traceweaver_tpu.serve.ring import TraceRing, build_trace_records
+from traceweaver_tpu.stream import wal as _walmod
 from traceweaver_tpu.stream.checkpoint import (
     load_checkpoint,
     read_checkpoint_bytes,
@@ -87,6 +88,12 @@ _TENANT_ID_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
 #: restart so ``TenantService.resume`` re-tombstones instead of minting
 #: a forked twin from whatever files the tenant left behind
 MIGRATED_MARKER = "migrated_out.json"
+
+#: client-seq dedup window depth per tenant: how many recently applied
+#: client seqs a retried POST can be answered from without re-ingesting
+#: (past it the oldest entries roll off — a client that retries an ack
+#: lost 4096 accepted POSTs ago is outside any real retry policy)
+WAL_DEDUP_WINDOW = 4096
 
 # obs registry mirrors (docs/OBSERVABILITY.md): per-tenant counters and
 # the service-wide pump ledger. /metrics does NOT scrape these mirrors
@@ -233,6 +240,15 @@ class Tenant:
                     if cfg.state_dir else None)
         self.ckpt_path = (os.path.join(self.dir, "ckpt.pkl")
                           if self.dir else None)
+        # durable ingest WAL (stream/wal.py, docs/ROBUSTNESS.md
+        # "Durability"): opened lazily on the first ledgered append or
+        # resume replay, so TW_WAL=0 never creates wal/
+        self.wal_dir = (os.path.join(self.dir, "wal") if self.dir else None)
+        self.wal: Optional[_walmod.WriteAheadLog] = None
+        # client-seq dedup window: client seq -> traces/spans the
+        # original application ingested (echoed verbatim on a dedup hit
+        # so a retried POST's accounting matches the lost ack's)
+        self._wal_seen: "OrderedDict[int, int]" = OrderedDict()
         sink = (TraceSink(os.path.join(self.dir, "traces.jsonl"))
                 if self.dir else None)
         stream_cfg = StreamConfig(
@@ -471,6 +487,117 @@ class Tenant:
             svc.scheduler.offer(buf)
         return len(sealed)
 
+    # -- durable ingest WAL (stream/wal.py, TW_WAL) -----------------------
+    def _wal(self) -> Optional[_walmod.WriteAheadLog]:
+        """The tenant's write-ahead log, opened lazily (``None`` for
+        state-dir-less tenants — nothing to be durable on)."""
+        if self.wal is None and self.wal_dir:
+            self.wal = _walmod.WriteAheadLog(
+                self.wal_dir,
+                segment_bytes=knobs.get_int("TW_WAL_SEGMENT_MB") << 20,
+                sync=knobs.get("TW_WAL_SYNC"))
+        return self.wal
+
+    def wal_seen(self, client_seq: Optional[int]) -> Optional[int]:
+        """Dedup-window lookup: the original application's ingested
+        count when this client seq was already applied, else None."""
+        if client_seq is None:
+            return None
+        return self._wal_seen.get(int(client_seq))
+
+    def wal_note(self, client_seq: Optional[int], n: int) -> None:
+        """Record an applied client seq (bounded window — the retry-of-
+        a-lost-ack dedup horizon)."""
+        if client_seq is None:
+            return
+        self._wal_seen[int(client_seq)] = int(n)
+        while len(self._wal_seen) > WAL_DEDUP_WINDOW:
+            self._wal_seen.popitem(last=False)
+
+    def wal_append(self, kind: str, body: bytes,
+                   client_seq: Optional[int] = None,
+                   meta: Optional[Dict] = None) -> Optional[int]:
+        """Ledgered append of one accepted wire payload — the ack-
+        discipline point (twlint TW013): the raw POST bytes hit the log
+        (durability per ``TW_WAL_SYNC``) before the caller can write a
+        2xx. The envelope is a tiny JSON head (kind, client seq, capture
+        source/ctype) + NUL + the raw body, so replay re-drives the
+        normal ingest path — columnar wire parse included — with no
+        re-serialization."""
+        w = self._wal()
+        if w is None:
+            return None
+        head = dict(k=kind)
+        if client_seq is not None:
+            head["seq"] = int(client_seq)
+        if meta:
+            head.update({k: v for k, v in meta.items() if v is not None})
+        rec = (json.dumps(head, separators=(",", ":")).encode("utf-8")
+               + b"\0" + body)
+        seq = w.append(rec)
+        self._bump("wal_appends")
+        return seq
+
+    def wal_sync(self) -> None:
+        """Group commit (the ``batch`` policy's durability point): fsync
+        pending WAL appends on the pump cadence. Failure is counted, not
+        raised — the appends are already OS-flushed (process-death
+        safe); only the power-loss window widens."""
+        if self.wal is None:
+            return
+        try:
+            self.wal.sync()
+        except (OSError, RuntimeError) as e:
+            from traceweaver_tpu.runtime import faults
+
+            if not (isinstance(e, (OSError, faults.FaultError))
+                    or faults.is_transient_fault(e)):
+                raise
+            self._bump("wal_sync_failures")
+
+    def wal_replay(self, low_water: int) -> int:
+        """Resume half: re-apply every WAL record past the checkpoint's
+        low-water mark through the normal ingest path, in append order —
+        the acked-but-uncheckpointed tail a hard death would otherwise
+        lose. Torn tails were truncated (counted + evented) at open;
+        per-record decode/apply errors are counted and skipped, never
+        raised (a poison payload must not wedge recovery — its client
+        was answered 4xx/5xx in the original run too)."""
+        w = self._wal()
+        if w is None:
+            return 0
+        if w.torn_tails:
+            self._bump("wal_torn_tail", w.torn_tails)
+        n = 0
+        for _seq, rec in w.replay(int(low_water)):
+            head_b, _, body = rec.partition(b"\0")
+            try:
+                head = json.loads(head_b)
+            except ValueError:
+                self._bump("wal_replay_errors")
+                continue
+            cseq = head.get("seq")
+            try:
+                if head.get("k") == "capture":
+                    captures = body.decode("utf-8", "replace")
+                    if head.get("ctype") == "json":
+                        captures = json.loads(captures)
+                    summary = self.ingest_capture(
+                        captures, source=head.get("source"))
+                    self.wal_note(cseq, summary.get("ingested_spans", 0))
+                else:
+                    summary = self.ingest_payload(body)
+                    self.wal_note(cseq, summary.get("ingested_traces", 0))
+            except (MalformedSpan, ValueError):
+                self._bump("wal_replay_errors")
+                continue
+            n += 1
+        if n:
+            self._bump("wal_replayed", n)
+            _events.emit("serve", "wal_replayed", tenant=self.id,
+                         records=n, low_water=int(low_water))
+        return n
+
     # -- solve plumbing (driven by the TenantService pump) ----------------
     @property
     def backlog(self) -> int:
@@ -524,8 +651,23 @@ class Tenant:
                            for k, v in self._self_loop_map.items()},
             fault_spec=self.fault_spec,
             fleet_stats=dict(self.fleet_stats),
+            # WAL low-water mark: appends are applied to the service
+            # state synchronously under the lock, so everything up to
+            # last_seq is inside THIS checkpoint — segments at or below
+            # it truncate once the write lands, and resume replays only
+            # the seqs past it
+            wal=dict(
+                low_water=(self.wal.last_seq
+                           if self.wal is not None else 0),
+                seen=[(int(k), int(v))
+                      for k, v in self._wal_seen.items()],
+            ),
         )
         try:
+            if self.wal is not None:
+                # the log must be at least as durable as the checkpoint
+                # that supersedes it ('off' policy flushes here)
+                self.wal.sync()
             save_checkpoint(self.ckpt_path, state)
         except (OSError, RuntimeError) as e:
             from traceweaver_tpu.runtime import faults
@@ -536,6 +678,9 @@ class Tenant:
             self._bump("checkpoint_failures")
             return False
         self.svc._since_checkpoint = 0
+        if self.wal is not None:
+            self.wal.truncate_below(
+                int(state["serve"]["wal"]["low_water"]))
         return True
 
     @classmethod
@@ -553,7 +698,25 @@ class Tenant:
         tenant._self_loop_map.update(serve.get("self_loop_map", {}))
         tenant.fault_spec = serve.get("fault_spec")
         tenant.fleet_stats.update(serve.get("fleet_stats", {}))
+        wal_state = serve.get("wal") or {}
+        for k, v in wal_state.get("seen", []):
+            tenant._wal_seen[int(k)] = int(v)
+        if knobs.get_bool("TW_WAL"):
+            tenant.wal_replay(int(wal_state.get("low_water", 0)))
         return tenant
+
+    @classmethod
+    def recover(cls, tenant_id: str, cfg: ServeConfig) -> "Tenant":
+        """Crash-recovery resume: like :meth:`resume`, but tolerates a
+        missing checkpoint — a tenant that died hard before its first
+        checkpoint recovers purely from its WAL tail (the checkpoint
+        low-water mark is implicitly 0)."""
+        probe = cls(tenant_id, cfg)
+        if probe.ckpt_path and os.path.isfile(probe.ckpt_path):
+            return cls.resume(tenant_id, cfg)
+        if knobs.get_bool("TW_WAL"):
+            probe.wal_replay(0)
+        return probe
 
     def fault_plan(self):
         """The tenant's persistent parsed fault plan (None when no storm
@@ -573,6 +736,8 @@ class Tenant:
             self.svc.sink.close()
         if self.svc.deadletter is not None:
             self.svc.deadletter.close()
+        if self.wal is not None:
+            self.wal.close()
 
     # -- accounting -------------------------------------------------------
     def _bump(self, key: str, n: float = 1) -> None:
@@ -614,6 +779,7 @@ class Tenant:
             fault_spec=self.fault_spec,
             counters=dict(self.counters),
             ingest=dict(self.ingest_counters),
+            wal=(self.wal.stats() if self.wal is not None else None),
             faults=dict(
                 retries=int(self.fleet_stats.get("fault_retries", 0)),
                 bisections=int(self.fleet_stats.get("fault_bisections", 0)),
@@ -818,6 +984,70 @@ class TenantService:
             self.dispatcher.kick()
         return summary
 
+    def wal_ingest(self, tenant_id: str, payload, raw: bytes,
+                   client_seq: Optional[int] = None) -> Dict[str, int]:
+        """Ledgered ingest (``TW_WAL``, docs/ROBUSTNESS.md
+        "Durability"): the raw wire bytes are WAL-appended BEFORE the
+        payload touches tenant state, so by the time the caller writes
+        its 200 the spans survive kill -9 (durability per
+        ``TW_WAL_SYNC``). A ``client_seq`` already in the dedup window
+        is a retry of a lost ack — answered with the ORIGINAL
+        application's accounting, no re-append, no re-ingest, so a
+        crash between ack and client cannot double-emit. Same
+        pump/kick discipline as :meth:`ingest`."""
+        with self._lock:
+            t = self.tenant(tenant_id)
+            seen = t.wal_seen(client_seq)
+            if seen is not None:
+                t._bump("wal_deduped")
+                return dict(
+                    ingested_traces=seen, ingested_spans=0,
+                    rejected_traces=0,
+                    malformed_spans=t.ingest_counters.get(
+                        "malformed_spans", 0),
+                    backlog=t.backlog, deduped=True,
+                    seq=int(client_seq))
+            t.wal_append("spans", raw, client_seq=client_seq)
+            summary = t.ingest_payload(payload)
+            t.wal_note(client_seq, summary.get("ingested_traces", 0))
+            if client_seq is not None:
+                summary["seq"] = int(client_seq)
+            if self.dispatcher is None:
+                if self.total_backlog() >= self.cfg.pump_windows:
+                    summary["pumped_windows"] = self.pump()
+        if self.dispatcher is not None:
+            self.dispatcher.kick()
+        return summary
+
+    def wal_ingest_capture(self, tenant_id: str, captures, raw: bytes,
+                           ctype: Optional[str] = None,
+                           source: Optional[str] = None,
+                           client_seq: Optional[int] = None
+                           ) -> Dict[str, int]:
+        """Ledgered capture ingest: the capture-path twin of
+        :meth:`wal_ingest` (raw body + source/ctype ride the envelope
+        so replay rebuilds the same :meth:`Tenant.ingest_capture`
+        call)."""
+        with self._lock:
+            t = self.tenant(tenant_id)
+            seen = t.wal_seen(client_seq)
+            if seen is not None:
+                t._bump("wal_deduped")
+                return dict(ingested_spans=seen, backlog=t.backlog,
+                            deduped=True, seq=int(client_seq))
+            t.wal_append("capture", raw, client_seq=client_seq,
+                         meta=dict(source=source, ctype=ctype))
+            summary = t.ingest_capture(captures, source=source)
+            t.wal_note(client_seq, summary.get("ingested_spans", 0))
+            if client_seq is not None:
+                summary["seq"] = int(client_seq)
+            if self.dispatcher is None:
+                if self.total_backlog() >= self.cfg.pump_windows:
+                    summary["pumped_windows"] = self.pump()
+        if self.dispatcher is not None:
+            self.dispatcher.kick()
+        return summary
+
     def total_backlog(self) -> int:
         with self._lock:
             return sum(t.backlog for t in self.tenants.values())
@@ -911,6 +1141,9 @@ class TenantService:
                 n += self._solve_isolated(t, batch)
             for tid in sorted(self.tenants):
                 t = self.tenants[tid]
+                # WAL group commit rides the pump cadence (the 'batch'
+                # sync policy's fsync point)
+                t.wal_sync()
                 if t.ckpt_path and \
                         t.svc._since_checkpoint >= self.cfg.checkpoint_every:
                     t.checkpoint()
@@ -1053,6 +1286,7 @@ class TenantService:
                     n += self._solve_isolated(t, bufs)
                 for tid in sorted(self.tenants):
                     t = self.tenants[tid]
+                    t.wal_sync()  # group commit on the consume cadence
                     if t.in_flight:
                         continue
                     if t.ckpt_path and t.svc._since_checkpoint \
@@ -1481,6 +1715,12 @@ class TenantService:
                 and os.path.exists(t.svc.deadletter.path)):
             with open(t.svc.deadletter.path, "rb") as f:
                 dlq_b = f.read()
+        # the checkpoint just written fully covers the WAL (appends are
+        # applied synchronously and in_flight is empty), so the log is
+        # not part of the transfer — and like the checkpoint files it
+        # must not survive here to resurrect a forked twin
+        if t.wal is not None:
+            t.wal.destroy()
         t.close()
         del self.tenants[tenant_id]
         now = time.time()
@@ -1520,12 +1760,21 @@ class TenantService:
                 "live migration requires a state dir on the destination "
                 "replica too; restart serve with --state-dir")
         try:
-            ckpt = base64.b64decode(transfer["checkpoint_b64"])
+            ckpt = base64.b64decode(
+                transfer.get("checkpoint_b64", "") or "")
             sink_b = base64.b64decode(transfer.get("sink_b64", "") or "")
             dlq_b = base64.b64decode(
                 transfer.get("deadletter_b64", "") or "")
-        except (KeyError, TypeError, ValueError) as e:
+            wal_b = base64.b64decode(transfer.get("wal_b64", "") or "")
+        except (TypeError, ValueError) as e:
             raise TenancyError(f"malformed migration transfer: {e}")
+        if not ckpt and not wal_b:
+            # a graceful migrate always ships a checkpoint; a crash
+            # failover may ship only the WAL of a never-checkpointed
+            # tenant — but NEITHER means there is nothing to install
+            raise TenancyError(
+                "malformed migration transfer: neither checkpoint_b64 "
+                "nor wal_b64 present")
         with self._lock:
             if tenant_id in self.tenants:
                 raise TenancyError(
@@ -1543,12 +1792,21 @@ class TenantService:
                 f.write(sink_b)
             with open(sink_path + ".deadletter.jsonl", "wb") as f:
                 f.write(dlq_b)
-            write_checkpoint_bytes(os.path.join(tdir, "ckpt.pkl"), ckpt)
+            if ckpt:
+                write_checkpoint_bytes(os.path.join(tdir, "ckpt.pkl"),
+                                       ckpt)
+            if wal_b:
+                # crash failover: the dead replica's WAL tail rides the
+                # transfer — installed before resume so the replay picks
+                # up exactly the acked-but-uncheckpointed records (a
+                # torn tail in the copy truncates on install, same
+                # contract as open)
+                _walmod.install_bytes(os.path.join(tdir, "wal"), wal_b)
             # a returning tenant clears any tombstone it left behind here
             marker = os.path.join(tdir, MIGRATED_MARKER)
             if os.path.exists(marker):
                 os.remove(marker)
-            t = Tenant.resume(tenant_id, self.cfg)
+            t = Tenant.recover(tenant_id, self.cfg)
             self.tenants[tenant_id] = t
             self.migrated_out.pop(tenant_id, None)
             self._bump("migrations_in")
@@ -1592,6 +1850,13 @@ class TenantService:
                 if os.path.isfile(ckpt):
                     with svc._lock:
                         svc.tenants[name] = Tenant.resume(name, cfg)
+                elif (knobs.get_bool("TW_WAL") and not os.path.isfile(marker)
+                      and _walmod.list_segments(
+                          os.path.join(cfg.state_dir, name, "wal"))):
+                    # killed before its first checkpoint: the tenant
+                    # exists only as a WAL — recover replays it in full
+                    with svc._lock:
+                        svc.tenants[name] = Tenant.recover(name, cfg)
                 elif os.path.isfile(marker):
                     # migrated-out tombstone survives restarts: the
                     # tenant lives on another replica now — requests
@@ -1763,3 +2028,70 @@ class TenantService:
                 tenants={tid: t.stats()
                          for tid, t in sorted(self.tenants.items())},
             )
+
+
+def read_crashed_transfer(tenant_dir: str,
+                          tenant_id: str) -> Dict[str, object]:
+    """Build a ``migrate_in`` transfer payload from a CRASHED replica's
+    on-disk tenant state (the failover half of crash recovery,
+    ``fleet_serve/manager.py``). Unlike :meth:`TenantService.migrate_out`
+    there is no live service to quiesce: the checkpoint may be stale
+    (or absent for a never-checkpointed tenant) — the WAL tail carries
+    every payload acked past it, and the destination's resume replays
+    that tail through the normal ingest path. A corrupt primary
+    checkpoint falls back to the rotated ``.prev`` generation; sink
+    bytes past the checkpointed offset are spliced off by resume, same
+    as a restart."""
+    from traceweaver_tpu.stream.checkpoint import CheckpointCorrupt
+
+    ckpt_b = b""
+    ckpt_path = os.path.join(tenant_dir, "ckpt.pkl")
+    for path in (ckpt_path, ckpt_path + ".prev"):
+        if not os.path.isfile(path):
+            continue
+        try:
+            ckpt_b = read_checkpoint_bytes(path)
+            break
+        except (CheckpointCorrupt, OSError):
+            continue
+    sink_b = b""
+    sink_path = os.path.join(tenant_dir, "traces.jsonl")
+    if os.path.isfile(sink_path):
+        with open(sink_path, "rb") as f:
+            sink_b = f.read()
+    dlq_b = b""
+    dlq_path = sink_path + ".deadletter.jsonl"
+    if os.path.isfile(dlq_path):
+        with open(dlq_path, "rb") as f:
+            dlq_b = f.read()
+    wal_b = _walmod.read_all_bytes(os.path.join(tenant_dir, "wal"))
+    if not ckpt_b and not wal_b:
+        raise TenancyError(
+            f"tenant {tenant_id!r}: no recoverable state under "
+            f"{tenant_dir} (no readable checkpoint, empty WAL)")
+    return dict(
+        tenant=tenant_id,
+        checkpoint_b64=base64.b64encode(ckpt_b).decode("ascii"),
+        sink_b64=base64.b64encode(sink_b).decode("ascii"),
+        deadletter_b64=base64.b64encode(dlq_b).decode("ascii"),
+        wal_b64=base64.b64encode(wal_b).decode("ascii"),
+    )
+
+
+def tombstone_crashed_tenant(tenant_dir: str, tenant_id: str) -> None:
+    """Post-failover hygiene on the crashed replica's disk: the tenant
+    now lives on a survivor, so its checkpoint generations and WAL go
+    and a durable :data:`MIGRATED_MARKER` stays — when the dead replica
+    respawns with ``--resume`` it re-tombstones instead of minting a
+    forked twin (same rule as a graceful migrate_out)."""
+    ckpt_path = os.path.join(tenant_dir, "ckpt.pkl")
+    for path in (ckpt_path, ckpt_path + ".prev"):
+        if os.path.exists(path):
+            os.remove(path)
+    for name in _walmod.list_segments(os.path.join(tenant_dir, "wal")):
+        try:
+            os.remove(os.path.join(tenant_dir, "wal", name))
+        except OSError:
+            pass
+    with open(os.path.join(tenant_dir, MIGRATED_MARKER), "w") as f:
+        json.dump({"tenant": tenant_id, "migrated_unix": time.time()}, f)
